@@ -1,0 +1,32 @@
+"""ai_rtc_agent_tpu — a TPU-native real-time video-to-video diffusion framework.
+
+A from-scratch rebuild of the capabilities of yondonfu/ai-rtc-agent
+(/root/reference) designed for TPUs: the per-frame StreamDiffusion-style
+img2img loop runs as AOT-compiled JAX/XLA graphs (with Pallas kernels for the
+hot fused ops) instead of TensorRT engines; media I/O uses host-CPU codecs
+plus a pinned host<->HBM frame ring instead of NVDEC/NVENC; scale-out rides a
+`jax.sharding.Mesh` (ICI collectives) instead of DataParallel/NCCL.
+
+Package layout (mirrors SURVEY.md section 7's build order):
+  ops/       pure-function numerics: noise schedules, LCM/Turbo scheduler
+             steps, R-CFG guidance, in-graph image pre/post-processing,
+             Pallas TPU kernels.
+  models/    param-pytree model zoo: SD UNet (SD1.5/SD2.1/SDXL configs),
+             TAESD, CLIP text encoders, ControlNet, LoRA fusion, safetensors
+             loading.
+  stream/    the stream-batch denoising engine (StreamState + jitted step)
+             and the pipeline facade (parity with reference lib/pipeline.py).
+  aot/       AOT compile + serialized-executable cache (parity with the
+             reference's TensorRT engine cache, lib/wrapper.py:732-746).
+  parallel/  device mesh, collectives, ring attention, tensor-parallel
+             sharding rules, multi-peer batching, sharded trainer.
+  media/     frames, codecs (native libavcodec via ctypes, null fallback),
+             RTP, host<->HBM ring.
+  server/    aiohttp signaling agent (whip/whep/offer/config/health),
+             tracks, webhooks, TURN (parity with reference agent.py).
+  assets/    model download + engine build CLIs (parity with download.py,
+             build.py).
+  utils/     env/config tiers, logging, profiling gauges.
+"""
+
+__version__ = "0.1.0"

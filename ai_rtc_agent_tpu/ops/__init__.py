@@ -1,0 +1,1 @@
+from . import schedule, lcm, rcfg, image  # noqa: F401

@@ -1,0 +1,163 @@
+"""LCM / SD-Turbo scheduler step math as pure in-graph functions.
+
+This is the TPU-native replacement for the scheduler step the reference
+delegates to the StreamDiffusion fork (``stream(image)`` at reference
+lib/wrapper.py:330 — LCM consistency step + stream-batch re-noising).  All
+functions take precomputed per-batch-entry coefficient vectors so the whole
+step is shape-static and fuses into one elementwise XLA/Pallas kernel.
+
+Math, for eps-prediction models (SD1.5, SD2.1, SD-Turbo):
+    pred_x0  = (x_t - sigma_t * eps) / alpha_t
+    LCM consistency output:
+        denoised = c_skip(t) * x_t + c_out(t) * pred_x0
+    with boundary-condition coefficients (LCM paper, timestep_scaling = 10):
+        s       = t / 10
+        c_skip  = sigma_data^2 / (s^2 + sigma_data^2),   sigma_data = 0.5
+        c_out   = s / sqrt(s^2 + sigma_data^2)
+    Stream-batch advance: entry i re-noises `denoised` to the NEXT
+    sub-timestep t_{i+1} with fresh (or cached) noise:
+        x_{t_{i+1}} = alpha_{t_{i+1}} * denoised + sigma_{t_{i+1}} * noise
+    The last entry exits the ring fully denoised (its "next" alpha=1,
+    sigma=0).
+
+v-prediction (SD2.1-v style) is also supported:
+    pred_x0 = alpha_t * x_t - sigma_t * v
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from .schedule import NoiseSchedule
+
+SIGMA_DATA = 0.5
+TIMESTEP_SCALING = 10.0
+
+
+def boundary_coeffs(timesteps, timestep_scaling: float = TIMESTEP_SCALING):
+    """LCM c_skip / c_out for integer timesteps (fp32)."""
+    s = jnp.asarray(timesteps, dtype=jnp.float32) / timestep_scaling
+    denom = s**2 + SIGMA_DATA**2
+    c_skip = SIGMA_DATA**2 / denom
+    c_out = s / jnp.sqrt(denom)
+    return c_skip, c_out
+
+
+@dataclass(frozen=True)
+class StepCoeffs:
+    """Per-batch-entry scheduler coefficients, precomputed on host.
+
+    All arrays have shape [B] (B = len(t_index_list) * frame_buffer_size) and
+    broadcast over [B, C, H, W] latents.  Keeping them as data (not python
+    constants) lets t_index updates be a buffer swap, not a recompile —
+    the recompilation-discipline requirement from SURVEY.md section 7.
+    """
+
+    timesteps: np.ndarray  # [B] int32 current sub-timestep per entry
+    alpha: np.ndarray  # [B] sqrt(abar_t)
+    sigma: np.ndarray  # [B] sqrt(1-abar_t)
+    c_skip: np.ndarray  # [B]
+    c_out: np.ndarray  # [B]
+    next_alpha: np.ndarray  # [B] sqrt(abar_{t_next}), 1.0 for the exit entry
+    next_sigma: np.ndarray  # [B] sqrt(1-abar_{t_next}), 0.0 for the exit entry
+
+    def as_jnp(self, dtype=jnp.float32) -> "StepCoeffs":
+        f = lambda a: jnp.asarray(a, dtype=dtype)
+        return StepCoeffs(
+            jnp.asarray(self.timesteps, dtype=jnp.int32),
+            f(self.alpha),
+            f(self.sigma),
+            f(self.c_skip),
+            f(self.c_out),
+            f(self.next_alpha),
+            f(self.next_sigma),
+        )
+
+
+def make_step_coeffs(
+    schedule: NoiseSchedule,
+    batched_timesteps: np.ndarray,
+    frame_buffer_size: int = 1,
+    timestep_scaling: float = TIMESTEP_SCALING,
+) -> StepCoeffs:
+    """Build StepCoeffs for a stream batch.
+
+    ``batched_timesteps`` is the [B] output of
+    :func:`ops.schedule.batched_sub_timesteps` (ascending noise order is NOT
+    assumed; "next" = the entry one t_index later, i.e. index + fbs; the last
+    fbs entries exit clean).
+    """
+    t = np.asarray(batched_timesteps, dtype=np.int64)
+    B = t.shape[0]
+    fbs = frame_buffer_size
+    if B % fbs != 0:
+        raise ValueError(f"batch {B} not divisible by frame_buffer_size {fbs}")
+    ac = schedule.alphas_cumprod[t]
+    alpha = np.sqrt(ac)
+    sigma = np.sqrt(1.0 - ac)
+    s = t.astype(np.float64) / timestep_scaling
+    denom = s**2 + SIGMA_DATA**2
+    c_skip = SIGMA_DATA**2 / denom
+    c_out = s / np.sqrt(denom)
+
+    next_t = np.full(B, -1, dtype=np.int64)
+    if B > fbs:
+        next_t[: B - fbs] = t[fbs:]
+    next_ac = np.where(next_t >= 0, schedule.alphas_cumprod[np.clip(next_t, 0, None)], 1.0)
+    next_alpha = np.sqrt(next_ac)
+    next_sigma = np.sqrt(1.0 - next_ac)
+    return StepCoeffs(
+        timesteps=t.astype(np.int32),
+        alpha=alpha.astype(np.float32),
+        sigma=sigma.astype(np.float32),
+        c_skip=c_skip.astype(np.float32),
+        c_out=c_out.astype(np.float32),
+        next_alpha=next_alpha.astype(np.float32),
+        next_sigma=next_sigma.astype(np.float32),
+    )
+
+
+def _bcast(v, x):
+    return jnp.asarray(v, dtype=x.dtype).reshape((-1,) + (1,) * (x.ndim - 1))
+
+
+def pred_x0(x_t, model_out, coeffs: StepCoeffs, prediction_type: str = "epsilon"):
+    """Predicted clean latent from the model output."""
+    a = _bcast(coeffs.alpha, x_t)
+    s = _bcast(coeffs.sigma, x_t)
+    if prediction_type == "epsilon":
+        return (x_t - s * model_out) / a
+    if prediction_type == "v_prediction":
+        return a * x_t - s * model_out
+    if prediction_type == "sample":
+        return model_out
+    raise ValueError(f"unknown prediction_type: {prediction_type}")
+
+
+def lcm_denoise(x_t, model_out, coeffs: StepCoeffs, prediction_type: str = "epsilon"):
+    """LCM consistency function: denoised = c_skip * x_t + c_out * pred_x0."""
+    x0 = pred_x0(x_t, model_out, coeffs, prediction_type)
+    return _bcast(coeffs.c_skip, x_t) * x_t + _bcast(coeffs.c_out, x_t) * x0
+
+
+def renoise_next(denoised, noise, coeffs: StepCoeffs):
+    """Advance each entry to its next sub-timestep (exit entries unchanged).
+
+    x_{t_next} = next_alpha * denoised + next_sigma * noise; for the exit
+    entries next_alpha=1, next_sigma=0 so this is the identity on `denoised`.
+    """
+    return _bcast(coeffs.next_alpha, denoised) * denoised + _bcast(
+        coeffs.next_sigma, denoised
+    ) * noise
+
+
+def turbo_denoise(x_t, model_out, coeffs: StepCoeffs, prediction_type: str = "epsilon"):
+    """SD-Turbo / SDXL-Turbo 1-step: the denoised output IS pred_x0.
+
+    (Adversarially-distilled turbo models produce a clean sample in one eps
+    prediction at the max-noise timestep; no consistency blending.)
+    """
+    return pred_x0(x_t, model_out, coeffs, prediction_type)

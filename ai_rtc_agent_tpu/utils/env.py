@@ -1,0 +1,92 @@
+"""Typed environment-variable configuration tier.
+
+The reference reads env vars ad hoc (docs/environment.md:3-25) and has a
+latent TypeError: ``WARMUP_FRAMES`` is used unconverted (str when set) while
+``DROP_FRAMES`` gets ``int(...)`` (reference lib/tracks.py:17-18).  Here every
+env read goes through typed accessors so that class of bug cannot exist.
+
+Recognised variables (superset of reference docs/environment.md):
+  AUTH_TOKEN, WEBHOOK_URL            webhook eventing (lib/events.py parity)
+  TWILIO_ACCOUNT_SID/_AUTH_TOKEN     ephemeral TURN credentials
+  WARMUP_FRAMES, DROP_FRAMES         track warm-up / OBS stutter workaround
+  XLA_ENGINES_CACHE                  AOT executable cache dir (was
+                                     TRT_ENGINES_CACHE, lib/pipeline.py:35)
+  CIVITAI_CACHE, HF_HUB_CACHE        weight caches (lib/utils.py:6-10)
+  HW_ENCODE, HW_DECODE               native codec toggles (was NVENC/NVDEC,
+                                     Dockerfile:53-56); on TPU these select
+                                     the libavcodec native path vs null codec
+  ENC_PRESET, ENC_TUNING_INFO,       encoder tuning (was NVENC_*,
+  ENC_DEFAULT/MIN/MAX_BITRATE        docs/environment.md:17-25)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_str(name: str, default: str | None = None) -> str | None:
+    v = os.getenv(name)
+    return v if v is not None and v != "" else default
+
+
+def get_int(name: str, default: int) -> int:
+    v = os.getenv(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError as e:
+        raise ValueError(f"env var {name}={v!r} is not an integer") from e
+
+
+def get_float(name: str, default: float) -> float:
+    v = os.getenv(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError as e:
+        raise ValueError(f"env var {name}={v!r} is not a float") from e
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    """Truthy iff set to a non-empty value that is not 0/false/no/off.
+
+    The reference treats any non-empty NVENC/NVDEC as true
+    (lib/pipeline.py:83); we keep that but allow explicit falsy spellings.
+    """
+    v = os.getenv(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
+
+# Canonical accessors -------------------------------------------------------
+
+def warmup_frames() -> int:
+    return get_int("WARMUP_FRAMES", 10)
+
+
+def drop_frames() -> int:
+    return get_int("DROP_FRAMES", 0)
+
+
+def engines_cache() -> str:
+    # accept the reference's TRT_ENGINES_CACHE name as an alias for migration
+    return (
+        get_str("XLA_ENGINES_CACHE")
+        or get_str("TRT_ENGINES_CACHE")
+        or "./models/engines"
+    )
+
+
+def civitai_cache() -> str:
+    return get_str("CIVITAI_CACHE") or "./models/civitai"
+
+
+def hw_encode() -> bool:
+    return get_bool("HW_ENCODE", get_bool("NVENC", False))
+
+
+def hw_decode() -> bool:
+    return get_bool("HW_DECODE", get_bool("NVDEC", False))

@@ -1,0 +1,24 @@
+"""Test harness config: force CPU JAX with a virtual 8-device mesh.
+
+Per SURVEY.md section 4 the multi-chip story is tested on a simulated mesh
+(`--xla_force_host_platform_device_count=8`) — the standard JAX stand-in for
+multi-chip without real hardware.  Must run before jax initializes a backend.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

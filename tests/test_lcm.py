@@ -1,0 +1,95 @@
+"""Golden tests for LCM step math vs fp64 closed forms."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ai_rtc_agent_tpu.ops import lcm as L
+from ai_rtc_agent_tpu.ops import schedule as S
+
+
+def _coeffs(t_idx=(18, 26, 35, 45), steps=50, fbs=1):
+    sch = S.make_schedule()
+    bt = S.batched_sub_timesteps(list(t_idx), steps, frame_buffer_size=fbs)
+    return sch, L.make_step_coeffs(sch, bt, frame_buffer_size=fbs)
+
+
+def test_boundary_coeffs_golden():
+    # independent fp64 recomputation: sigma_data=0.5, scaling=10
+    t = np.array([0.0, 100.0, 500.0, 999.0])
+    c_skip, c_out = L.boundary_coeffs(t)
+    s = t / 10.0
+    np.testing.assert_allclose(
+        np.asarray(c_skip), 0.25 / (s**2 + 0.25), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(c_out), s / np.sqrt(s**2 + 0.25), rtol=1e-6
+    )
+    # at t=0 the consistency fn is the identity on x_t
+    assert abs(float(c_skip[0]) - 1.0) < 1e-6 and abs(float(c_out[0])) < 1e-6
+
+
+def test_step_coeffs_next_shifts_by_fbs():
+    sch, c = _coeffs(fbs=2)
+    # entry i's next-stage coeffs are entry i+fbs's current-stage coeffs
+    np.testing.assert_allclose(c.next_alpha[:-2], c.alpha[2:], rtol=1e-6)
+    np.testing.assert_allclose(c.next_sigma[:-2], c.sigma[2:], rtol=1e-6)
+    # exit entries re-noise to clean
+    np.testing.assert_allclose(c.next_alpha[-2:], 1.0)
+    np.testing.assert_allclose(c.next_sigma[-2:], 0.0)
+
+
+def test_pred_x0_inverts_add_noise(rng):
+    sch, c = _coeffs()
+    x0 = rng.standard_normal((4, 4, 8, 8)).astype(np.float32)
+    eps = rng.standard_normal((4, 4, 8, 8)).astype(np.float32)
+    x_t = S.add_noise(sch, jnp.asarray(x0), jnp.asarray(eps), c.timesteps)
+    got = L.pred_x0(x_t, jnp.asarray(eps), c.as_jnp())
+    np.testing.assert_allclose(np.asarray(got), x0, rtol=2e-3, atol=2e-3)
+
+
+def test_lcm_denoise_blend(rng):
+    sch, c = _coeffs()
+    x_t = rng.standard_normal((4, 4, 4, 4)).astype(np.float32)
+    eps = rng.standard_normal((4, 4, 4, 4)).astype(np.float32)
+    den = np.asarray(L.lcm_denoise(jnp.asarray(x_t), jnp.asarray(eps), c.as_jnp()))
+    x0 = np.asarray(L.pred_x0(jnp.asarray(x_t), jnp.asarray(eps), c.as_jnp()))
+    want = (
+        c.c_skip[:, None, None, None] * x_t + c.c_out[:, None, None, None] * x0
+    )
+    np.testing.assert_allclose(den, want, rtol=1e-5, atol=1e-6)
+
+
+def test_renoise_next_exit_is_identity(rng):
+    sch, c = _coeffs()
+    den = rng.standard_normal((4, 4, 4, 4)).astype(np.float32)
+    noise = rng.standard_normal((4, 4, 4, 4)).astype(np.float32)
+    out = np.asarray(L.renoise_next(jnp.asarray(den), jnp.asarray(noise), c.as_jnp()))
+    # last entry exits clean: renoise is identity
+    np.testing.assert_allclose(out[-1], den[-1], rtol=1e-6)
+    # earlier entries follow q(x_{t_next} | x0=denoised)
+    ac = sch.alphas_cumprod[np.asarray(c.timesteps)[1]]
+    want0 = np.sqrt(ac) * den[0] + np.sqrt(1 - ac) * noise[0]
+    np.testing.assert_allclose(out[0], want0.astype(np.float32), rtol=1e-5, atol=1e-5)
+
+
+def test_turbo_denoise_is_pred_x0(rng):
+    sch = S.make_schedule()
+    bt = S.batched_sub_timesteps([0], 1, num_train_steps=1000, spacing="trailing")
+    c = L.make_step_coeffs(sch, bt)
+    assert c.timesteps.tolist() == [999]
+    x_t = rng.standard_normal((1, 4, 8, 8)).astype(np.float32)
+    eps = rng.standard_normal((1, 4, 8, 8)).astype(np.float32)
+    td = L.turbo_denoise(jnp.asarray(x_t), jnp.asarray(eps), c.as_jnp())
+    px = L.pred_x0(jnp.asarray(x_t), jnp.asarray(eps), c.as_jnp())
+    np.testing.assert_allclose(np.asarray(td), np.asarray(px))
+
+
+def test_v_prediction(rng):
+    sch, c = _coeffs()
+    x_t = rng.standard_normal((4, 4, 4, 4)).astype(np.float32)
+    v = rng.standard_normal((4, 4, 4, 4)).astype(np.float32)
+    got = np.asarray(L.pred_x0(jnp.asarray(x_t), jnp.asarray(v), c.as_jnp(), "v_prediction"))
+    want = (
+        c.alpha[:, None, None, None] * x_t - c.sigma[:, None, None, None] * v
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,51 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ai_rtc_agent_tpu.ops import rcfg as R
+
+
+def test_needs_double_batch():
+    assert R.needs_double_batch("full")
+    for t in ("none", "self", "initialize"):
+        assert not R.needs_double_batch(t)
+    with pytest.raises(ValueError):
+        R.needs_double_batch("bogus")
+
+
+def test_full_cfg_golden(rng):
+    eu = rng.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    ec = rng.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    got = np.asarray(R.combine_full(jnp.asarray(eu), jnp.asarray(ec), 7.5))
+    np.testing.assert_allclose(got, eu + 7.5 * (ec - eu), rtol=1e-5, atol=1e-6)
+    # g=1 reduces to conditional prediction
+    got1 = np.asarray(R.combine_full(jnp.asarray(eu), jnp.asarray(ec), 1.0))
+    np.testing.assert_allclose(got1, ec, rtol=1e-5, atol=1e-6)
+
+
+def test_residual_cfg_golden(rng):
+    ec = rng.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    stock = rng.standard_normal((2, 4, 4, 4)).astype(np.float32)
+    got = np.asarray(R.combine_residual(jnp.asarray(ec), jnp.asarray(stock), 1.5, 0.7))
+    np.testing.assert_allclose(got, 1.5 * ec - 0.5 * 0.7 * stock, rtol=1e-5)
+    # g=1: guidance off regardless of stock noise
+    got1 = np.asarray(R.combine_residual(jnp.asarray(ec), jnp.asarray(stock), 1.0))
+    np.testing.assert_allclose(got1, ec, rtol=1e-6)
+
+
+def test_apply_guidance_dispatch(rng):
+    ec = jnp.asarray(rng.standard_normal((1, 4, 2, 2)).astype(np.float32))
+    assert np.allclose(np.asarray(R.apply_guidance("none", ec)), np.asarray(ec))
+    with pytest.raises(ValueError):
+        R.apply_guidance("full", ec)  # missing uncond
+    with pytest.raises(ValueError):
+        R.apply_guidance("self", ec)  # missing stock noise
+
+
+def test_update_stock_noise_fixed_point(rng):
+    # if prediction equals current stock (delta=1), the stock is unchanged
+    stock = jnp.asarray(rng.standard_normal((2, 4, 2, 2)).astype(np.float32))
+    alpha = jnp.asarray(np.array([0.9, 0.5], np.float32))
+    sigma = jnp.asarray(np.array([0.436, 0.866], np.float32))
+    out = R.update_stock_noise(stock, stock, alpha, sigma, 1.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(stock), rtol=1e-5)

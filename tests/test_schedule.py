@@ -1,0 +1,76 @@
+"""Golden tests for the noise schedule vs an independent fp64 reference."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ai_rtc_agent_tpu.ops import schedule as S
+
+
+def test_scaled_linear_betas_match_fp64_reference():
+    sch = S.make_schedule()
+    # independent recomputation
+    betas = np.linspace(0.00085**0.5, 0.012**0.5, 1000) ** 2
+    np.testing.assert_allclose(sch.betas, betas, rtol=0, atol=0)
+    np.testing.assert_allclose(sch.alphas_cumprod, np.cumprod(1 - betas), rtol=1e-12)
+    assert sch.alphas_cumprod[0] > 0.999 - 1e-3
+    assert sch.alphas_cumprod[-1] < 0.01  # nearly pure noise at t=999
+
+
+def test_inference_timesteps_leading_50():
+    ts = S.inference_timesteps(50)
+    assert ts.shape == (50,)
+    assert ts[0] == 980 and ts[-1] == 0  # 20*i descending
+    assert (np.diff(ts) < 0).all()
+
+
+def test_inference_timesteps_trailing_1_step_turbo():
+    ts = S.inference_timesteps(1, spacing="trailing")
+    assert ts.tolist() == [999]
+
+
+def test_sub_timesteps_reference_default():
+    # reference default t_index_list [18,26,35,45] of 50 (lib/pipeline.py:12)
+    st = S.sub_timesteps([18, 26, 35, 45], 50)
+    ladder = S.inference_timesteps(50)
+    np.testing.assert_array_equal(st, ladder[[18, 26, 35, 45]])
+    # larger t_index -> later in descending ladder -> smaller timestep
+    assert (np.diff(st) < 0).all()
+
+
+def test_sub_timesteps_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        S.sub_timesteps([], 50)
+    with pytest.raises(ValueError):
+        S.sub_timesteps([5, 3], 50)  # not increasing
+    with pytest.raises(ValueError):
+        S.sub_timesteps([0, 50], 50)  # out of range
+
+
+def test_batched_sub_timesteps_repeat_interleave():
+    st = S.batched_sub_timesteps([10, 20], 50, frame_buffer_size=3)
+    base = S.sub_timesteps([10, 20], 50)
+    np.testing.assert_array_equal(st, np.repeat(base, 3))
+
+
+def test_add_noise_matches_closed_form(rng):
+    sch = S.make_schedule()
+    x0 = rng.standard_normal((4, 4, 8, 8)).astype(np.float32)
+    noise = rng.standard_normal((4, 4, 8, 8)).astype(np.float32)
+    t = np.array([0, 100, 500, 999])
+    out = np.asarray(S.add_noise(sch, jnp.asarray(x0), jnp.asarray(noise), t))
+    ac = sch.alphas_cumprod[t]
+    want = (
+        np.sqrt(ac)[:, None, None, None] * x0
+        + np.sqrt(1 - ac)[:, None, None, None] * noise
+    )
+    np.testing.assert_allclose(out, want.astype(np.float32), rtol=2e-5, atol=2e-6)
+
+
+def test_add_noise_clean_timestep():
+    sch = S.make_schedule()
+    x0 = np.ones((1, 4, 2, 2), np.float32)
+    noise = np.full((1, 4, 2, 2), 7.0, np.float32)
+    out = np.asarray(S.add_noise(sch, x0, noise, np.array([-1])))
+    np.testing.assert_allclose(out, x0)
