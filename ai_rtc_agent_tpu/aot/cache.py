@@ -1,0 +1,129 @@
+"""AOT compilation + serialized-executable cache.
+
+TPU-native replacement for the reference's TensorRT engine layer: the
+ONNX->TRT compile pipeline (reference lib/wrapper.py:712-915), the engine
+cache key discipline (:732-746), the on-disk layout
+``engines--<model>/{unet,vae_encoder,vae_decoder}.engine`` (:593-597,
+896-910) and the "load engines without base weights" fast path (:409-512).
+
+Here an "engine" is a serialized ``jax.export`` artifact (StableHLO +
+calling convention): portable across processes, loaded without re-tracing
+the python model code.  On first use per (key x platform) we export, compile
+and persist; subsequent server starts deserialize and run.
+
+Key discipline mirrors the reference exactly:
+    model x mode x min/max batch x resolution x dtype x code-version
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from dataclasses import dataclass
+
+import jax
+from jax import export as jax_export
+
+from .. import __version__
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+
+def engine_key(model_id: str, mode: str, **attrs) -> str:
+    """Human-readable cache key (reference lib/wrapper.py:732-746 analog)."""
+    safe_model = model_id.replace("/", "--")
+    parts = [f"engines--{safe_model}", f"mode-{mode}"]
+    for k in sorted(attrs):
+        parts.append(f"{k}-{attrs[k]}")
+    parts.append(f"v-{__version__}")
+    return "--".join(parts)
+
+
+def _digest(key: str, args_spec: str, platform: str) -> str:
+    h = hashlib.sha256(f"{key}|{args_spec}|{platform}|{jax.__version__}".encode())
+    return h.hexdigest()[:16]
+
+
+@dataclass
+class EngineCache:
+    """Directory-backed cache of serialized XLA executables."""
+
+    cache_dir: str | None = None
+
+    def __post_init__(self):
+        self.cache_dir = self.cache_dir or env.engines_cache()
+
+    def _paths(self, key: str, digest: str):
+        d = os.path.join(self.cache_dir, key)
+        return d, os.path.join(d, f"{digest}.jaxexport"), os.path.join(
+            d, f"{digest}.json"
+        )
+
+    def load_or_build(self, key: str, fn, example_args, donate_argnums=()):
+        """Return a callable backed by a cached executable when possible.
+
+        ``fn`` must be a pure function; ``example_args`` a tuple of arrays /
+        ShapeDtypeStructs defining the static signature.
+        """
+        specs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tuple(example_args)
+        )
+        platform = jax.default_backend()
+        args_spec = ";".join(f"{s.shape}:{s.dtype}" for s in jax.tree.leaves(specs))
+        digest = _digest(key, args_spec, platform)
+        d, blob_path, meta_path = self._paths(key, digest)
+
+        if os.path.exists(blob_path):
+            try:
+                with open(blob_path, "rb") as f:
+                    exp = jax_export.deserialize(f.read())
+                logger.info("engine cache HIT %s (%s)", key, digest)
+                return exp.call
+            except Exception as e:  # corrupted/incompatible: rebuild
+                logger.warning("engine cache entry unreadable (%s); rebuilding", e)
+
+        logger.info("engine cache MISS %s — compiling (first run is slow)", key)
+        t0 = time.time()
+        jitted = jax.jit(fn, donate_argnums=donate_argnums)
+        exp = jax_export.export(jitted)(*specs)
+        blob = exp.serialize()
+        os.makedirs(d, exist_ok=True)
+        tmp = blob_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, blob_path)
+        with open(meta_path, "w") as f:
+            json.dump(
+                {
+                    "key": key,
+                    "digest": digest,
+                    "platform": platform,
+                    "jax": jax.__version__,
+                    "args": args_spec,
+                    "built_at": time.time(),
+                    "build_seconds": time.time() - t0,
+                },
+                f,
+                indent=2,
+            )
+        logger.info("engine built in %.1fs -> %s", time.time() - t0, blob_path)
+        return exp.call
+
+    def entries(self):
+        if not os.path.isdir(self.cache_dir):
+            return []
+        out = []
+        for key in sorted(os.listdir(self.cache_dir)):
+            kd = os.path.join(self.cache_dir, key)
+            if os.path.isdir(kd):
+                for f in sorted(os.listdir(kd)):
+                    if f.endswith(".json"):
+                        with open(os.path.join(kd, f)) as fh:
+                            out.append(json.load(fh))
+        return out
+
+

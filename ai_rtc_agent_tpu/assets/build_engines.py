@@ -1,0 +1,90 @@
+"""AOT engine builder CLI — parity with reference build.py.
+
+The reference builds TensorRT engines by constructing the wrapper (compile
+happens inside _load_model, reference build.py:11-32); here we AOT-compile
+the full stream step via jax.export and persist it in the engine cache
+(aot/cache.py), optionally fusing LoRAs first (build.py:14-24 parity).
+Serving then hits the deserialize fast path — the analog of the reference's
+"load engines without base weights" (lib/wrapper.py:409-512).
+
+Usage:
+  python -m ai_rtc_agent_tpu.assets.build_engines --model-id stabilityai/sd-turbo
+  python -m ai_rtc_agent_tpu.assets.build_engines --model-id lykon/dreamshaper-8 \
+      --lora ./models/civitai/studio-ghibli-style-lora.safetensors:1.0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def build(model_id: str, lora_dict: dict | None = None, cache_dir: str | None = None):
+    from ..aot.cache import EngineCache, engine_key
+    from ..models import registry
+    from ..stream.engine import StreamEngine, make_step_fn
+
+    bundle = registry.load_model_bundle(model_id, lora_dict=lora_dict)
+    cfg = registry.default_stream_config(model_id)
+    engine = StreamEngine(
+        bundle.stream_models,
+        bundle.params,
+        cfg,
+        bundle.encode_prompt,
+        jit_compile=False,
+    )
+    engine.prepare(prompt="engine build probe")
+
+    step = make_step_fn(bundle.stream_models, cfg)
+    frame = np.zeros(
+        (cfg.height, cfg.width, 3)
+        if cfg.frame_buffer_size == 1
+        else (cfg.frame_buffer_size, cfg.height, cfg.width, 3),
+        np.uint8,
+    )
+    key = engine_key(
+        model_id,
+        cfg.mode,
+        batch=cfg.batch_size,
+        hw=f"{cfg.height}x{cfg.width}",
+        dtype=cfg.dtype,
+        cfgtype=cfg.cfg_type,
+        sched=cfg.scheduler,
+    )
+    cache = EngineCache(cache_dir)
+    call = cache.load_or_build(
+        key, step, (bundle.params, engine.state, frame), donate_argnums=(1,)
+    )
+    # smoke-run the built engine once
+    new_state, out = call(bundle.params, engine.state, frame)
+    jax.block_until_ready(out)
+    logger.info("engine %s built and verified (out %s)", key, np.asarray(out).shape)
+    return key
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-id", default="stabilityai/sd-turbo")
+    ap.add_argument(
+        "--lora",
+        action="append",
+        default=[],
+        help="path.safetensors:scale (repeatable)",
+    )
+    ap.add_argument("--cache-dir", default=None)
+    args = ap.parse_args(argv)
+    lora_dict = {}
+    for spec in args.lora:
+        path, _, scale = spec.rpartition(":")
+        lora_dict[path or spec] = float(scale) if path else 1.0
+    build(args.model_id, lora_dict or None, args.cache_dir)
+
+
+if __name__ == "__main__":
+    main()

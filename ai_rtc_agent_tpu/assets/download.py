@@ -1,0 +1,84 @@
+"""Model downloader CLI — parity with reference download.py.
+
+Snapshots the serving model families from HF hub plus style LoRAs from
+Civitai (Content-Disposition filename parsing kept, reference
+download.py:28-41).  Honors HF_HUB_CACHE / CIVITAI_CACHE exactly like the
+reference (lib/utils.py:6-10).  Network access is required — on a zero-egress
+TPU VM, run this on a connected host and ship the caches.
+
+Usage: python -m ai_rtc_agent_tpu.assets.download [--model-set default|sd15|turbo|sdxl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import re
+
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+HF_MODEL_SETS = {
+    "sd15": [
+        "lykon/dreamshaper-8",
+        "latent-consistency/lcm-lora-sdv1-5",
+        "madebyollin/taesd",
+    ],
+    "turbo": ["stabilityai/sd-turbo", "madebyollin/taesd"],
+    "sdxl": ["stabilityai/sdxl-turbo", "madebyollin/taesdxl"],
+}
+HF_MODEL_SETS["default"] = (
+    HF_MODEL_SETS["sd15"] + HF_MODEL_SETS["turbo"] + HF_MODEL_SETS["sdxl"]
+)
+
+# Civitai style LoRAs by version id (reference download.py:17-25 ships the
+# studio-ghibli LoRA this way)
+CIVITAI_MODELS = {"studio-ghibli-style-lora": "7657"}
+
+
+def civitai_model_path(name: str) -> str:
+    """Cache path helper (reference lib/utils.py:6-10 parity)."""
+    return os.path.join(env.civitai_cache(), f"{name}.safetensors")
+
+
+def download_civitai_model(name: str, version_id: str) -> str | None:
+    import requests
+
+    path = civitai_model_path(name)
+    if os.path.exists(path):
+        logger.info("civitai %s cached", name)
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    url = f"https://civitai.com/api/download/models/{version_id}"
+    r = requests.get(url, allow_redirects=True, timeout=120)
+    if r.status_code != 200:
+        logger.error("civitai download failed: %s", r.status_code)
+        return None
+    # filename from Content-Disposition (parity with reference
+    # download.py:33-38), but we store under our canonical name
+    cd = r.headers.get("Content-Disposition", "")
+    m = re.search(r'filename="?([^";]+)"?', cd)
+    logger.info("downloaded %s (%s)", name, m.group(1) if m else "unnamed")
+    with open(path, "wb") as f:
+        f.write(r.content)
+    return path
+
+
+def download(model_set: str = "default"):
+    from huggingface_hub import snapshot_download
+
+    for repo in HF_MODEL_SETS[model_set]:
+        logger.info("snapshot %s", repo)
+        snapshot_download(repo)
+    for name, version in CIVITAI_MODELS.items():
+        download_civitai_model(name, version)
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-set", default="default", choices=sorted(HF_MODEL_SETS))
+    args = ap.parse_args()
+    download(args.model_set)

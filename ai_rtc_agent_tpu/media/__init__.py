@@ -1,0 +1,1 @@
+from . import frames  # noqa: F401

@@ -1,0 +1,165 @@
+"""Video codecs for the TPU media plane.
+
+Replaces the reference's NVENC/NVDEC hardware paths (toggled by NVENC/NVDEC
+env vars, reference lib/pipeline.py:83-96, Dockerfile:53-56) with host-CPU
+H.264 via the native shim (native/h264.cpp -> distro libavcodec), selected by
+HW_ENCODE/HW_DECODE (NVENC/NVDEC accepted as aliases, utils/env.py).
+
+Encoder tuning surface mirrors the reference's NVENC_* env vars
+(docs/environment.md:17-25): ENC_PRESET (x264 preset, default ultrafast),
+ENC_TUNING_INFO (default zerolatency), ENC_DEFAULT_BITRATE.
+
+``NullCodec`` is the hermetic fallback: "encoded" frames are raw RGB with an
+8-byte header — it keeps every byte-stream contract intact for tests and for
+environments without libavcodec 5.x.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import struct
+
+import numpy as np
+
+from ..utils import env
+from . import native
+
+logger = logging.getLogger(__name__)
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class H264Encoder:
+    def __init__(
+        self,
+        width: int,
+        height: int,
+        fps: int = 30,
+        bitrate: int | None = None,
+        gop: int = 60,
+        preset: str | None = None,
+        tune: str | None = None,
+    ):
+        lib = native.load()
+        if lib is None or not lib.tr_h264_available():
+            raise RuntimeError("native H.264 not available (libavcodec 5.x required)")
+        self._lib = lib
+        bitrate = bitrate or env.get_int("ENC_DEFAULT_BITRATE", 3_000_000)
+        preset = preset or env.get_str("ENC_PRESET", "ultrafast")
+        tune = tune or env.get_str("ENC_TUNING_INFO", "zerolatency")
+        self._enc = lib.tr_h264_encoder_create(
+            width, height, fps, 1, bitrate, gop, preset.encode(), tune.encode()
+        )
+        if not self._enc:
+            raise RuntimeError("failed to open H.264 encoder")
+        self.width, self.height = width, height
+        self._buf = np.empty(width * height * 3 + (1 << 16), np.uint8)
+
+    def encode(self, rgb: np.ndarray, pts: int = -1) -> bytes:
+        """[H,W,3] uint8 -> annex-B bytes ('' while the encoder buffers)."""
+        rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
+        key = ctypes.c_int(0)
+        n = self._lib.tr_h264_encode(
+            self._enc, _u8p(rgb), pts, _u8p(self._buf), self._buf.size,
+            ctypes.byref(key),
+        )
+        if n < 0:
+            raise RuntimeError(f"encode failed: {n}")
+        return bytes(self._buf[:n])
+
+    def flush(self) -> bytes:
+        key = ctypes.c_int(0)
+        n = self._lib.tr_h264_encode(
+            self._enc, None, -1, _u8p(self._buf), self._buf.size, ctypes.byref(key)
+        )
+        return bytes(self._buf[:n]) if n > 0 else b""
+
+    def close(self):
+        if self._enc:
+            self._lib.tr_h264_encoder_destroy(self._enc)
+            self._enc = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class H264Decoder:
+    def __init__(self, max_width: int = 4096, max_height: int = 2304):
+        lib = native.load()
+        if lib is None or not lib.tr_h264_available():
+            raise RuntimeError("native H.264 not available (libavcodec 5.x required)")
+        self._lib = lib
+        self._dec = lib.tr_h264_decoder_create()
+        if not self._dec:
+            raise RuntimeError("failed to open H.264 decoder")
+        self._buf = np.empty(max_width * max_height * 3, np.uint8)
+
+    def decode(self, au: bytes, pts: int = 0):
+        """annex-B access unit -> [H,W,3] uint8 ndarray or None (buffering)."""
+        data = np.frombuffer(au, np.uint8)
+        w = ctypes.c_int(0)
+        h = ctypes.c_int(0)
+        opts = ctypes.c_int64(0)
+        n = self._lib.tr_h264_decode(
+            self._dec, _u8p(data), data.size, pts, _u8p(self._buf), self._buf.size,
+            ctypes.byref(w), ctypes.byref(h), ctypes.byref(opts),
+        )
+        if n < 0:
+            raise RuntimeError(f"decode failed: {n}")
+        if n == 0:
+            return None
+        frame = self._buf[:n].reshape(h.value, w.value, 3).copy()
+        return frame, opts.value
+
+    def flush(self):
+        return self.decode(b"", 0)
+
+    def close(self):
+        if self._dec:
+            self._lib.tr_h264_decoder_destroy(self._dec)
+            self._dec = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NullCodec:
+    """Raw passthrough codec (hermetic fallback + tests): frame <-> bytes."""
+
+    MAGIC = b"TRAW"
+
+    @staticmethod
+    def encode(rgb: np.ndarray, pts: int = 0) -> bytes:
+        h, w, _ = rgb.shape
+        return NullCodec.MAGIC + struct.pack("<HHq", w, h, pts) + rgb.tobytes()
+
+    @staticmethod
+    def decode(data: bytes):
+        if data[:4] != NullCodec.MAGIC:
+            raise ValueError("not a NullCodec frame")
+        w, h, pts = struct.unpack("<HHq", data[4:16])
+        arr = np.frombuffer(data[16:], np.uint8).reshape(h, w, 3)
+        return arr, pts
+
+
+def make_encoder(width: int, height: int, fps: int = 30):
+    """HW_ENCODE -> native H.264, else NullCodec (mirrors reference NVENC
+    branch at lib/pipeline.py:83)."""
+    if env.hw_encode() and native.h264_available():
+        return H264Encoder(width, height, fps)
+    return None
+
+
+def make_decoder():
+    if env.hw_decode() and native.h264_available():
+        return H264Decoder()
+    return None

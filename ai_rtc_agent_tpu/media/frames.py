@@ -1,0 +1,49 @@
+"""VideoFrame: the duck-typed frame contract of the media plane.
+
+The reference passes ``av.VideoFrame`` (software path) or CUDA tensors
+(NVDEC path) through a documented duck-type contract (reference
+lib/tracks.py:34-37, lib/pipeline.py:50-58).  PyAV is not a dependency here;
+this class IS the contract: ``to_ndarray(format="rgb24")``, ``pts``,
+``time_base`` — so real av.VideoFrame objects interoperate transparently
+when PyAV is installed, and the test suite can fabricate frames hermetically.
+
+The TPU-native "hardware path" analog is a bare [H,W,3] uint8 ndarray headed
+for the pinned host<->HBM ring (media/ring.py) — the counterpart of the
+reference's CUDA-tensor NVDEC frames.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from fractions import Fraction
+
+import numpy as np
+
+
+@dataclass
+class VideoFrame:
+    _rgb: np.ndarray  # [H,W,3] uint8
+    pts: int | None = None
+    time_base: Fraction | None = None
+
+    @classmethod
+    def from_ndarray(cls, arr: np.ndarray, format: str = "rgb24") -> "VideoFrame":
+        if format != "rgb24":
+            raise ValueError(f"unsupported format: {format}")
+        arr = np.ascontiguousarray(arr, dtype=np.uint8)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            raise ValueError(f"expected HxWx3, got {arr.shape}")
+        return cls(_rgb=arr)
+
+    def to_ndarray(self, format: str = "rgb24") -> np.ndarray:
+        if format != "rgb24":
+            raise ValueError(f"unsupported format: {format}")
+        return self._rgb
+
+    @property
+    def width(self) -> int:
+        return self._rgb.shape[1]
+
+    @property
+    def height(self) -> int:
+        return self._rgb.shape[0]

@@ -1,0 +1,104 @@
+"""ctypes bindings to the native media runtime (native/libtpurtc.so).
+
+Auto-builds the library with make on first use when a toolchain is present
+(the library itself has zero build-time deps; libavcodec is dlopen'd at
+runtime).  All consumers must handle ``None`` returns from the loaders and
+fall back to pure-python paths (media/codec.py NullCodec, media/rtp.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpurtc.so"))
+
+_lib = None
+_lib_tried = False
+
+
+def load() -> ctypes.CDLL | None:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError) as e:
+            logger.warning("native build failed (%s); using python fallbacks", e)
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:
+        logger.warning("cannot load %s (%s)", _LIB_PATH, e)
+        return None
+    _declare(lib)
+    _lib = lib
+    return lib
+
+
+def _declare(lib: ctypes.CDLL):
+    c = ctypes
+    u8p = c.POINTER(c.c_uint8)
+
+    lib.tr_ring_create.restype = c.c_void_p
+    lib.tr_ring_create.argtypes = [c.c_size_t, c.c_size_t]
+    lib.tr_ring_destroy.argtypes = [c.c_void_p]
+    lib.tr_ring_try_push.restype = c.c_int
+    lib.tr_ring_try_push.argtypes = [c.c_void_p, u8p, c.c_int64, c.c_int64]
+    lib.tr_ring_push_latest.restype = c.c_int
+    lib.tr_ring_push_latest.argtypes = [c.c_void_p, u8p, c.c_int64, c.c_int64]
+    lib.tr_ring_try_pop.restype = c.c_int64
+    lib.tr_ring_try_pop.argtypes = [c.c_void_p, u8p, c.c_int64, c.POINTER(c.c_int64)]
+    lib.tr_ring_size.restype = c.c_int64
+    lib.tr_ring_size.argtypes = [c.c_void_p]
+    lib.tr_ring_dropped.restype = c.c_int64
+    lib.tr_ring_dropped.argtypes = [c.c_void_p]
+
+    lib.tr_rtp_packetizer_create.restype = c.c_void_p
+    lib.tr_rtp_packetizer_create.argtypes = [c.c_uint32, c.c_uint8, c.c_int32]
+    lib.tr_rtp_packetizer_destroy.argtypes = [c.c_void_p]
+    lib.tr_rtp_packetize.restype = c.c_int64
+    lib.tr_rtp_packetize.argtypes = [
+        c.c_void_p, u8p, c.c_int64, c.c_uint32, u8p, c.c_int64,
+    ]
+    lib.tr_rtp_depacketizer_create.restype = c.c_void_p
+    lib.tr_rtp_depacketizer_destroy.argtypes = [c.c_void_p]
+    lib.tr_rtp_depacketize.restype = c.c_int
+    lib.tr_rtp_depacketize.argtypes = [c.c_void_p, u8p, c.c_int64]
+    lib.tr_rtp_get_au.restype = c.c_int64
+    lib.tr_rtp_get_au.argtypes = [c.c_void_p, u8p, c.c_int64, c.POINTER(c.c_uint32)]
+
+    lib.tr_h264_available.restype = c.c_int
+    lib.tr_h264_encoder_create.restype = c.c_void_p
+    lib.tr_h264_encoder_create.argtypes = [
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int64, c.c_int, c.c_char_p, c.c_char_p,
+    ]
+    lib.tr_h264_encode.restype = c.c_int64
+    lib.tr_h264_encode.argtypes = [
+        c.c_void_p, u8p, c.c_int64, u8p, c.c_int64, c.POINTER(c.c_int),
+    ]
+    lib.tr_h264_encoder_destroy.argtypes = [c.c_void_p]
+    lib.tr_h264_decoder_create.restype = c.c_void_p
+    lib.tr_h264_decode.restype = c.c_int64
+    lib.tr_h264_decode.argtypes = [
+        c.c_void_p, u8p, c.c_int64, c.c_int64, u8p, c.c_int64,
+        c.POINTER(c.c_int), c.POINTER(c.c_int), c.POINTER(c.c_int64),
+    ]
+    lib.tr_h264_decoder_destroy.argtypes = [c.c_void_p]
+
+
+def h264_available() -> bool:
+    lib = load()
+    return bool(lib and lib.tr_h264_available())

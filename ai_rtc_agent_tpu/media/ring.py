@@ -1,0 +1,131 @@
+"""Host<->HBM frame ring: the TPU analog of NVDEC/NVENC zero-copy.
+
+The reference keeps pixels in GPU memory end-to-end via CUDA tensors
+(reference README.md:11-15, lib/tracks.py:34-37).  A TPU has no on-chip
+codec, so the design target becomes: make the ONE unavoidable host<->HBM hop
+per direction cheap and fully overlapped:
+
+* frames move as uint8 (3 bytes/px — the smallest possible wire format;
+  float conversion happens in-graph, ops/image.py);
+* the native SPSC ring (native/frame_ring.cpp) hands the feeder thread
+  page-aligned slots, so jax can DMA without an intermediate copy;
+* ``device_put`` of frame N+1 is issued while frame N is still computing
+  (async dispatch) — transfer rides under compute;
+* the stream step donates its state, so the latent ring buffer never leaves
+  HBM (stream/engine.py).
+
+``DeviceFeeder`` wraps the pattern; the loopback/e2e tests measure that the
+device never waits for a frame that was pushed in time.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+
+import numpy as np
+
+from . import native
+
+logger = logging.getLogger(__name__)
+
+
+class FrameRing:
+    """numpy-facing wrapper over the native SPSC ring (python fallback when
+    the native lib is unavailable)."""
+
+    def __init__(self, frame_shape, n_slots: int = 4):
+        self.frame_shape = tuple(frame_shape)
+        self.slot_bytes = int(np.prod(self.frame_shape))
+        self._lib = native.load()
+        if self._lib is not None:
+            self._ring = self._lib.tr_ring_create(self.slot_bytes, n_slots)
+        else:
+            self._ring = None
+            self._q: list = []
+            self._lock = threading.Lock()
+            self._n = n_slots
+            self._dropped = 0
+
+    def push_latest(self, frame: np.ndarray, meta: int = 0) -> bool:
+        frame = np.ascontiguousarray(frame, np.uint8)
+        if self._ring:
+            p = frame.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            return bool(
+                self._lib.tr_ring_push_latest(self._ring, p, frame.nbytes, meta)
+            )
+        with self._lock:
+            if len(self._q) >= self._n:
+                self._q.pop(0)
+                self._dropped += 1
+            self._q.append((frame.copy(), meta))
+        return True
+
+    def pop(self):
+        """-> (frame [*shape] uint8, meta) or None."""
+        if self._ring:
+            out = np.empty(self.slot_bytes, np.uint8)
+            meta = ctypes.c_int64(0)
+            n = self._lib.tr_ring_try_pop(
+                self._ring,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                out.size,
+                ctypes.byref(meta),
+            )
+            if n < 0:
+                return None
+            return out[:n].reshape(self.frame_shape), meta.value
+        with self._lock:
+            if not self._q:
+                return None
+            return self._q.pop(0)
+
+    @property
+    def size(self) -> int:
+        if self._ring:
+            return int(self._lib.tr_ring_size(self._ring))
+        return len(self._q)
+
+    @property
+    def dropped(self) -> int:
+        if self._ring:
+            return int(self._lib.tr_ring_dropped(self._ring))
+        return self._dropped
+
+    def close(self):
+        if self._ring:
+            self._lib.tr_ring_destroy(self._ring)
+            self._ring = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class DeviceFeeder:
+    """Double-buffered host->HBM staging: device_put the NEXT frame while the
+    CURRENT one computes (async dispatch overlap)."""
+
+    def __init__(self, device=None):
+        import jax
+
+        self._device = device or jax.devices()[0]
+        self._inflight = None
+        self._inflight_meta = None
+
+    def stage(self, frame: np.ndarray, meta=None):
+        """Start the host->HBM transfer (non-blocking)."""
+        import jax
+
+        self._inflight = jax.device_put(frame, self._device)
+        self._inflight_meta = meta
+
+    def take(self):
+        """-> (device_array, meta) of the staged frame (transfer may still be
+        in flight — jax dispatch orders it before any consumer op)."""
+        x, m = self._inflight, self._inflight_meta
+        self._inflight = None
+        return x, m

@@ -1,0 +1,96 @@
+"""RTP packetization wrappers (native RFC 6184 implementation).
+
+Python-facing API over native/rtp.cpp; the reference gets this from the
+aiortc fork's RTP stack (SURVEY.md L3).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import native
+
+MAX_AU = 1 << 22  # 4 MiB access-unit bound
+
+
+class RtpPacketizer:
+    def __init__(self, ssrc: int = 0x1234, payload_type: int = 96, mtu: int = 1200):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native media runtime unavailable")
+        self._p = self._lib.tr_rtp_packetizer_create(ssrc, payload_type, mtu)
+        self._buf = np.empty(MAX_AU, np.uint8)
+
+    def packetize(self, access_unit: bytes, timestamp: int) -> list[bytes]:
+        data = np.frombuffer(access_unit, np.uint8)
+        n = self._lib.tr_rtp_packetize(
+            self._p,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            data.size,
+            timestamp & 0xFFFFFFFF,
+            self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._buf.size,
+        )
+        if n < 0:
+            raise RuntimeError("packetize overflow")
+        out, off = [], 0
+        raw = self._buf[:n].tobytes()
+        while off < n:
+            ln = int.from_bytes(raw[off : off + 4], "big")
+            off += 4
+            out.append(raw[off : off + ln])
+            off += ln
+        return out
+
+    def close(self):
+        if self._p:
+            self._lib.tr_rtp_packetizer_destroy(self._p)
+            self._p = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RtpDepacketizer:
+    def __init__(self):
+        self._lib = native.load()
+        if self._lib is None:
+            raise RuntimeError("native media runtime unavailable")
+        self._d = self._lib.tr_rtp_depacketizer_create()
+        self._buf = np.empty(MAX_AU, np.uint8)
+
+    def push(self, packet: bytes):
+        """Feed one RTP packet; returns a completed (annex-B AU, timestamp)
+        or None."""
+        data = np.frombuffer(packet, np.uint8)
+        ready = self._lib.tr_rtp_depacketize(
+            self._d, data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), data.size
+        )
+        if not ready:
+            return None
+        ts = ctypes.c_uint32(0)
+        n = self._lib.tr_rtp_get_au(
+            self._d,
+            self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._buf.size,
+            ctypes.byref(ts),
+        )
+        if n < 0:
+            return None
+        return self._buf[:n].tobytes(), ts.value
+
+    def close(self):
+        if self._d:
+            self._lib.tr_rtp_depacketizer_destroy(self._d)
+            self._d = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,1 @@
+from . import layers, taesd, clip, unet, controlnet, lora, loader  # noqa: F401

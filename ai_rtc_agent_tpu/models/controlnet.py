@@ -1,0 +1,170 @@
+"""ControlNet — conditioned-generation branch of the UNet.
+
+TPU-native replacement for ``diffusers.ControlNetModel`` + the GPU HED
+annotator which the reference wires in at lib/wrapper.py:617-643 (engine
+variant :870-877).  A ControlNet is the UNet's encoder half with (a) a small
+conv stack embedding the conditioning image into latent space and (b)
+zero-initialized 1x1 "zero convs" on every skip output, so an untrained
+ControlNet is an exact no-op on the base UNet.
+
+The conditioning annotator here is in-graph Canny (BASELINE.json's tracked
+config is ControlNet-canny; the reference's HED detector is a CUDA-only
+external) — see :func:`canny_soft`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .layers import conv2d, init_conv, silu
+from .unet import (
+    UNetConfig,
+    _resnet,
+    _transformer,
+    init_unet,
+    time_cond_embedding,
+)
+
+
+def init_controlnet(key, cfg: UNetConfig, cond_channels: int = 3):
+    """Params: encoder half of the UNet + cond embedding + zero convs."""
+    k_unet, k_cond, k_zero = jax.random.split(key, 3)
+    unet_p = init_unet(k_unet, cfg)
+    p = {
+        "conv_in": unet_p["conv_in"],
+        "time_embedding": unet_p["time_embedding"],
+        "down_blocks": unet_p["down_blocks"],
+        "mid_block": unet_p["mid_block"],
+    }
+    if "add_embedding" in unet_p:
+        p["add_embedding"] = unet_p["add_embedding"]
+
+    # conditioning embedding: 3 -> 16 -> 32 -> 96 -> ch0 with 2x downsamples
+    # to latent resolution (8x), zero-init final conv
+    ch0 = cfg.block_out_channels[0]
+    widths = (16, 32, 96)
+    ks = jax.random.split(k_cond, len(widths) * 2 + 2)
+    cond = {"conv_in": init_conv(ks[0], cond_channels, widths[0], 3), "blocks": []}
+    w_in = widths[0]
+    for i, w_out in enumerate(widths):
+        nxt = widths[i + 1] if i + 1 < len(widths) else ch0
+        cond["blocks"].append(
+            {
+                "conv1": init_conv(ks[1 + 2 * i], w_in, w_out, 3),
+                "conv2": init_conv(ks[2 + 2 * i], w_out, nxt, 3),  # stride 2
+            }
+        )
+        w_in = nxt
+    cond["conv_out"] = {
+        "kernel": jnp.zeros((3, 3, ch0, ch0)),
+        "bias": jnp.zeros((ch0,)),
+    }
+    p["cond_embedding"] = cond
+
+    # zero convs: one per skip output + one for mid
+    n_skips = 1  # conv_in skip
+    nb = len(cfg.block_out_channels)
+    for i in range(nb):
+        n_skips += cfg.layers_per_block + (1 if i < nb - 1 else 0)
+    chs = _skip_channels(cfg)
+    assert len(chs) == n_skips
+    p["zero_convs"] = [
+        {"kernel": jnp.zeros((1, 1, c, c)), "bias": jnp.zeros((c,))} for c in chs
+    ]
+    p["mid_zero_conv"] = {
+        "kernel": jnp.zeros((1, 1, cfg.block_out_channels[-1], cfg.block_out_channels[-1])),
+        "bias": jnp.zeros((cfg.block_out_channels[-1],)),
+    }
+    return p
+
+
+def _skip_channels(cfg: UNetConfig):
+    chs = [cfg.block_out_channels[0]]
+    out = cfg.block_out_channels[0]
+    nb = len(cfg.block_out_channels)
+    for i, ch in enumerate(cfg.block_out_channels):
+        out = ch
+        chs.extend([out] * cfg.layers_per_block)
+        if i < nb - 1:
+            chs.append(out)
+    return chs
+
+
+def apply_controlnet(
+    p,
+    x,
+    timesteps,
+    context,
+    cond_image,
+    cfg: UNetConfig,
+    added_cond=None,
+    conditioning_scale: float = 1.0,
+    attn_impl: str = "xla",
+):
+    """Returns (down_residuals list, mid_residual) for apply_unet.
+
+    ``cond_image``: [B,H,W,3] in [0,1] at IMAGE resolution (8x the latent).
+    """
+    temb = time_cond_embedding(p, cfg, timesteps, added_cond, dtype=x.dtype)
+    context = context.astype(x.dtype)
+
+    # embed conditioning image to latent resolution and add to conv_in output
+    c = conv2d(p["cond_embedding"]["conv_in"], cond_image.astype(x.dtype))
+    c = silu(c)
+    for blk in p["cond_embedding"]["blocks"]:
+        c = silu(conv2d(blk["conv1"], c))
+        c = silu(conv2d(blk["conv2"], c, stride=2))
+    c = conv2d(p["cond_embedding"]["conv_out"], c)
+
+    h = conv2d(p["conv_in"], x) + c
+    outs = [h]
+    for i, blk in enumerate(p["down_blocks"]):
+        for j, rn in enumerate(blk["resnets"]):
+            h = _resnet(rn, h, temb, cfg.norm_groups)
+            if blk["attentions"]:
+                h = _transformer(
+                    blk["attentions"][j], h, context, cfg, cfg.num_heads_per_block[i], attn_impl
+                )
+            outs.append(h)
+        if blk["downsample"] is not None:
+            h = conv2d(blk["downsample"], h, stride=2)
+            outs.append(h)
+
+    mb = p["mid_block"]
+    h = _resnet(mb["resnet1"], h, temb, cfg.norm_groups)
+    h = _transformer(mb["attention"], h, context, cfg, cfg.num_heads_per_block[-1], attn_impl)
+    h = _resnet(mb["resnet2"], h, temb, cfg.norm_groups)
+
+    scale = jnp.asarray(conditioning_scale, dtype=x.dtype)
+    down_res = [conv2d(zc, o) * scale for zc, o in zip(p["zero_convs"], outs)]
+    mid_res = conv2d(p["mid_zero_conv"], h) * scale
+    return down_res, mid_res
+
+
+def canny_soft(img_nhwc, low: float = 0.1, high: float = 0.3):
+    """Differentiable soft-Canny edge map, in-graph annotator.
+
+    Replaces the reference's HED CUDA annotator (lib/wrapper.py:39-40,
+    518-519) with the canny conditioning BASELINE.json tracks: Sobel gradient
+    magnitude on luma with a smooth double-threshold, returned as 3-channel
+    [0,1] NHWC so it feeds apply_controlnet directly.
+    """
+    luma = (
+        0.299 * img_nhwc[..., 0] + 0.587 * img_nhwc[..., 1] + 0.114 * img_nhwc[..., 2]
+    )[..., None]
+    kx = jnp.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], img_nhwc.dtype) / 4.0
+    ky = kx.T
+    def conv1(img, k):
+        return jax.lax.conv_general_dilated(
+            img,
+            k[:, :, None, None],
+            (1, 1),
+            "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    gx = conv1(luma, kx)
+    gy = conv1(luma, ky)
+    mag = jnp.sqrt(gx * gx + gy * gy + 1e-12)
+    edge = jax.nn.sigmoid((mag - low) / jnp.maximum(high - low, 1e-6) * 12.0 - 6.0)
+    return jnp.repeat(edge, 3, axis=-1)

@@ -1,0 +1,212 @@
+"""Foundational pure-function layers for the param-pytree model zoo.
+
+Design (TPU-first, replaces the diffusers/torch module classes the reference
+leans on at lib/wrapper.py:12-17):
+
+* A "module" is a pair of plain functions: ``init_*(key, cfg) -> params`` and
+  ``apply(params, x, ...) -> y``.  Params are nested dicts of jnp arrays —
+  a pytree that jit/pjit/shard_map/optax all consume natively, and that maps
+  1:1 onto HF safetensors key paths (see models/loader.py).
+* Layout is NHWC everywhere; conv kernels are HWIO (see ops/image.py for the
+  rationale).  Matmul-heavy ops keep the contracted dimension minor so XLA
+  tiles them straight onto the MXU.
+* Compute dtype follows the activation dtype; params are cast at use (XLA
+  fuses the casts).  Normalization statistics are always fp32 for bf16
+  stability.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# initializers
+# --------------------------------------------------------------------------
+
+def _fan_in_normal(key, shape, fan_in, scale=1.0, dtype=jnp.float32):
+    std = scale / math.sqrt(max(fan_in, 1))
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def init_linear(key, in_dim: int, out_dim: int, bias: bool = True, scale: float = 1.0):
+    kw, _ = jax.random.split(key)
+    p = {"kernel": _fan_in_normal(kw, (in_dim, out_dim), in_dim, scale)}
+    if bias:
+        p["bias"] = jnp.zeros((out_dim,), jnp.float32)
+    return p
+
+
+def init_conv(key, in_ch: int, out_ch: int, k: int = 3, bias: bool = True, scale: float = 1.0):
+    kw, _ = jax.random.split(key)
+    p = {"kernel": _fan_in_normal(kw, (k, k, in_ch, out_ch), in_ch * k * k, scale)}
+    if bias:
+        p["bias"] = jnp.zeros((out_ch,), jnp.float32)
+    return p
+
+
+def init_norm(ch: int):
+    return {"scale": jnp.ones((ch,), jnp.float32), "bias": jnp.zeros((ch,), jnp.float32)}
+
+
+def zeros_like_params(params):
+    """Zero-init a param pytree (ControlNet zero-convs, LoRA B matrices)."""
+    return jax.tree.map(jnp.zeros_like, params)
+
+
+# --------------------------------------------------------------------------
+# primitives
+# --------------------------------------------------------------------------
+
+def linear(p, x):
+    w = p["kernel"].astype(x.dtype)
+    y = x @ w
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def conv2d(p, x, stride: int = 1, padding="SAME"):
+    """NHWC conv, HWIO kernel."""
+    w = p["kernel"].astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def group_norm(p, x, groups: int = 32, eps: float = 1e-5):
+    """GroupNorm over NHWC (stats in fp32)."""
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(n, h * w, g, c // g)
+    mean = xf.mean(axis=(1, 3), keepdims=True)
+    var = xf.var(axis=(1, 3), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(n, h, w, c)
+    return (xf * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def layer_norm(p, x, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def quick_gelu(x):
+    """CLIP ViT-L activation: x * sigmoid(1.702 x)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+ACTIVATIONS = {"silu": silu, "gelu": gelu, "quick_gelu": quick_gelu}
+
+
+def timestep_embedding(timesteps, dim: int, max_period: int = 10000, dtype=jnp.float32):
+    """Sinusoidal timestep embedding [B] -> [B, dim] (diffusers convention:
+    flip_sin_to_cos=True, downscale_freq_shift=0, i.e. [cos | sin])."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half
+    )
+    args = jnp.asarray(timesteps, jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb.astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# attention
+# --------------------------------------------------------------------------
+
+def init_attention(key, query_dim: int, context_dim: int | None, heads: int, head_dim: int):
+    context_dim = context_dim or query_dim
+    inner = heads * head_dim
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "to_q": init_linear(k1, query_dim, inner, bias=False),
+        "to_k": init_linear(k2, context_dim, inner, bias=False),
+        "to_v": init_linear(k3, context_dim, inner, bias=False),
+        "to_out": init_linear(k4, inner, query_dim),
+    }
+
+
+def attention(p, x, context=None, heads: int = 8, mask=None, attn_impl: str = "xla"):
+    """Multi-head attention. x: [B, Lq, D], context: [B, Lk, Dc] or None.
+
+    ``attn_impl``: "xla" (einsum softmax, XLA-fused) or "pallas" (flash
+    kernel from ops/pallas, used for long token counts on real TPUs).
+    """
+    context = x if context is None else context
+    q = linear(p["to_q"], x)
+    k = linear(p["to_k"], context)
+    v = linear(p["to_v"], context)
+    b, lq, inner = q.shape
+    hd = inner // heads
+    q = q.reshape(b, lq, heads, hd)
+    k = k.reshape(b, context.shape[1], heads, hd)
+    v = v.reshape(b, context.shape[1], heads, hd)
+
+    if attn_impl == "pallas":
+        from ..ops.pallas import attention as pattn  # lazy; TPU paths only
+
+        o = pattn.flash_attention(q, k, v, mask=mask)
+    else:
+        o = _sdpa_xla(q, k, v, mask)
+    o = o.reshape(b, lq, inner)
+    return linear(p["to_out"], o)
+
+
+def _sdpa_xla(q, k, v, mask=None):
+    """[B,L,H,Dh] scaled dot-product attention with fp32 softmax."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask
+    w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def causal_mask(length: int, dtype=jnp.float32):
+    """[1,1,L,L] additive causal mask (large negative above diagonal)."""
+    m = jnp.tril(jnp.ones((length, length), bool))
+    return jnp.where(m, 0.0, -1e9).astype(dtype)[None, None]
+
+
+# --------------------------------------------------------------------------
+# feed-forward (GEGLU, the diffusers transformer FF)
+# --------------------------------------------------------------------------
+
+def init_geglu_ff(key, dim: int, mult: int = 4):
+    k1, k2 = jax.random.split(key)
+    return {
+        "proj": init_linear(k1, dim, dim * mult * 2),
+        "out": init_linear(k2, dim * mult, dim),
+    }
+
+
+def geglu_ff(p, x):
+    h = linear(p["proj"], x)
+    a, g = jnp.split(h, 2, axis=-1)
+    return linear(p["out"], a * gelu(g))

@@ -1,0 +1,132 @@
+"""TAESD (tiny autoencoder) — the TinyVAE of the stream pipeline.
+
+TPU-native replacement for ``diffusers.AutoencoderTiny`` which the reference
+swaps in with ``use_tiny_vae=True`` (reference lib/wrapper.py:699-707, TRT
+engine shells at :445-466).  Architecture follows the public TAESD design
+(madebyollin/taesd): 4x down/up, width 64, residual conv blocks, latent
+channels 4.  NHWC + HWIO layout throughout.
+
+Contract (differs from diffusers' [-1,1] wrapper, documented deliberately):
+  encode: RGB [N,H,W,3] in [0,1]  ->  latents [N,H/8,W/8,4], already in SD's
+          *scaled* latent space (TAESD emits scaled latents; scaling_factor
+          is 1.0, vs 0.18215 for the full KL VAE).
+  decode: latents [N,h,w,4] -> RGB [N,8h,8w,3] in [0,1] (clamped).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .layers import conv2d, init_conv
+
+
+@dataclass(frozen=True)
+class TAESDConfig:
+    width: int = 64
+    latent_channels: int = 4
+    image_channels: int = 3
+    num_stages: int = 3          # number of 2x down/up stages after the stem
+    blocks_per_stage: int = 3
+    # tiny configs for CPU tests
+    @staticmethod
+    def tiny() -> "TAESDConfig":
+        return TAESDConfig(width=8, num_stages=2, blocks_per_stage=1)
+
+
+def _init_block(key, ch: int):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "conv1": init_conv(k1, ch, ch, 3),
+        "conv2": init_conv(k2, ch, ch, 3),
+        "conv3": init_conv(k3, ch, ch, 3),
+    }
+
+
+def _block(p, x):
+    """Residual block: relu(f(x) + x), f = conv-relu-conv-relu-conv."""
+    h = jax.nn.relu(conv2d(p["conv1"], x))
+    h = jax.nn.relu(conv2d(p["conv2"], h))
+    h = conv2d(p["conv3"], h)
+    return jax.nn.relu(h + x)
+
+
+def init_encoder(key, cfg: TAESDConfig):
+    """Mirrors TAESD exactly: stem conv + 1 block, then per stage a strided
+    (bias-free) down conv followed by `blocks_per_stage` residual blocks."""
+    w = cfg.width
+    keys = jax.random.split(key, 3 + cfg.num_stages * (1 + cfg.blocks_per_stage))
+    ki = iter(keys)
+    p = {
+        "conv_in": init_conv(next(ki), cfg.image_channels, w, 3),
+        "block_in": _init_block(next(ki), w),
+        "stages": [],
+    }
+    for _ in range(cfg.num_stages):
+        stage = {
+            "down": init_conv(next(ki), w, w, 3, bias=False),
+            "blocks": [_init_block(next(ki), w) for _ in range(cfg.blocks_per_stage)],
+        }
+        p["stages"].append(stage)
+    p["conv_out"] = init_conv(next(ki), w, cfg.latent_channels, 3)
+    return p
+
+
+def encode(p, x, cfg: TAESDConfig = TAESDConfig()):
+    """RGB [N,H,W,3] in [0,1] -> latents [N,H/2^s,W/2^s,4]."""
+    h = conv2d(p["conv_in"], x)
+    h = _block(p["block_in"], h)
+    for stage in p["stages"]:
+        h = conv2d(stage["down"], h, stride=2)
+        h = _block_list(stage["blocks"], h)
+    return conv2d(p["conv_out"], h)
+
+
+def init_decoder(key, cfg: TAESDConfig):
+    w = cfg.width
+    keys = jax.random.split(key, 2 + cfg.num_stages * (1 + cfg.blocks_per_stage) + 2)
+    ki = iter(keys)
+    p = {"conv_in": init_conv(next(ki), cfg.latent_channels, w, 3), "stages": []}
+    for _ in range(cfg.num_stages):
+        stage = {
+            "blocks": [_init_block(next(ki), w) for _ in range(cfg.blocks_per_stage)],
+            "up": init_conv(next(ki), w, w, 3, bias=False),
+        }
+        p["stages"].append(stage)
+    p["block_out"] = _init_block(next(ki), w)
+    p["conv_out"] = init_conv(next(ki), w, cfg.image_channels, 3)
+    return p
+
+
+def decode(p, z, cfg: TAESDConfig = TAESDConfig()):
+    """latents [N,h,w,4] -> RGB [N,h*2^s,w*2^s,3] in [0,1]."""
+    # TAESD's input clamp: tanh(z/3)*3 bounds extreme latents smoothly
+    z = jnp.tanh(z / 3.0) * 3.0
+    h = jax.nn.relu(conv2d(p["conv_in"], z))
+    for stage in p["stages"]:
+        h = _block_list(stage["blocks"], h)
+        h = _upsample2x(h)
+        h = conv2d(stage["up"], h)
+    h = _block(p["block_out"], h)
+    x = conv2d(p["conv_out"], h)
+    return jnp.clip(x, 0.0, 1.0)
+
+
+def _block_list(blocks, h):
+    for b in blocks:
+        h = _block(b, h)
+    return h
+
+
+def _upsample2x(x):
+    n, h, w, c = x.shape
+    x = x[:, :, None, :, None, :]
+    x = jnp.broadcast_to(x, (n, h, 2, w, 2, c))
+    return x.reshape(n, h * 2, w * 2, c)
+
+
+def init_taesd(key, cfg: TAESDConfig = TAESDConfig()):
+    ke, kd = jax.random.split(key)
+    return {"encoder": init_encoder(ke, cfg), "decoder": init_decoder(kd, cfg)}

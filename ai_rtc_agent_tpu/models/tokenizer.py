@@ -1,0 +1,137 @@
+"""CLIP tokenizer — self-contained BPE with a hermetic fallback.
+
+Replaces ``transformers.CLIPTokenizer`` (reference lib/wrapper.py:471-473).
+Two modes:
+
+* :class:`CLIPBPETokenizer` — a from-scratch CLIP byte-pair encoder reading
+  the standard ``vocab.json`` + ``merges.txt`` files from a local HF
+  snapshot (no network, no transformers import needed).
+* :class:`HashTokenizer` — deterministic hermetic fallback for tests and
+  random-weight serving: token = stable hash of the word into the vocab
+  range.  Keeps every downstream shape/contract identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from functools import lru_cache
+
+BOS = 49406
+EOS = 49407
+
+
+class HashTokenizer:
+    def __init__(self, vocab_size: int = 49408, max_length: int = 77):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.bos = vocab_size - 2
+        self.eos = vocab_size - 1
+
+    def __call__(self, text: str, max_length: int | None = None) -> list[int]:
+        n = max_length or self.max_length
+        ids = [self.bos]
+        for w in re.findall(r"\w+", text.lower()):
+            h = 0
+            for ch in w:
+                h = (h * 131 + ord(ch)) % (self.vocab_size - 2)
+            ids.append(h)
+        ids = ids[: n - 1] + [self.eos]
+        ids += [self.eos] * (n - len(ids))
+        return ids
+
+
+@lru_cache()
+def _bytes_to_unicode():
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+class CLIPBPETokenizer:
+    """Standard CLIP BPE (lowercase + </w> word-end marker)."""
+
+    _pat = re.compile(
+        r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+"
+        if False
+        else r"'s|'t|'re|'ve|'m|'ll|'d|[a-zA-Z]+|[0-9]|[^\sa-zA-Z0-9]+",
+        re.IGNORECASE,
+    )
+
+    def __init__(self, vocab_path: str, merges_path: str, max_length: int = 77):
+        with open(vocab_path) as f:
+            self.encoder: dict[str, int] = json.load(f)
+        with open(merges_path, encoding="utf-8") as f:
+            merges = f.read().split("\n")
+        # first line may be a version header
+        if merges and merges[0].startswith("#"):
+            merges = merges[1:]
+        pairs = [tuple(m.split()) for m in merges if m and len(m.split()) == 2]
+        self.bpe_ranks = {p: i for i, p in enumerate(pairs)}
+        self.byte_encoder = _bytes_to_unicode()
+        self.max_length = max_length
+        self.bos = self.encoder.get("<|startoftext|>", BOS)
+        self.eos = self.encoder.get("<|endoftext|>", EOS)
+        self._cache: dict[str, list[str]] = {}
+
+    def _bpe(self, token: str) -> list[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = list(token[:-1]) + [token[-1] + "</w>"]
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, 1 << 30))
+            if best not in self.bpe_ranks:
+                break
+            first, second = best
+            new_word: list[str] = []
+            i = 0
+            while i < len(word):
+                if (
+                    i < len(word) - 1
+                    and word[i] == first
+                    and word[i + 1] == second
+                ):
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = new_word
+        self._cache[token] = word
+        return word
+
+    def __call__(self, text: str, max_length: int | None = None) -> list[int]:
+        n = max_length or self.max_length
+        text = re.sub(r"\s+", " ", text.lower()).strip()
+        ids = [self.bos]
+        for tok in self._pat.findall(text):
+            tok = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            for piece in self._bpe(tok):
+                tid = self.encoder.get(piece)
+                if tid is not None:
+                    ids.append(tid)
+        ids = ids[: n - 1] + [self.eos]
+        ids += [self.eos] * (n - len(ids))
+        return ids
+
+
+def find_clip_tokenizer(model_dir: str, max_length: int = 77):
+    """Locate vocab.json/merges.txt under an HF snapshot; fall back to hash."""
+    for sub in ("tokenizer", "tokenizer_2", "."):
+        v = os.path.join(model_dir, sub, "vocab.json")
+        m = os.path.join(model_dir, sub, "merges.txt")
+        if os.path.exists(v) and os.path.exists(m):
+            return CLIPBPETokenizer(v, m, max_length)
+    return HashTokenizer(max_length=max_length)

@@ -1,13 +1,18 @@
-"""In-graph image pre/post-processing.
+"""In-graph image pre/post-processing — NHWC-native.
 
 TPU-native replacement for the CV-CUDA ops of the reference's frame pipeline
 (``cvcuda.convertto`` scale=1/255 + ``cvcuda.reformat`` NHWC->NCHW at
 reference lib/pipeline.py:50-67, and the ``(x*255).clamp(0,255).to(uint8)``
-postprocess at :72-74).  On TPU these are trivial XLA ops that fuse into the
-VAE prologue/epilogue, so they live INSIDE the jitted step — the frame
-crosses host<->device exactly once in each direction as uint8 (3 bytes/px),
-minimizing PCIe traffic (the reference ships fp16 tensors over NVLink; we
-ship uint8 over PCIe, 2.7x smaller than fp16 RGB).
+postprocess at :72-74).
+
+Deliberate departure from the reference: the reference reformats to NCHW
+because cuDNN prefers it (lib/pipeline.py:63).  TPU convolutions prefer NHWC
+(channels-last feeds the MXU's 128-lane minor dimension directly), so this
+framework is **NHWC end-to-end** — decoded frames arrive [H,W,3] uint8, every
+model in `models/` consumes/produces [N,H,W,C], and there is NO layout
+transpose anywhere in the hot path.  The uint8<->float conversions fuse into
+the TAESD prologue/epilogue under jit.  Frames cross host<->device exactly
+once each way as uint8 (3 bytes/px).
 """
 
 from __future__ import annotations
@@ -16,20 +21,20 @@ import jax.numpy as jnp
 
 
 def preprocess_uint8(frame_hwc_u8, dtype=jnp.float32):
-    """[H,W,3] (or [N,H,W,3]) uint8 RGB -> [N,3,H,W] float in [0,1]."""
+    """[H,W,3] (or [N,H,W,3]) uint8 RGB -> [N,H,W,3] float in [0,1]."""
     x = jnp.asarray(frame_hwc_u8)
     if x.ndim == 3:
         x = x[None]
-    x = x.astype(dtype) * (1.0 / 255.0)
-    return jnp.transpose(x, (0, 3, 1, 2))  # NHWC -> NCHW
+    return x.astype(dtype) * (1.0 / 255.0)
 
 
-def postprocess_uint8(img_nchw):
-    """[N,3,H,W] float in [0,1] -> [N,H,W,3] uint8 RGB (clamped)."""
-    x = jnp.transpose(img_nchw, (0, 2, 3, 1))
-    x = jnp.clip(x * 255.0, 0.0, 255.0)
-    # round-to-nearest matches the eye better than the reference's truncating
-    # .to(uint8) (lib/pipeline.py:74); documented deliberate improvement.
+def postprocess_uint8(img_nhwc):
+    """[N,H,W,3] float in [0,1] -> [N,H,W,3] uint8 RGB (clamped).
+
+    Round-to-nearest (the reference truncates via ``.to(uint8)``,
+    lib/pipeline.py:74 — rounding is a deliberate quality improvement).
+    """
+    x = jnp.clip(img_nhwc * 255.0, 0.0, 255.0)
     return jnp.round(x).astype(jnp.uint8)
 
 
@@ -43,31 +48,33 @@ def to_sym_range(x):
     return x * 2.0 - 1.0
 
 
-def resize_bilinear(img_nchw, height: int, width: int):
+def resize_bilinear(img_nhwc, height: int, width: int):
     """Bilinear resize (static target shape) for mismatched peer frames."""
-    n, c, h, w = img_nchw.shape
+    n, h, w, c = img_nhwc.shape
     if (h, w) == (height, width):
-        return img_nchw
+        return img_nhwc
     import jax
 
     return jax.image.resize(
-        img_nchw, (n, c, height, width), method="bilinear"
-    ).astype(img_nchw.dtype)
+        img_nhwc, (n, height, width, c), method="bilinear"
+    ).astype(img_nhwc.dtype)
 
 
-def similarity(a_nchw, b_nchw):
+def similarity(a_nhwc, b_nhwc):
     """Cheap frame-similarity score in [0,1] (1 = identical).
 
     In-graph replacement for the fork's stochastic similar-image filter
     (enabled at reference lib/wrapper.py:192-195): mean absolute difference
     on 8x-downsampled luma.  The caller turns this into a skip decision.
     """
+
     def luma_small(x):
-        y = 0.299 * x[:, 0] + 0.587 * x[:, 1] + 0.114 * x[:, 2]
+        y = 0.299 * x[..., 0] + 0.587 * x[..., 1] + 0.114 * x[..., 2]
         n, h, w = y.shape
-        hs, ws = max(h // 8, 1) * 8, max(w // 8, 1) * 8
-        y = y[:, :hs, :ws].reshape(n, hs // 8, 8, ws // 8, 8).mean(axis=(2, 4))
+        fh, fw = min(8, h), min(8, w)  # sub-8px frames: shrink the pool
+        hs, ws = (h // fh) * fh, (w // fw) * fw
+        y = y[:, :hs, :ws].reshape(n, hs // fh, fh, ws // fw, fw).mean(axis=(2, 4))
         return y
 
-    d = jnp.abs(luma_small(a_nchw) - luma_small(b_nchw)).mean(axis=(1, 2))
+    d = jnp.abs(luma_small(a_nhwc) - luma_small(b_nhwc)).mean(axis=(1, 2))
     return 1.0 - jnp.clip(d, 0.0, 1.0)

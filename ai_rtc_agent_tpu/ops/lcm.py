@@ -37,13 +37,20 @@ SIGMA_DATA = 0.5
 TIMESTEP_SCALING = 10.0
 
 
+def _boundary_formula(scaled_t, sqrt):
+    """Shared LCM boundary-condition math (dtype/backend agnostic: pass the
+    matching sqrt so both the host (numpy) and in-graph (jnp) callers use
+    exactly the same formula)."""
+    denom = scaled_t**2 + SIGMA_DATA**2
+    c_skip = SIGMA_DATA**2 / denom
+    c_out = scaled_t / sqrt(denom)
+    return c_skip, c_out
+
+
 def boundary_coeffs(timesteps, timestep_scaling: float = TIMESTEP_SCALING):
     """LCM c_skip / c_out for integer timesteps (fp32)."""
     s = jnp.asarray(timesteps, dtype=jnp.float32) / timestep_scaling
-    denom = s**2 + SIGMA_DATA**2
-    c_skip = SIGMA_DATA**2 / denom
-    c_out = s / jnp.sqrt(denom)
-    return c_skip, c_out
+    return _boundary_formula(s, jnp.sqrt)
 
 
 @dataclass(frozen=True)
@@ -98,10 +105,7 @@ def make_step_coeffs(
     ac = schedule.alphas_cumprod[t]
     alpha = np.sqrt(ac)
     sigma = np.sqrt(1.0 - ac)
-    s = t.astype(np.float64) / timestep_scaling
-    denom = s**2 + SIGMA_DATA**2
-    c_skip = SIGMA_DATA**2 / denom
-    c_out = s / np.sqrt(denom)
+    c_skip, c_out = _boundary_formula(t.astype(np.float64) / timestep_scaling, np.sqrt)
 
     next_t = np.full(B, -1, dtype=np.int64)
     if B > fbs:

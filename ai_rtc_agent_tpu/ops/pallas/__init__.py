@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the hot fused ops (see /opt/skills/guides/pallas_guide.md).
+
+Kernels ship with an `interpret=True` CPU path so the test suite exercises
+them without TPU hardware.
+"""

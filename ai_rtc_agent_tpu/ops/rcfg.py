@@ -49,7 +49,7 @@ def combine_residual(eps_cond, stock_noise, guidance_scale, delta=1.0):
     return g * eps_cond - (g - 1.0) * d * stock_noise
 
 
-def update_stock_noise(stock_noise, eps_cond, alpha, sigma, delta=1.0):
+def update_stock_noise(stock_noise, eps_cond, alpha, sigma):
     """Self-Negative stock-noise tracking update.
 
     After the conditioned prediction, the stream's belief about the residual
@@ -60,13 +60,14 @@ def update_stock_noise(stock_noise, eps_cond, alpha, sigma, delta=1.0):
     prediction.  This mirrors the fork's per-step stock-noise refresh in
     spirit; the exact blend constant is a free design parameter — we pick the
     alpha/sigma-weighted EMA because it preserves the q(x_t|x0) consistency
-    of the ring buffer across stages.
+    of the ring buffer across stages.  Deliberately delta-free: delta scales
+    the stock ONLY at combine time (combine_residual) — scaling here too
+    would apply delta twice.
     """
     beta = (sigma / jnp.maximum(alpha, 1e-6)).reshape(
         (-1,) + (1,) * (eps_cond.ndim - 1)
     ).astype(eps_cond.dtype)
-    d = jnp.asarray(delta, dtype=eps_cond.dtype)
-    return (d * eps_cond + beta * stock_noise) / (1.0 + beta)
+    return (eps_cond + beta * stock_noise) / (1.0 + beta)
 
 
 def apply_guidance(

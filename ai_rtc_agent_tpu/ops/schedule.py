@@ -80,12 +80,15 @@ def inference_timesteps(
     if n < 1 or n > T:
         raise ValueError(f"num_inference_steps must be in [1, {T}], got {n}")
     if spacing == "leading":
-        ts = (np.arange(n) * (T // n)).round().astype(np.int64)
+        # ascending by construction -> reverse to descending
+        ts = (np.arange(n) * (T // n)).round().astype(np.int64)[::-1]
     elif spacing == "trailing":
+        # descending by construction (t_0 = T-1)
         ts = np.round(T - np.arange(n) * (T / n)).astype(np.int64) - 1
     else:
         raise ValueError(f"unknown spacing: {spacing}")
-    return ts[::-1].copy()  # descending: most-noisy first
+    assert n == 1 or ts[0] > ts[-1], "timesteps must be descending"
+    return ts.copy()  # descending: most-noisy first
 
 
 def sub_timesteps(

@@ -1,0 +1,1 @@
+from . import mesh, collectives, ring_attention, sharding, multipeer, trainer  # noqa: F401

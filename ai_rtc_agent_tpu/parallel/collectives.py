@@ -1,0 +1,47 @@
+"""Thin, named wrappers over XLA collectives used inside shard_map bodies.
+
+The TPU-native equivalent of the NCCL call surface a GPU framework would
+carry (the reference carries none — SURVEY.md section 2c).  Keeping these as
+one module gives the codebase a single place where cross-chip traffic is
+visible and auditable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis_name=axis, tiled=tiled, axis=gather_axis)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute_ring(x, axis: str, shift: int = 1):
+    """Rotate shards around the ring (ICI neighbor exchange)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    """Ulysses-style sequence<->head reshard."""
+    return lax.all_to_all(
+        x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )

@@ -1,0 +1,1 @@
+from . import agent, events, signaling, tracks, turn  # noqa: F401

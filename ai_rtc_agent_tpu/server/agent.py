@@ -1,0 +1,432 @@
+"""The serving agent: HTTP signaling + WebRTC lifecycle + control plane.
+
+Endpoint-for-endpoint parity with reference agent.py:
+
+  POST/DELETE /whip    publish a stream (OBS/browser)     agent.py:285-395
+  POST/DELETE /whep    subscribe to the processed stream  agent.py:211-282
+  POST /offer          bidirectional browser session      agent.py:123-208
+  POST /config         runtime prompt / t_index update    agent.py:398-412
+  GET  /               health                             agent.py:415-416
+  GET  /metrics        fps/latency gauges                 (new — SURVEY sec.5
+                                                          says the rebuild
+                                                          must add these)
+
+Also carried over behavior-for-behavior: UDP port pinning via the event-loop
+datagram patch (agent.py:32-69), H264 codec forcing on send+receive
+(agent.py:72-77, 149-152), Twilio TURN on /offer only with the documented
+rationale for avoiding TURN on /whip (agent.py:299-314), the OBS
+full-gather-before-answer workaround (agent.py:256-263), webhooks on
+connect/close (agent.py:185-196), CORS-allow-all, and graceful shutdown
+closing all pcs (agent.py:433-437).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import random
+import types
+import uuid
+from typing import List, Tuple
+
+from aiohttp import web
+
+from ..utils import env
+from ..utils.profiling import FrameStats
+from . import turn
+from .events import StreamEventHandler
+from .signaling import get_provider
+from .tracks import VideoStreamTrack
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# UDP port pinning (reference agent.py:32-69; rationale: restrictive
+# firewalls / serverless platforms need operator-chosen media ports)
+# ---------------------------------------------------------------------------
+
+def patch_loop_datagram(local_ports: List[int]):
+    loop = asyncio.get_event_loop()
+    if getattr(loop, "_patch_done", False):
+        return
+
+    old_create = loop.create_datagram_endpoint
+
+    async def create_datagram_endpoint(
+        self, protocol_factory, local_addr: Tuple[str, int] = None, **kwargs
+    ):
+        if local_addr and local_addr[1]:
+            return await old_create(protocol_factory, local_addr=local_addr, **kwargs)
+        if local_addr is None:
+            return await old_create(protocol_factory, local_addr=None, **kwargs)
+        ports = [int(p) for p in local_ports]
+        random.shuffle(ports)
+        last_exc = None
+        for port in ports:
+            try:
+                ret = await old_create(
+                    protocol_factory, local_addr=(local_addr[0], port), **kwargs
+                )
+                logger.debug("create_datagram_endpoint chose port %s", port)
+                return ret
+            except OSError as exc:
+                last_exc = exc
+        if last_exc is not None:
+            raise last_exc
+        raise ValueError("local_ports must not be empty")
+
+    loop.create_datagram_endpoint = types.MethodType(create_datagram_endpoint, loop)
+    loop._patch_done = True
+
+
+# ---------------------------------------------------------------------------
+# control-plane application of runtime config JSON (shared by datachannel
+# and POST /config — reference agent.py:154-168, 324-337, 398-412)
+# ---------------------------------------------------------------------------
+
+def apply_runtime_config(pipeline, config: dict):
+    t_index_list = config.get("t_index_list")
+    if t_index_list is not None:
+        pipeline.update_t_index_list(t_index_list)
+    prompt = config.get("prompt")
+    if prompt is not None:
+        pipeline.update_prompt(prompt)
+
+
+def _wire_datachannel(pipeline, channel, guard=None):
+    @channel.on("message")
+    async def on_message(message):
+        if guard is not None and not guard():
+            return
+        logger.info("received config: %s", message)
+        try:
+            apply_runtime_config(pipeline, json.loads(message))
+        except (ValueError, KeyError) as e:
+            logger.error("bad config message: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+
+async def offer(request):
+    app = request.app
+    pipeline = app["pipeline"]
+    pcs = app["pcs"]
+    provider = app["provider"]
+    stream_event_handler = app["stream_event_handler"]
+    stats: FrameStats = app["stats"]
+
+    try:
+        params = await request.json()
+        room_id = params["room_id"]
+        offer_params = params["offer"]
+    except (ValueError, KeyError) as e:
+        return web.Response(status=400, text=f"invalid offer request: {e}")
+    stream_id = str(uuid.uuid4())
+    offer_sdp = provider.session_description(
+        sdp=offer_params["sdp"], type=offer_params["type"]
+    )
+
+    ice_servers = turn.get_ice_servers()
+    pc = provider.peer_connection(ice_servers if ice_servers else None)
+    pcs.add(pc)
+
+    tracks = {"video": None}
+
+    # Prefer H264 on the receive transceiver (reference agent.py:149-152)
+    transceiver = pc.addTransceiver("video")
+    transceiver.setCodecPreferences(provider.h264_codec_preferences("video"))
+
+    @pc.on("datachannel")
+    def on_datachannel(channel):
+        _wire_datachannel(pipeline, channel, guard=lambda: tracks["video"] is not None)
+
+    @pc.on("track")
+    def on_track(track):
+        logger.info("Track received: %s", track.kind)
+        if track.kind == "video":
+            video_track = VideoStreamTrack(track, _timed_pipeline(pipeline, stats))
+            tracks["video"] = video_track
+            sender = pc.addTrack(video_track)
+            provider.force_codec(pc, sender, "video/H264")
+
+        @track.on("ended")
+        async def on_ended():
+            logger.info("%s track ended", track.kind)
+
+    @pc.on("connectionstatechange")
+    async def on_connectionstatechange():
+        logger.info("Connection state is: %s", pc.connectionState)
+        if pc.connectionState == "failed":
+            await pc.close()
+            pcs.discard(pc)
+        elif pc.connectionState == "closed":
+            await pc.close()
+            pcs.discard(pc)
+            stream_event_handler.handle_stream_ended(stream_id, room_id)
+        elif pc.connectionState == "connected":
+            stream_event_handler.handle_stream_started(stream_id, room_id)
+
+    await pc.setRemoteDescription(offer_sdp)
+    answer = await pc.createAnswer()
+    await pc.setLocalDescription(answer)
+
+    return web.Response(
+        content_type="application/json",
+        text=json.dumps(
+            {"sdp": pc.localDescription.sdp, "type": pc.localDescription.type}
+        ),
+    )
+
+
+async def whep(request):
+    if request.method == "DELETE":
+        return web.Response(status=200)
+    if request.content_type != "application/sdp":
+        return web.Response(status=400)
+
+    app = request.app
+    source_track = app["state"].get("source_track")
+    if source_track is None:
+        return web.Response(status=401)
+
+    provider = app["provider"]
+    pcs = app["pcs"]
+
+    offer_sdp = provider.session_description(sdp=await request.text(), type="offer")
+    pc = provider.peer_connection()
+    pcs.add(pc)
+
+    @pc.on("iceconnectionstatechange")
+    async def on_iceconnectionstatechange():
+        logger.info("ICE connection state is %s", pc.iceConnectionState)
+        if pc.iceConnectionState == "failed":
+            await pc.close()
+            pcs.discard(pc)
+
+    @pc.on("connectionstatechange")
+    async def on_connectionstatechange():
+        logger.info("Connection state is: %s", pc.connectionState)
+        if pc.connectionState in ("failed", "closed"):
+            await pc.close()
+            pcs.discard(pc)
+
+    sender = pc.addTrack(source_track)
+    provider.force_codec(pc, sender, "video/H264")
+
+    await pc.setRemoteDescription(offer_sdp)
+    # OBS WHIP: gather ALL ICE candidates before answering (reference
+    # agent.py:256-263 — OBS does not trickle)
+    await pc._RTCPeerConnection__gather()
+    answer = await pc.createAnswer()
+    await pc.setLocalDescription(answer)
+
+    return web.Response(
+        status=201,
+        content_type="application/sdp",
+        headers={
+            "Access-Control-Allow-Origin": "*",
+            "Access-Control-Allow-Headers": "*",
+            "Location": "/whep",
+        },
+        text=answer.sdp,
+    )
+
+
+async def whip(request):
+    if request.method == "DELETE":
+        return web.Response(status=200)
+    if request.content_type != "application/sdp":
+        return web.Response(status=400)
+
+    app = request.app
+    pipeline = app["pipeline"]
+    pcs = app["pcs"]
+    provider = app["provider"]
+    stats: FrameStats = app["stats"]
+
+    offer_sdp = provider.session_description(sdp=await request.text(), type="offer")
+
+    # No TURN here by design: OBS doesn't trickle ICE, so the TURN permission
+    # dance can't complete; rely on STUN + pinned UDP ports instead
+    # (full rationale preserved from reference agent.py:299-314).
+    pc = provider.peer_connection()
+    pcs.add(pc)
+
+    transceiver = pc.addTransceiver("video")
+    transceiver.setCodecPreferences(provider.h264_codec_preferences("video"))
+
+    @pc.on("datachannel")
+    def on_datachannel(channel):
+        _wire_datachannel(pipeline, channel)
+
+    @pc.on("iceconnectionstatechange")
+    async def on_iceconnectionstatechange():
+        logger.info("ICE connection state is %s", pc.iceConnectionState)
+        if pc.iceConnectionState == "failed":
+            await pc.close()
+            pcs.discard(pc)
+
+    @pc.on("track")
+    def on_track(track):
+        logger.info("Track received: %s", track.kind)
+        if track.kind == "video":
+            app["state"]["source_track"] = VideoStreamTrack(
+                track, _timed_pipeline(pipeline, stats)
+            )
+
+        @track.on("ended")
+        async def on_ended():
+            logger.info("%s track ended", track.kind)
+
+    @pc.on("connectionstatechange")
+    async def on_connectionstatechange():
+        logger.info("Connection state is: %s", pc.connectionState)
+        if pc.connectionState in ("failed", "closed"):
+            await pc.close()
+            pcs.discard(pc)
+
+    await pc.setRemoteDescription(offer_sdp)
+    await pc._RTCPeerConnection__gather()
+    answer = await pc.createAnswer()
+    await pc.setLocalDescription(answer)
+
+    return web.Response(
+        status=201,
+        content_type="application/sdp",
+        headers={
+            "Access-Control-Allow-Origin": "*",
+            "Access-Control-Allow-Headers": "*",
+            "Location": "/whip",
+        },
+        text=answer.sdp,
+    )
+
+
+async def update_config(request):
+    try:
+        config = await request.json()
+    except ValueError:
+        return web.Response(status=400, text="invalid JSON body")
+    logger.info("received config: %s", config)
+    try:
+        apply_runtime_config(request.app["pipeline"], config)
+    except ValueError as e:
+        return web.Response(status=400, text=str(e))
+    return web.Response(content_type="application/json", text="OK")
+
+
+async def health(_):
+    return web.Response(content_type="application/json", text="OK")
+
+
+async def metrics(request):
+    return web.json_response(request.app["stats"].snapshot())
+
+
+def _timed_pipeline(pipeline, stats: FrameStats):
+    def run(frame):
+        with stats.timed():
+            return pipeline(frame)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# app assembly
+# ---------------------------------------------------------------------------
+
+@web.middleware
+async def cors_middleware(request, handler):
+    """Allow-all CORS (replaces aiohttp_middlewares.cors_middleware —
+    reference agent.py:459 — without the extra dependency)."""
+    if request.method == "OPTIONS":
+        resp = web.Response(status=200)
+    else:
+        resp = await handler(request)
+    resp.headers.setdefault("Access-Control-Allow-Origin", "*")
+    resp.headers.setdefault("Access-Control-Allow-Headers", "*")
+    resp.headers.setdefault(
+        "Access-Control-Allow-Methods", "GET,POST,DELETE,OPTIONS"
+    )
+    return resp
+
+
+async def on_startup(app):
+    if app["udp_ports"]:
+        patch_loop_datagram(app["udp_ports"])
+
+    if app.get("pipeline") is None:
+        from ..stream.pipeline import StreamDiffusionPipeline
+
+        app["pipeline"] = StreamDiffusionPipeline(app["model_id"])
+    app["pcs"] = set()
+    app["stream_event_handler"] = StreamEventHandler()
+    app["state"] = {"source_track": None}
+    app["stats"] = FrameStats()
+
+
+async def on_shutdown(app):
+    pcs = app["pcs"]
+    await asyncio.gather(*[pc.close() for pc in pcs])
+    pcs.clear()
+
+
+def build_app(
+    model_id: str = "stabilityai/sd-turbo",
+    udp_ports=None,
+    pipeline=None,
+    provider=None,
+) -> web.Application:
+    app = web.Application(middlewares=[cors_middleware])
+    app["udp_ports"] = udp_ports
+    app["model_id"] = model_id
+    app["pipeline"] = pipeline  # injectable for tests; built on startup if None
+    app["provider"] = provider or get_provider()
+
+    app.on_startup.append(on_startup)
+    app.on_shutdown.append(on_shutdown)
+
+    app.router.add_post("/whip", whip)
+    app.router.add_delete("/whip", whip)
+    app.router.add_post("/whep", whep)
+    app.router.add_delete("/whep", whep)
+    app.router.add_post("/offer", offer)
+    app.router.add_post("/config", update_config)
+    app.router.add_get("/", health)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Run agent")
+    parser.add_argument(
+        "--model-id",
+        default="stabilityai/sd-turbo",
+        help="HuggingFace model ID (sd15 / sd-turbo / sdxl-turbo families)",
+    )
+    parser.add_argument("--port", default=8888, type=int, help="HTTP signaling port")
+    parser.add_argument(
+        "--udp-ports", default=None, help="comma-separated UDP media ports"
+    )
+    parser.add_argument(
+        "--log-level",
+        default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+
+    app = build_app(
+        model_id=args.model_id,
+        udp_ports=args.udp_ports.split(",") if args.udp_ports else None,
+    )
+    web.run_app(app, host="0.0.0.0", port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Stream lifecycle webhooks — parity with reference lib/events.py.
+
+Same event schema (stream_id, room_id, timestamp, event in
+{StreamStarted, StreamEnded}) and env config (WEBHOOK_URL + AUTH_TOKEN
+bearer), with one deliberate fix: the reference fires BLOCKING
+``requests.post`` inside the asyncio event loop (reference lib/events.py:50
+— flagged in SURVEY.md section 5 as a known hazard); here webhooks are
+fire-and-forget asyncio tasks over aiohttp, so a slow webhook endpoint can
+never stall the media path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from pydantic import BaseModel
+
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+
+class WebhookEvent(BaseModel):
+    stream_id: str
+    room_id: str
+    timestamp: int
+
+
+class StreamStartedEvent(WebhookEvent):
+    event: str = "StreamStarted"
+
+
+class StreamEndedEvent(WebhookEvent):
+    event: str = "StreamEnded"
+
+
+class StreamEventHandler:
+    def __init__(self, session_factory=None):
+        self.webhook_url = env.get_str("WEBHOOK_URL")
+        self.token = env.get_str("AUTH_TOKEN")
+        self._session_factory = session_factory
+        self._tasks: set = set()
+
+    def _event(self, event_name: str, stream_id: str, room_id: str) -> WebhookEvent:
+        cls = {"StreamStarted": StreamStartedEvent, "StreamEnded": StreamEndedEvent}.get(
+            event_name
+        )
+        if cls is None:
+            raise ValueError(f"unknown event: {event_name}")
+        return cls(stream_id=stream_id, room_id=room_id, timestamp=int(time.time()))
+
+    async def _post(self, event: WebhookEvent):
+        import aiohttp
+
+        headers = {
+            "Content-Type": "application/json",
+            "Authorization": f"Bearer {self.token}",
+        }
+        try:
+            if self._session_factory:
+                session = self._session_factory()
+                resp = await session.post(
+                    self.webhook_url, headers=headers, json=event.model_dump()
+                )
+                status = getattr(resp, "status", 200)
+            else:
+                async with aiohttp.ClientSession() as session:
+                    async with session.post(
+                        self.webhook_url,
+                        headers=headers,
+                        json=event.model_dump(),
+                        timeout=aiohttp.ClientTimeout(total=10),
+                    ) as resp:
+                        status = resp.status
+            if status != 200:
+                logger.error("failed to send %s event with %s", event.event, status)
+        except Exception as e:
+            logger.error("webhook %s failed: %s", event.event, e)
+
+    def send_request(self, event_name: str, stream_id: str, room_id: str):
+        """Fire-and-forget; returns the task (or None when unconfigured)."""
+        if self.webhook_url is None or self.token is None:
+            return None
+        ev = self._event(event_name, stream_id, room_id)
+        try:
+            task = asyncio.get_running_loop().create_task(self._post(ev))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            return task
+        except RuntimeError:
+            # no running loop (sync context): degrade to blocking best-effort
+            asyncio.run(self._post(ev))
+            return None
+
+    def handle_stream_started(self, stream_id: str, room_id: str):
+        return self.send_request("StreamStarted", stream_id, room_id)
+
+    def handle_stream_ended(self, stream_id: str, room_id: str):
+        return self.send_request("StreamEnded", stream_id, room_id)

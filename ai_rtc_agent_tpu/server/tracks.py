@@ -1,0 +1,65 @@
+"""Processed media track — parity with reference lib/tracks.py.
+
+Wraps a source track; every ``recv()`` pulls a decoded frame and returns the
+diffused frame.  Keeps the reference's warm-up semantics (drop WARMUP_FRAMES
+frames through the pipeline to trigger compile/caches at connect time,
+reference lib/tracks.py:21-25) and the DROP_FRAMES OBS-stutter workaround
+(:27-31), with two deliberate fixes:
+
+* WARMUP_FRAMES is parsed as int (the reference leaves it a str when set —
+  latent TypeError, lib/tracks.py:17; flagged in SURVEY.md section 5).
+* The diffusion step runs in a worker thread via ``asyncio.to_thread`` so a
+  TPU step can NEVER stall the event loop (the reference blocks its loop on
+  GPU inference inside recv(), lib/tracks.py:24,38 — SURVEY.md hazard list).
+  Ordering stays strict because recv() calls are serialized per track.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+
+class VideoStreamTrack:
+    kind = "video"
+
+    def __init__(self, track, pipeline):
+        self.track = track
+        self.pipeline = pipeline
+        self.warmup_frame_idx = 0
+        self.warmup_frames = env.warmup_frames()
+        self.drop_frames = env.drop_frames()
+        self._handlers: dict = {}
+
+    # minimal MediaStreamTrack event surface (works standalone and under
+    # aiortc, which duck-types tracks through the same recv() pull model)
+    def on(self, event: str, f=None):
+        def register(fn):
+            self._handlers[event] = fn
+            return fn
+
+        return register(f) if f else register
+
+    def stop(self):
+        h = self._handlers.get("ended")
+        if h:
+            h()
+
+    async def recv(self):
+        while self.warmup_frame_idx < self.warmup_frames:
+            logger.info("dropping warmup frames %d", self.warmup_frame_idx)
+            frame = await self.track.recv()
+            await asyncio.to_thread(self.pipeline, frame)
+            self.warmup_frame_idx += 1
+
+        # Drop frames to smooth certain encoders (OBS x264 stutter fix kept
+        # from reference lib/tracks.py:27-31)
+        for _ in range(self.drop_frames):
+            await self.track.recv()
+
+        frame = await self.track.recv()
+        return await asyncio.to_thread(self.pipeline, frame)

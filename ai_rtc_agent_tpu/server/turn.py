@@ -1,0 +1,83 @@
+"""ICE/TURN integration — parity with reference agent.py:80-120.
+
+Twilio ephemeral TURN credentials via the bare REST API (the reference pulls
+in the whole twilio SDK for one ``tokens.create()`` call, agent.py:80-91;
+here it's a single POST).  Returns plain dicts shaped like RTCIceServer
+kwargs so both aiortc and the loopback stack consume them.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+TWILIO_TOKEN_URL = "https://api.twilio.com/2010-04-01/Accounts/{sid}/Tokens.json"
+
+
+def get_twilio_token(http_post=None):
+    """POST /Tokens.json with basic auth; returns parsed token dict or None.
+
+    ``http_post(url, headers) -> (status, json_dict)`` is injectable for
+    tests; default implementation uses requests.
+    """
+    sid = env.get_str("TWILIO_ACCOUNT_SID")
+    auth = env.get_str("TWILIO_AUTH_TOKEN")
+    if sid is None or auth is None:
+        return None
+    url = TWILIO_TOKEN_URL.format(sid=sid)
+    basic = base64.b64encode(f"{sid}:{auth}".encode()).decode()
+    headers = {"Authorization": f"Basic {basic}"}
+    if http_post is None:
+
+        def http_post(u, h):
+            import requests
+
+            r = requests.post(u, headers=h, timeout=10)
+            return r.status_code, r.json()
+
+    try:
+        status, body = http_post(url, headers)
+    except Exception as e:
+        logger.error("twilio token request failed: %s", e)
+        return None
+    if status not in (200, 201):
+        logger.error("twilio token request returned %s", status)
+        return None
+    return body
+
+
+def get_ice_servers(http_post=None) -> list[dict]:
+    """TURN-only server list (reference filters to turn: URLs,
+    agent.py:94-109)."""
+    token = get_twilio_token(http_post)
+    if token is None:
+        return []
+    servers = []
+    for server in token.get("ice_servers", []):
+        url = server.get("url", "")
+        if url.startswith("turn:"):
+            servers.append(
+                {
+                    "urls": [server.get("urls", url)],
+                    "username": server.get("username"),
+                    "credential": server.get("credential"),
+                }
+            )
+    return servers
+
+
+def get_link_headers(ice_servers: list[dict]) -> list[str]:
+    """WHIP Link headers (built but unused, mirroring reference
+    agent.py:113-120 + the commented-out usage at :272-276)."""
+    links = []
+    for srv in ice_servers:
+        url = srv["urls"][0]
+        links.append(
+            f'<{url}>; rel="ice-server"; username="{srv["username"]}"; '
+            f'credential="{srv["credential"]}";'
+        )
+    return links

@@ -1,0 +1,1 @@
+from . import engine, pipeline  # noqa: F401

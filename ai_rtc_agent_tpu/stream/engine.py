@@ -1,0 +1,471 @@
+"""The stream-batch denoising engine — heart of the framework.
+
+TPU-native replacement for the external ``StreamDiffusion`` core the
+reference drives at lib/wrapper.py:494-512 / :330 (stream batch, LCM step,
+R-CFG, prompt cache) — re-designed as ONE jit-compiled pure function:
+
+    step(params, state, frame_u8) -> (state', out_u8)
+
+* The latent ring buffer, stock noise, prompt embeddings and scheduler
+  coefficient vectors all live in ``state`` (a dict pytree of device
+  arrays).  The state is DONATED every call, so the ring buffer rotates
+  in-place in HBM with zero copies.
+* Prompt updates and same-length t_index updates are state swaps — no
+  retrace, no recompile (recompilation discipline per SURVEY.md section 7).
+* uint8 pre/post-processing happens in-graph (ops/image.py), so exactly one
+  uint8 [H,W,3] crosses host->device and one [H,W,3] crosses device->host
+  per frame — the TPU analog of the reference's NVDEC/NVENC zero-copy
+  property (reference README.md:11-15).
+
+Stream-batch semantics (reference batch law lib/wrapper.py:159-163):
+  batch B = len(t_index_list) * frame_buffer_size.  Each call consumes
+  frame_buffer_size new frames at the noisiest sub-timestep, advances every
+  buffered latent one denoising stage, and emits the frames that just
+  completed the final stage — per-frame latency of ONE UNet pass while
+  getting len(t_index_list)-step quality.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import image as I
+from ..ops import lcm as L
+from ..ops import rcfg as R
+from ..ops import schedule as S
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    """Static (compile-time) stream configuration."""
+
+    mode: str = "img2img"  # img2img | txt2img
+    height: int = 512
+    width: int = 512
+    latent_scale: int = 8  # image/latent resolution ratio (TAESD: 8)
+    latent_channels: int = 4
+    t_index_list: tuple = (18, 26, 35, 45)
+    num_inference_steps: int = 50
+    frame_buffer_size: int = 1
+    cfg_type: str = "self"  # none | full | self | initialize
+    use_denoising_batch: bool = True
+    do_add_noise: bool = True
+    prediction_type: str = "epsilon"
+    scheduler: str = "lcm"  # lcm | turbo
+    timestep_spacing: str = "leading"
+    dtype: str = "float32"  # compute dtype: float32 | bfloat16
+    similar_image_filter: bool = False
+    similar_image_threshold: float = 0.98
+    similar_image_max_skip: int = 10
+    # SDXL-style "text_time" addition conditioning: pooled text embeds +
+    # micro-conditioning time_ids travel in state (prompt swaps, no retrace)
+    use_added_cond: bool = False
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.t_index_list)
+
+    @property
+    def batch_size(self) -> int:
+        # the stream-batch law (reference lib/wrapper.py:159-163)
+        return self.n_stages * self.frame_buffer_size
+
+    @property
+    def latent_hw(self) -> tuple:
+        return (self.height // self.latent_scale, self.width // self.latent_scale)
+
+    @property
+    def jdtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+@dataclass
+class StreamModels:
+    """Apply-fn bundle the engine drives (duck-typed, so any model family —
+    SD1.5/SD2.1/SDXL/ControlNet variants — plugs in).
+
+    unet(params, x, t, context, added_cond) -> model_out   [B,h,w,4]
+    vae_encode(params, img01_nhwc) -> latents              [N,h,w,4]
+    vae_decode(params, latents) -> img01_nhwc              [N,H,W,3]
+    """
+
+    unet: Callable
+    vae_encode: Callable
+    vae_decode: Callable
+
+
+def _coeff_state(cfg: StreamConfig, schedule: S.NoiseSchedule, t_index_list):
+    bt = S.batched_sub_timesteps(
+        list(t_index_list),
+        cfg.num_inference_steps,
+        cfg.frame_buffer_size,
+        spacing=cfg.timestep_spacing,
+    )
+    c = L.make_step_coeffs(schedule, bt, cfg.frame_buffer_size)
+    return {
+        "timesteps": jnp.asarray(c.timesteps, jnp.int32),
+        "alpha": jnp.asarray(c.alpha),
+        "sigma": jnp.asarray(c.sigma),
+        "c_skip": jnp.asarray(c.c_skip),
+        "c_out": jnp.asarray(c.c_out),
+        "next_alpha": jnp.asarray(c.next_alpha),
+        "next_sigma": jnp.asarray(c.next_sigma),
+    }
+
+
+def _as_step_coeffs(d) -> L.StepCoeffs:
+    return L.StepCoeffs(
+        d["timesteps"], d["alpha"], d["sigma"], d["c_skip"], d["c_out"],
+        d["next_alpha"], d["next_sigma"],
+    )
+
+
+def make_step_fn(models: StreamModels, cfg: StreamConfig):
+    """Build the pure step function (to be jitted/AOT-compiled by the caller)."""
+
+    B = cfg.batch_size
+    fbs = cfg.frame_buffer_size
+    dt = cfg.jdtype
+
+    def unet_with_guidance(params, x_t, state, coeffs, stock):
+        """One guided UNet pass over x_t [xb, h, w, c]; xb may be the full
+        stream batch (denoising-batch mode) or one stage slice (sequential
+        mode).  Returns (eps, new_stock) with new_stock shaped like stock."""
+        xb = x_t.shape[0]
+        t = coeffs.timesteps
+        added = None
+        if cfg.use_added_cond:
+            added = {
+                "time_ids": jnp.broadcast_to(
+                    state["added_time_ids"], (xb,) + state["added_time_ids"].shape[1:]
+                ),
+                "text_embeds": jnp.broadcast_to(
+                    state["added_text"], (xb,) + state["added_text"].shape[1:]
+                ).astype(dt),
+            }
+        cond = jnp.broadcast_to(
+            state["cond"], (xb,) + state["cond"].shape[1:]
+        ).astype(dt)
+
+        if cfg.cfg_type == "full":
+            uncond = jnp.broadcast_to(
+                state["uncond"], (xb,) + state["uncond"].shape[1:]
+            ).astype(dt)
+            x2 = jnp.concatenate([x_t, x_t], axis=0)
+            t2 = jnp.concatenate([t, t], axis=0)
+            ctx2 = jnp.concatenate([uncond, cond], axis=0)
+            added2 = (
+                jax.tree.map(lambda a: jnp.concatenate([a, a], 0), added)
+                if added is not None
+                else None
+            )
+            out = models.unet(params, x2, t2, ctx2, added2)
+            eps_u, eps_c = jnp.split(out, 2, axis=0)
+            eps = R.combine_full(eps_u, eps_c, state["guidance"])
+            new_stock = stock
+        else:
+            eps_c = models.unet(params, x_t, t, cond, added)
+            if cfg.cfg_type == "none":
+                eps = eps_c
+                new_stock = stock
+            else:  # self | initialize
+                eps = R.combine_residual(
+                    eps_c, stock.astype(dt), state["guidance"], state["delta"]
+                )
+                if cfg.cfg_type == "self":
+                    new_stock = R.update_stock_noise(
+                        stock.astype(dt), eps_c, coeffs.alpha, coeffs.sigma
+                    )
+                else:
+                    new_stock = stock
+        return eps, new_stock
+
+    def step(params, state, frame_u8):
+        """frame_u8: [fbs,H,W,3] (or [H,W,3] when fbs==1) uint8 RGB."""
+        coeffs = _as_step_coeffs(state["coeffs"])
+
+        # ---- encode the incoming frame(s) to the noisiest stage ----
+        if cfg.mode == "img2img":
+            img = I.preprocess_uint8(frame_u8, dtype=dt)  # [fbs,H,W,3]
+            z0 = models.vae_encode(params, img)  # [fbs,h,w,4]
+            if cfg.do_add_noise:
+                a0 = coeffs.alpha[:fbs].reshape(-1, 1, 1, 1).astype(dt)
+                s0 = coeffs.sigma[:fbs].reshape(-1, 1, 1, 1).astype(dt)
+                x_new = a0 * z0 + s0 * state["noise"][:fbs].astype(dt)
+            else:
+                x_new = z0
+        else:  # txt2img: fresh noise enters the ring
+            x_new = state["noise"][:fbs].astype(dt)
+
+        # ---- assemble the stream batch and run the UNet ----
+        if cfg.use_denoising_batch:
+            x_t = (
+                jnp.concatenate([x_new, state["x_buf"].astype(dt)], axis=0)
+                if B > fbs
+                else x_new
+            )
+            eps, new_stock = unet_with_guidance(
+                params, x_t, state, coeffs, state["stock"]
+            )
+            if cfg.scheduler == "turbo":
+                denoised = L.turbo_denoise(x_t, eps, coeffs, cfg.prediction_type)
+            else:
+                denoised = L.lcm_denoise(x_t, eps, coeffs, cfg.prediction_type)
+
+            # ---- rotate the ring: advance every entry one stage ----
+            out_latent = denoised[B - fbs :]
+            if B > fbs:
+                stage_noise = state["noise"][fbs:].astype(dt)
+                advanced = L.renoise_next(
+                    denoised[: B - fbs],
+                    stage_noise,
+                    L.StepCoeffs(
+                        *[
+                            getattr(coeffs, f)[: B - fbs]
+                            for f in (
+                                "timesteps", "alpha", "sigma", "c_skip", "c_out",
+                                "next_alpha", "next_sigma",
+                            )
+                        ]
+                    ),
+                )
+                new_buf = advanced
+            else:
+                new_buf = state["x_buf"]
+        else:
+            # sequential (non-stream) mode: all stages for this frame now —
+            # n UNet passes of batch fbs; parity with the reference's
+            # use_denoising_batch=False path (lib/wrapper.py ctor arg).
+            x = x_new
+            new_stock = state["stock"]
+            for i in range(cfg.n_stages):
+                sl = slice(i * fbs, (i + 1) * fbs)
+                sub = L.StepCoeffs(
+                    *[
+                        getattr(coeffs, f)[sl]
+                        for f in (
+                            "timesteps", "alpha", "sigma", "c_skip", "c_out",
+                            "next_alpha", "next_sigma",
+                        )
+                    ]
+                )
+                eps, stock_sl = unet_with_guidance(
+                    params, x, state, sub, new_stock[sl]
+                )
+                new_stock = (
+                    new_stock
+                    if stock_sl is None
+                    else jnp.concatenate(
+                        [new_stock[: i * fbs], stock_sl, new_stock[(i + 1) * fbs :]],
+                        axis=0,
+                    )
+                )
+                if cfg.scheduler == "turbo":
+                    d = L.turbo_denoise(x, eps, sub, cfg.prediction_type)
+                else:
+                    d = L.lcm_denoise(x, eps, sub, cfg.prediction_type)
+                x = L.renoise_next(d, state["noise"][sl].astype(dt), sub)
+            out_latent = x
+            new_buf = state["x_buf"]
+
+        # ---- decode + postprocess in-graph ----
+        img_out = models.vae_decode(params, out_latent)
+        out_u8 = I.postprocess_uint8(img_out.astype(jnp.float32))
+
+        new_state = dict(state)
+        new_state["x_buf"] = new_buf
+        new_state["stock"] = new_stock
+        return new_state, out_u8
+
+    return step
+
+
+class StreamEngine:
+    """Host-side driver around the jitted step fn (prompt cache, state
+    management, warm-up, similarity filter).
+
+    Parity surface with the reference wrapper (lib/wrapper.py):
+      prepare(prompt, num_inference_steps, guidance_scale, delta, seed)
+      __call__(frame) / update_prompt(prompt) / update_t_index_list(list)
+    ``encode_prompt`` is injected (a callable str -> (cond, uncond) numpy
+    [1,L,D] pair) so the engine stays tokenizer-agnostic.
+    """
+
+    def __init__(
+        self,
+        models: StreamModels,
+        params,
+        cfg: StreamConfig,
+        encode_prompt: Callable[[str], tuple],
+        schedule: S.NoiseSchedule | None = None,
+        jit_compile: bool = True,
+        donate: bool = True,
+    ):
+        self.models = models
+        self.params = params
+        self.cfg = cfg
+        self.encode_prompt = encode_prompt
+        self.schedule = schedule or S.make_schedule()
+        self._t_index_list = tuple(cfg.t_index_list)
+        step = make_step_fn(models, cfg)
+        if jit_compile:
+            self._step = jax.jit(step, donate_argnums=(1,) if donate else ())
+        else:
+            self._step = step
+        self.state = None
+        self._skip_count = 0
+        self._last_out = None
+        self._prev_frame_small = None
+
+    # -- state construction -------------------------------------------------
+
+    def prepare(
+        self,
+        prompt: str,
+        num_inference_steps: int | None = None,
+        guidance_scale: float = 1.2,
+        delta: float = 1.0,
+        seed: int = 2,
+        negative_prompt: str = "",
+    ):
+        """Build the initial StreamState (reference prepare(): lib/wrapper.py:197-234)."""
+        cfg = self.cfg
+        if (
+            num_inference_steps is not None
+            and num_inference_steps != cfg.num_inference_steps
+        ):
+            raise ValueError(
+                "num_inference_steps is compile-time static; rebuild the engine"
+            )
+        h, w = cfg.latent_hw
+        B = cfg.batch_size
+        key = jax.random.PRNGKey(seed)
+        noise = jax.random.normal(key, (B, h, w, cfg.latent_channels), cfg.jdtype)
+        cond, uncond, extras = self._encode(prompt)
+        state = {
+            "x_buf": (
+                noise[cfg.frame_buffer_size :]
+                if B > cfg.frame_buffer_size
+                else jnp.zeros((0, h, w, cfg.latent_channels), cfg.jdtype)
+            ),
+            "noise": noise,
+            "stock": jnp.zeros_like(noise),
+            "cond": jnp.asarray(cond, cfg.jdtype),
+            "uncond": jnp.asarray(uncond, cfg.jdtype),
+            "guidance": jnp.asarray(guidance_scale, jnp.float32),
+            "delta": jnp.asarray(delta, jnp.float32),
+            "coeffs": _coeff_state(cfg, self.schedule, self._t_index_list),
+        }
+        if cfg.use_added_cond:
+            state["added_text"] = jnp.asarray(extras["pooled"], cfg.jdtype)
+            state["added_time_ids"] = jnp.asarray(
+                extras.get(
+                    "time_ids",
+                    np.array(
+                        [[cfg.height, cfg.width, 0, 0, cfg.height, cfg.width]],
+                        np.float32,
+                    ),
+                )
+            )
+        if cfg.cfg_type == "initialize":
+            # Onetime-Negative: seed the stock noise with one real uncond pass
+            coeffs = _as_step_coeffs(state["coeffs"])
+            x = state["noise"].astype(cfg.jdtype)
+            added = None
+            if cfg.use_added_cond:
+                added = {
+                    "time_ids": jnp.broadcast_to(
+                        state["added_time_ids"], (B,) + state["added_time_ids"].shape[1:]
+                    ),
+                    "text_embeds": jnp.broadcast_to(
+                        state["added_text"], (B,) + state["added_text"].shape[1:]
+                    ).astype(cfg.jdtype),
+                }
+            unc = jnp.broadcast_to(
+                state["uncond"], (B,) + state["uncond"].shape[1:]
+            ).astype(cfg.jdtype)
+            state["stock"] = self.models.unet(
+                self.params, x, coeffs.timesteps, unc, added
+            )
+        self.state = state
+        return self
+
+    # -- hot path -----------------------------------------------------------
+
+    def __call__(self, frame_u8: np.ndarray) -> np.ndarray:
+        """One stream step. frame_u8 [H,W,3] uint8 -> [H,W,3] uint8.
+
+        With frame_buffer_size>1 pass [fbs,H,W,3] and get [fbs,H,W,3].
+        """
+        if self.state is None:
+            raise RuntimeError("call prepare() first")
+        if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
+            return self._last_out
+        self.state, out = self._step(self.params, self.state, frame_u8)
+        out = np.asarray(out)
+        if out.shape[0] == 1 and frame_u8.ndim == 3:
+            out = out[0]
+        self._last_out = out
+        return out
+
+    def _maybe_skip(self, frame_u8) -> bool:
+        """Host-side similar-image filter: skips the device call entirely
+        (the real saving — an in-graph select would still burn the FLOPs).
+        Parity with the fork's stochastic similarity filter (reference
+        lib/wrapper.py:192-195)."""
+        small = np.asarray(frame_u8, dtype=np.float32)[..., ::16, ::16, :]
+        if self._prev_frame_small is not None and self._last_out is not None:
+            diff = np.abs(small - self._prev_frame_small).mean() / 255.0
+            sim = 1.0 - min(diff * 4.0, 1.0)
+            if (
+                sim > self.cfg.similar_image_threshold
+                and self._skip_count < self.cfg.similar_image_max_skip
+            ):
+                self._skip_count += 1
+                return True
+        self._prev_frame_small = small
+        self._skip_count = 0
+        return False
+
+    # -- control plane (no recompiles) -------------------------------------
+
+    def update_prompt(self, prompt: str):
+        """Embedding swap (reference lib/pipeline.py:44-45)."""
+        cond, uncond, extras = self._encode(prompt)
+        self.state["cond"] = jnp.asarray(cond, self.cfg.jdtype)
+        self.state["uncond"] = jnp.asarray(uncond, self.cfg.jdtype)
+        if self.cfg.use_added_cond and "pooled" in extras:
+            self.state["added_text"] = jnp.asarray(extras["pooled"], self.cfg.jdtype)
+
+    def _encode(self, prompt: str):
+        res = self.encode_prompt(prompt)
+        if len(res) == 3:
+            return res
+        cond, uncond = res
+        return cond, uncond, {}
+
+    def update_t_index_list(self, t_index_list):
+        """Same-length update = coefficient swap, zero recompile (fixes the
+        reference's desync quirk at lib/wrapper.py:389-407 by VALIDATING the
+        length here, which the reference only does in prepare())."""
+        t_index_list = tuple(int(t) for t in t_index_list)
+        if len(t_index_list) != len(self._t_index_list):
+            raise ValueError(
+                f"t_index_list length must stay {len(self._t_index_list)} "
+                f"(compiled batch size); rebuild the engine to change depth"
+            )
+        self._t_index_list = t_index_list
+        self.state["coeffs"] = _coeff_state(self.cfg, self.schedule, t_index_list)
+
+    def update_guidance(self, guidance_scale=None, delta=None):
+        if guidance_scale is not None:
+            self.state["guidance"] = jnp.asarray(guidance_scale, jnp.float32)
+        if delta is not None:
+            self.state["delta"] = jnp.asarray(delta, jnp.float32)

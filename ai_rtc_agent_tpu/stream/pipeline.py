@@ -1,0 +1,127 @@
+"""Pipeline façade — parity surface with reference lib/pipeline.py.
+
+``StreamDiffusionPipeline(model_id)`` owns the model bundle + engine and
+exposes exactly the reference's call surface (reference lib/pipeline.py:17-96):
+    __call__(frame) -> frame      update_prompt(str)
+    preprocess / predict / postprocess        update_t_index_list(list)
+
+Differences, all deliberate and TPU-motivated:
+* preprocess/postprocess are IN-GRAPH (ops/image.py); the façade-level
+  methods exist for API parity and host-side fallbacks but the hot path
+  calls the fused jitted step directly.
+* The reference hardcodes device="cuda" and NCHW fp16; here the engine
+  compiles for the local TPU (or CPU) in NHWC with bf16/fp32 selected by
+  StreamConfig.
+* Frame duck-typing contract preserved (reference lib/tracks.py:34-37): a
+  frame is either a raw HxWx3 uint8 ndarray (device-bound fast path — the
+  NVDEC analog) or an object with .to_ndarray(format="rgb24"), .pts and
+  .time_base (av.VideoFrame-compatible software path).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import replace
+from typing import Sequence
+
+import numpy as np
+
+from ..models import registry
+from ..utils import env
+from .engine import StreamConfig, StreamEngine
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PROMPT = "fireworks in the night sky"
+DEFAULT_T_INDEX_LIST = (18, 26, 35, 45)
+DEFAULT_NUM_INFERENCE_STEPS = 50
+DEFAULT_GUIDANCE_SCALE = 1.2
+DEFAULT_DELTA = 1.0
+
+
+class StreamDiffusionPipeline:
+    """Owns model params + stream engine; shared by all connections
+    (mutable shared state semantics preserved from reference agent.py:423)."""
+
+    def __init__(
+        self,
+        model_id: str = "stabilityai/sd-turbo",
+        config: StreamConfig | None = None,
+        prompt: str = DEFAULT_PROMPT,
+        lora_dict: dict | None = None,
+        seed: int = 2,
+    ):
+        self.prompt = prompt
+        self.model_id = model_id
+        bundle = registry.load_model_bundle(model_id, lora_dict=lora_dict)
+        cfg = config or registry.default_stream_config(model_id)
+        self.t_index_list = list(cfg.t_index_list)
+        self.engine = StreamEngine(
+            models=bundle.stream_models,
+            params=bundle.params,
+            cfg=cfg,
+            encode_prompt=bundle.encode_prompt,
+        )
+        self.engine.prepare(
+            prompt=prompt,
+            guidance_scale=DEFAULT_GUIDANCE_SCALE,
+            delta=DEFAULT_DELTA,
+            seed=seed,
+        )
+        self.config = cfg
+
+    # -- control plane (reference lib/pipeline.py:44-48) --------------------
+
+    def update_prompt(self, prompt: str):
+        self.prompt = prompt
+        self.engine.update_prompt(prompt)
+
+    def update_t_index_list(self, t_index_list: Sequence[int]):
+        self.engine.update_t_index_list(t_index_list)
+        self.t_index_list = list(t_index_list)
+
+    # -- frame path (reference lib/pipeline.py:50-96) -----------------------
+
+    def preprocess(self, frame) -> np.ndarray:
+        """Duck-typed frame -> [H,W,3] uint8 ndarray (+ pts metadata)."""
+        if hasattr(frame, "to_ndarray"):
+            arr = frame.to_ndarray(format="rgb24")
+        elif isinstance(frame, np.ndarray):
+            arr = frame
+        else:
+            raise TypeError(f"invalid frame type: {type(frame)!r}")
+        if arr.dtype != np.uint8 or arr.ndim != 3 or arr.shape[-1] != 3:
+            raise ValueError(f"expected HxWx3 uint8 RGB, got {arr.shape} {arr.dtype}")
+        h, w = self.config.height, self.config.width
+        if arr.shape[:2] != (h, w):
+            arr = _resize_u8(arr, h, w)
+        return arr
+
+    def predict(self, frame_u8: np.ndarray) -> np.ndarray:
+        return self.engine(frame_u8)
+
+    def postprocess(self, out_u8: np.ndarray, src_frame=None):
+        """Attach timing metadata when the input carried it (VideoFrame
+        contract: pts/time_base preserved, reference lib/pipeline.py:89-93)."""
+        if src_frame is not None and hasattr(src_frame, "pts"):
+            from ..media.frames import VideoFrame
+
+            vf = VideoFrame.from_ndarray(out_u8)
+            vf.pts = src_frame.pts
+            vf.time_base = src_frame.time_base
+            return vf
+        return out_u8
+
+    def __call__(self, frame):
+        pre = self.preprocess(frame)
+        out = self.predict(pre)
+        if hasattr(frame, "pts") and not env.hw_encode():
+            return self.postprocess(out, frame)
+        return out
+
+
+def _resize_u8(arr: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Nearest-neighbor host resize for mismatched sources (control path)."""
+    ys = (np.arange(h) * arr.shape[0] // h).clip(0, arr.shape[0] - 1)
+    xs = (np.arange(w) * arr.shape[1] // w).clip(0, arr.shape[1] - 1)
+    return arr[ys][:, xs]

@@ -1,0 +1,72 @@
+"""Observability: fps / latency gauges + JAX profiler hooks.
+
+The reference has NO metrics at all (SURVEY.md section 5: "no fps/latency
+reporting anywhere") despite fps being its implicit north-star; this module
+adds the gauges the rebuild is judged on, plus a hook into
+``jax.profiler`` for TPU traces (replacing the nvtx/pynvml dependencies of
+the reference's requirements.txt:4-7).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+class FrameStats:
+    """Sliding-window fps + latency percentiles (thread-safe, O(1) record)."""
+
+    def __init__(self, window: int = 240):
+        self._lat = collections.deque(maxlen=window)
+        self._times = collections.deque(maxlen=window)
+        self._lock = threading.Lock()
+        self.frames_total = 0
+
+    def record(self, latency_s: float, t: float | None = None):
+        with self._lock:
+            self._lat.append(latency_s)
+            self._times.append(t if t is not None else time.monotonic())
+            self.frames_total += 1
+
+    def timed(self):
+        """Context manager: with stats.timed(): process(frame)."""
+        stats = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                stats.record(time.monotonic() - self.t0)
+                return False
+
+        return _Timer()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = sorted(self._lat)
+            times = list(self._times)
+        out = {
+            "frames_total": self.frames_total,
+            "fps": 0.0,
+            "latency_p50_ms": None,
+            "latency_p90_ms": None,
+            "latency_max_ms": None,
+        }
+        if len(times) >= 2 and times[-1] > times[0]:
+            out["fps"] = (len(times) - 1) / (times[-1] - times[0])
+        if lat:
+            out["latency_p50_ms"] = 1e3 * lat[len(lat) // 2]
+            out["latency_p90_ms"] = 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.9))]
+            out["latency_max_ms"] = 1e3 * lat[-1]
+        return out
+
+
+def start_profiler_server(port: int = 9999):
+    """TPU trace collection endpoint (tensorboard-connectable)."""
+    import jax
+
+    jax.profiler.start_server(port)
+    return port

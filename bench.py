@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Benchmark: end-to-end stream-step fps on the flagship serving config.
+
+Measures the BASELINE.md north-star: SD-Turbo-architecture (SD2.1 geometry)
+1-step img2img at 512x512 with TAESD, bf16, as ONE jitted step including
+in-graph uint8 pre/post-processing — i.e. everything between "decoded frame
+on host" and "stylized frame on host" (glass-to-glass minus host codec).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": fps/30, ...}
+
+vs_baseline is against the 30 fps real-time bar (BASELINE.json north_star:
+">=30 fps end-to-end at 512x512 SD-Turbo 1-step on a single v5e-1").
+Weights are random (zero-egress image) — identical FLOPs/shapes to real
+weights, which is what fps depends on.
+
+Flags: --config {turbo512, lcm4x512, sdxl1024, multipeer} --frames N
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+import numpy as np
+
+logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+logger = logging.getLogger("bench")
+
+
+def build_engine(config: str):
+    import jax
+    import jax.numpy as jnp
+
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    dtype = "bfloat16" if jax.default_backend() != "cpu" else "float32"
+    if config == "turbo512":
+        model_id, overrides = "stabilityai/sd-turbo", dict(dtype=dtype)
+    elif config == "lcm4x512":
+        model_id, overrides = "lykon/dreamshaper-8", dict(dtype=dtype)
+    elif config == "sdxl1024":
+        model_id, overrides = "stabilityai/sdxl-turbo", dict(dtype=dtype)
+    else:
+        raise ValueError(config)
+
+    bundle = registry.load_model_bundle(model_id)
+    cfg = registry.default_stream_config(model_id, **overrides)
+    if dtype == "bfloat16":
+        bundle.params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+            bundle.params,
+        )
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    )
+    eng.prepare("a benchmark prompt", guidance_scale=1.0)
+    return eng, cfg
+
+
+def run_bench(config: str, frames: int):
+    eng, cfg = build_engine(config)
+    rng = np.random.default_rng(0)
+    frame = rng.integers(0, 256, (cfg.height, cfg.width, 3), dtype=np.uint8)
+
+    # warm-up: compile + cache (reference drops 10 warm-up frames at connect,
+    # lib/tracks.py:21-25 — same idea)
+    t0 = time.monotonic()
+    for _ in range(3):
+        out = eng(frame)
+    logger.info("warm-up (incl. compile): %.1fs", time.monotonic() - t0)
+
+    lats = []
+    for i in range(frames):
+        f = frame if i % 2 == 0 else frame[::-1].copy()
+        t1 = time.monotonic()
+        out = eng(f)
+        lats.append(time.monotonic() - t1)
+    lats = np.array(lats)
+    fps = 1.0 / lats.mean()
+    return {
+        "fps": float(fps),
+        "latency_p50_ms": float(np.percentile(lats, 50) * 1e3),
+        "latency_p90_ms": float(np.percentile(lats, 90) * 1e3),
+        "out_shape": list(np.asarray(out).shape),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="turbo512",
+                    choices=["turbo512", "lcm4x512", "sdxl1024"])
+    ap.add_argument("--frames", type=int, default=30)
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    try:
+        r = run_bench(args.config, args.frames)
+        result = {
+            "metric": f"e2e_fps_{args.config}_singlechip",
+            "value": round(r["fps"], 2),
+            "unit": "fps",
+            "vs_baseline": round(r["fps"] / 30.0, 3),
+            "latency_p50_ms": round(r["latency_p50_ms"], 1),
+            "latency_p90_ms": round(r["latency_p90_ms"], 1),
+            "backend": backend,
+        }
+    except Exception as e:  # still emit the contract line on failure
+        logger.exception("bench failed")
+        result = {
+            "metric": f"e2e_fps_{args.config}_singlechip",
+            "value": 0.0,
+            "unit": "fps",
+            "vs_baseline": 0.0,
+            "backend": backend,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+// SPSC lock-free frame ring — the host-side half of the pinned host<->HBM
+// frame path (TPU-native replacement for the reference's NVDEC/NVENC
+// zero-copy CUDA tensors, reference lib/pipeline.py:83-96).
+//
+// One producer (codec thread) and one consumer (device-feed thread) exchange
+// fixed-size frame slots with acquire/release atomics — no locks, no
+// allocation on the hot path.  Slot memory is page-aligned so the JAX runtime
+// can DMA straight out of it (jax.device_put on a numpy view of the slot).
+//
+// C ABI (ctypes-friendly), prefix tr_ring_.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+struct TrRing {
+    uint8_t *slots;          // n_slots * slot_bytes, page aligned
+    int64_t *lens;           // payload length per slot
+    int64_t *meta;           // user metadata (pts) per slot
+    size_t slot_bytes;
+    size_t n_slots;
+    std::atomic<uint64_t> head;  // next slot to write (producer)
+    std::atomic<uint64_t> tail;  // next slot to read (consumer)
+    std::atomic<uint64_t> dropped;
+};
+
+TrRing *tr_ring_create(size_t slot_bytes, size_t n_slots) {
+    if (n_slots < 2 || slot_bytes == 0) return nullptr;
+    auto *r = new TrRing();
+    // page-align slot storage for DMA friendliness
+    if (posix_memalign(reinterpret_cast<void **>(&r->slots), 4096,
+                       slot_bytes * n_slots) != 0) {
+        delete r;
+        return nullptr;
+    }
+    r->lens = static_cast<int64_t *>(calloc(n_slots, sizeof(int64_t)));
+    r->meta = static_cast<int64_t *>(calloc(n_slots, sizeof(int64_t)));
+    r->slot_bytes = slot_bytes;
+    r->n_slots = n_slots;
+    r->head.store(0);
+    r->tail.store(0);
+    r->dropped.store(0);
+    return r;
+}
+
+void tr_ring_destroy(TrRing *r) {
+    if (!r) return;
+    free(r->slots);
+    free(r->lens);
+    free(r->meta);
+    delete r;
+}
+
+// Producer: copy a frame in. Returns 1 on success, 0 when full (frame
+// dropped — real-time semantics: newest-frame-wins policy is the CALLER's
+// choice via tr_ring_push_latest below).
+int tr_ring_try_push(TrRing *r, const uint8_t *data, int64_t len, int64_t meta) {
+    if (!r || len < 0 || static_cast<size_t>(len) > r->slot_bytes) return 0;
+    uint64_t head = r->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->tail.load(std::memory_order_acquire);
+    if (head - tail >= r->n_slots) {
+        r->dropped.fetch_add(1, std::memory_order_relaxed);
+        return 0;  // full
+    }
+    size_t idx = head % r->n_slots;
+    memcpy(r->slots + idx * r->slot_bytes, data, static_cast<size_t>(len));
+    r->lens[idx] = len;
+    r->meta[idx] = meta;
+    r->head.store(head + 1, std::memory_order_release);
+    return 1;
+}
+
+// Producer: push, evicting the oldest frame when full (live-stream policy:
+// prefer freshness over completeness).
+int tr_ring_push_latest(TrRing *r, const uint8_t *data, int64_t len, int64_t meta) {
+    if (tr_ring_try_push(r, data, len, meta)) return 1;
+    // consumer lags: advance tail by one (single-producer safe: consumer may
+    // concurrently pop; compare_exchange keeps us honest)
+    uint64_t tail = r->tail.load(std::memory_order_acquire);
+    r->tail.compare_exchange_strong(tail, tail + 1, std::memory_order_acq_rel);
+    return tr_ring_try_push(r, data, len, meta);
+}
+
+// Consumer: copy the next frame out. Returns payload length, or -1 if empty.
+int64_t tr_ring_try_pop(TrRing *r, uint8_t *out, int64_t cap, int64_t *meta) {
+    if (!r) return -1;
+    uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->head.load(std::memory_order_acquire);
+    if (tail == head) return -1;  // empty
+    size_t idx = tail % r->n_slots;
+    int64_t len = r->lens[idx];
+    if (len > cap) return -2;
+    memcpy(out, r->slots + idx * r->slot_bytes, static_cast<size_t>(len));
+    if (meta) *meta = r->meta[idx];
+    r->tail.store(tail + 1, std::memory_order_release);
+    return len;
+}
+
+// Consumer zero-copy variant: borrow a pointer to the slot (valid until the
+// next pop); numpy can wrap it without copying.
+const uint8_t *tr_ring_peek(TrRing *r, int64_t *len, int64_t *meta) {
+    if (!r) return nullptr;
+    uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->head.load(std::memory_order_acquire);
+    if (tail == head) return nullptr;
+    size_t idx = tail % r->n_slots;
+    if (len) *len = r->lens[idx];
+    if (meta) *meta = r->meta[idx];
+    return r->slots + idx * r->slot_bytes;
+}
+
+void tr_ring_pop_advance(TrRing *r) {
+    uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    r->tail.store(tail + 1, std::memory_order_release);
+}
+
+int64_t tr_ring_size(TrRing *r) {
+    return static_cast<int64_t>(r->head.load(std::memory_order_acquire) -
+                                r->tail.load(std::memory_order_acquire));
+}
+
+int64_t tr_ring_dropped(TrRing *r) {
+    return static_cast<int64_t>(r->dropped.load(std::memory_order_relaxed));
+}
+
+}  // extern "C"

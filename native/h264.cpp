@@ -1,0 +1,373 @@
+// Host-CPU H.264 encode/decode via dlopen'd libavcodec/libswscale.
+//
+// TPU-native replacement for the reference's NVENC/NVDEC paths
+// (PyNvVideoCodec inside the aiortc fork — SURVEY.md L0 items 2/3): on TPU
+// VMs video codecs run on the host CPU; this shim talks straight to the
+// distro's libavcodec through dlopen so the framework has NO build-time
+// ffmpeg dependency (headers are not vendored; a minimal, version-gated
+// struct prefix mirror is used instead — see the ABI note below).
+//
+// ABI note: we poke width/height/pix_fmt/time_base directly into
+// AVCodecContext and read data/linesize/width/height/format/pts from
+// AVFrame/AVPacket.  These prefixes are stable within a libavcodec major
+// version; tr_h264_available() therefore HARD-GATES on major 59 / libavutil
+// 57 (ffmpeg 5.x, Debian 12) and the python layer falls back to the null
+// codec anywhere else.  Everything tunable (bitrate "b", gop "g", preset,
+// tune) goes through the av_opt API, which is ABI-stable.
+//
+// C ABI, prefix tr_h264_.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dlfcn.h>
+
+namespace {
+
+struct AVRational {
+    int num, den;
+};
+
+// --- minimal struct prefix mirrors (libavcodec 59 / libavutil 57) ---------
+
+struct AVCodecContext59 {
+    const void *av_class;
+    int log_level_offset;
+    int codec_type;
+    const void *codec;
+    int codec_id;
+    unsigned int codec_tag;
+    void *priv_data;
+    void *internal;
+    void *opaque;
+    int64_t bit_rate;
+    int bit_rate_tolerance;
+    int global_quality;
+    int compression_level;
+    int flags;
+    int flags2;
+    uint8_t *extradata;
+    int extradata_size;
+    AVRational time_base;
+    int ticks_per_frame;
+    int delay;
+    int width, height;
+    int coded_width, coded_height;
+    int gop_size;
+    int pix_fmt;
+    // ... rest intentionally omitted (never touched)
+};
+
+struct AVFrame57 {
+    uint8_t *data[8];
+    int linesize[8];
+    uint8_t **extended_data;
+    int width, height;
+    int nb_samples;
+    int format;
+    int key_frame;
+    int pict_type;
+    AVRational sample_aspect_ratio;
+    int64_t pts;
+    // ... rest omitted
+};
+
+struct AVPacket59 {
+    void *buf;
+    int64_t pts;
+    int64_t dts;
+    uint8_t *data;
+    int size;
+    int stream_index;
+    int flags;
+    void *side_data;
+    int side_data_elems;
+    int64_t duration;
+    int64_t pos;
+    // ... rest omitted
+};
+
+constexpr int AV_CODEC_ID_H264 = 27;
+constexpr int AV_PIX_FMT_YUV420P = 0;
+constexpr int AV_PIX_FMT_RGB24 = 2;
+constexpr int AVERROR_EAGAIN = -11;   // -EAGAIN on linux
+constexpr int AVERROR_EOF_ = -541478725;  // FFERRTAG('E','O','F',' ')
+constexpr int SWS_BILINEAR = 2;
+
+// --- dlopen'd entry points -------------------------------------------------
+
+struct Libs {
+    void *avcodec = nullptr;
+    void *avutil = nullptr;
+    void *swscale = nullptr;
+
+    unsigned (*avcodec_version)();
+    unsigned (*avutil_version)();
+    const void *(*avcodec_find_encoder)(int);
+    const void *(*avcodec_find_decoder)(int);
+    AVCodecContext59 *(*avcodec_alloc_context3)(const void *);
+    void (*avcodec_free_context)(AVCodecContext59 **);
+    int (*avcodec_open2)(AVCodecContext59 *, const void *, void *);
+    int (*avcodec_send_frame)(AVCodecContext59 *, const AVFrame57 *);
+    int (*avcodec_receive_packet)(AVCodecContext59 *, AVPacket59 *);
+    int (*avcodec_send_packet)(AVCodecContext59 *, const AVPacket59 *);
+    int (*avcodec_receive_frame)(AVCodecContext59 *, AVFrame57 *);
+    AVPacket59 *(*av_packet_alloc)();
+    void (*av_packet_free)(AVPacket59 **);
+    void (*av_packet_unref)(AVPacket59 *);
+    AVFrame57 *(*av_frame_alloc)();
+    void (*av_frame_free)(AVFrame57 **);
+    int (*av_frame_get_buffer)(AVFrame57 *, int);
+    int (*av_frame_make_writable)(AVFrame57 *);
+    int (*av_opt_set)(void *, const char *, const char *, int);
+    void *(*sws_getContext)(int, int, int, int, int, int, int, void *, void *,
+                            const double *);
+    void (*sws_freeContext)(void *);
+    int (*sws_scale)(void *, const uint8_t *const[], const int[], int, int,
+                     uint8_t *const[], const int[]);
+    bool ok = false;
+};
+
+Libs *load_libs() {
+    static Libs libs;
+    static bool tried = false;
+    if (tried) return libs.ok ? &libs : nullptr;
+    tried = true;
+    libs.avcodec = dlopen("libavcodec.so.59", RTLD_NOW | RTLD_GLOBAL);
+    libs.avutil = dlopen("libavutil.so.57", RTLD_NOW | RTLD_GLOBAL);
+    libs.swscale = dlopen("libswscale.so.6", RTLD_NOW | RTLD_GLOBAL);
+    if (!libs.avcodec || !libs.avutil || !libs.swscale) return nullptr;
+
+#define LOAD(lib, name)                                                      \
+    libs.name = reinterpret_cast<decltype(libs.name)>(dlsym(libs.lib, #name)); \
+    if (!libs.name) return nullptr;
+    LOAD(avcodec, avcodec_version)
+    LOAD(avutil, avutil_version)
+    LOAD(avcodec, avcodec_find_encoder)
+    LOAD(avcodec, avcodec_find_decoder)
+    LOAD(avcodec, avcodec_alloc_context3)
+    LOAD(avcodec, avcodec_free_context)
+    LOAD(avcodec, avcodec_open2)
+    LOAD(avcodec, avcodec_send_frame)
+    LOAD(avcodec, avcodec_receive_packet)
+    LOAD(avcodec, avcodec_send_packet)
+    LOAD(avcodec, avcodec_receive_frame)
+    LOAD(avcodec, av_packet_alloc)
+    LOAD(avcodec, av_packet_free)
+    LOAD(avcodec, av_packet_unref)
+    LOAD(avutil, av_frame_alloc)
+    LOAD(avutil, av_frame_free)
+    LOAD(avutil, av_frame_get_buffer)
+    LOAD(avutil, av_frame_make_writable)
+    LOAD(avutil, av_opt_set)
+    LOAD(swscale, sws_getContext)
+    LOAD(swscale, sws_freeContext)
+    LOAD(swscale, sws_scale)
+#undef LOAD
+
+    // ABI gate: struct prefix mirrors above are only valid for these majors
+    if ((libs.avcodec_version() >> 16) != 59) return nullptr;
+    if ((libs.avutil_version() >> 16) != 57) return nullptr;
+    libs.ok = true;
+    return &libs;
+}
+
+struct Encoder {
+    Libs *L;
+    AVCodecContext59 *ctx = nullptr;
+    AVFrame57 *frame = nullptr;
+    AVPacket59 *pkt = nullptr;
+    void *sws = nullptr;  // rgb24 -> yuv420p
+    int w, h;
+    int64_t frame_index = 0;
+};
+
+struct Decoder {
+    Libs *L;
+    AVCodecContext59 *ctx = nullptr;
+    AVFrame57 *frame = nullptr;
+    AVPacket59 *pkt = nullptr;
+    void *sws = nullptr;  // yuv -> rgb24
+    int sws_w = 0, sws_h = 0, sws_fmt = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+int tr_h264_available() { return load_libs() != nullptr; }
+
+// ---------------------------------------------------------------------------
+// encoder
+// ---------------------------------------------------------------------------
+
+Encoder *tr_h264_encoder_create(int w, int h, int fps_num, int fps_den,
+                                int64_t bitrate, int gop, const char *preset,
+                                const char *tune) {
+    Libs *L = load_libs();
+    if (!L) return nullptr;
+    const void *codec = L->avcodec_find_encoder(AV_CODEC_ID_H264);
+    if (!codec) return nullptr;
+    auto *e = new Encoder();
+    e->L = L;
+    e->w = w;
+    e->h = h;
+    e->ctx = L->avcodec_alloc_context3(codec);
+    e->ctx->width = w;
+    e->ctx->height = h;
+    e->ctx->pix_fmt = AV_PIX_FMT_YUV420P;
+    e->ctx->time_base = {fps_den, fps_num};
+    char buf[32];
+    snprintf(buf, sizeof buf, "%lld", static_cast<long long>(bitrate));
+    L->av_opt_set(e->ctx, "b", buf, 0);
+    snprintf(buf, sizeof buf, "%d", gop);
+    L->av_opt_set(e->ctx, "g", buf, 0);
+    // zero-latency tuning (the ENC_PRESET/ENC_TUNING_INFO control surface —
+    // parity with the reference's NVENC_PRESET/NVENC_TUNING_INFO,
+    // docs/environment.md:17-25)
+    if (e->ctx->priv_data) {
+        L->av_opt_set(e->ctx->priv_data, "preset", preset ? preset : "ultrafast", 0);
+        L->av_opt_set(e->ctx->priv_data, "tune", tune ? tune : "zerolatency", 0);
+    }
+    if (L->avcodec_open2(e->ctx, codec, nullptr) < 0) {
+        L->avcodec_free_context(&e->ctx);
+        delete e;
+        return nullptr;
+    }
+    e->frame = L->av_frame_alloc();
+    e->frame->width = w;
+    e->frame->height = h;
+    e->frame->format = AV_PIX_FMT_YUV420P;
+    if (L->av_frame_get_buffer(e->frame, 32) < 0) {
+        delete e;
+        return nullptr;
+    }
+    e->pkt = L->av_packet_alloc();
+    e->sws = L->sws_getContext(w, h, AV_PIX_FMT_RGB24, w, h, AV_PIX_FMT_YUV420P,
+                               SWS_BILINEAR, nullptr, nullptr, nullptr);
+    return e;
+}
+
+// Encode one RGB24 frame (w*h*3 bytes). Writes annex-B bytes to out.
+// Returns bytes written (0 = encoder buffering), <0 on error.
+int64_t tr_h264_encode(Encoder *e, const uint8_t *rgb, int64_t pts,
+                       uint8_t *out, int64_t cap, int *is_key) {
+    Libs *L = e->L;
+    int ret;
+    if (rgb) {
+        L->av_frame_make_writable(e->frame);
+        const uint8_t *src[1] = {rgb};
+        const int src_stride[1] = {e->w * 3};
+        L->sws_scale(e->sws, src, src_stride, 0, e->h, e->frame->data,
+                     e->frame->linesize);
+        e->frame->pts = pts >= 0 ? pts : e->frame_index;
+        e->frame_index++;
+        ret = L->avcodec_send_frame(e->ctx, e->frame);
+    } else {
+        ret = L->avcodec_send_frame(e->ctx, nullptr);  // flush
+    }
+    if (ret < 0 && ret != AVERROR_EAGAIN) return ret;
+
+    int64_t written = 0;
+    while (true) {
+        ret = L->avcodec_receive_packet(e->ctx, e->pkt);
+        if (ret == AVERROR_EAGAIN || ret == AVERROR_EOF_) break;
+        if (ret < 0) return ret;
+        if (written + e->pkt->size > cap) {
+            L->av_packet_unref(e->pkt);
+            return -1000;  // caller buffer too small
+        }
+        memcpy(out + written, e->pkt->data, e->pkt->size);
+        written += e->pkt->size;
+        if (is_key) *is_key = (e->pkt->flags & 1) ? 1 : 0;  // AV_PKT_FLAG_KEY
+        L->av_packet_unref(e->pkt);
+    }
+    return written;
+}
+
+void tr_h264_encoder_destroy(Encoder *e) {
+    if (!e) return;
+    Libs *L = e->L;
+    if (e->sws) L->sws_freeContext(e->sws);
+    if (e->pkt) L->av_packet_free(&e->pkt);
+    if (e->frame) L->av_frame_free(&e->frame);
+    if (e->ctx) L->avcodec_free_context(&e->ctx);
+    delete e;
+}
+
+// ---------------------------------------------------------------------------
+// decoder
+// ---------------------------------------------------------------------------
+
+Decoder *tr_h264_decoder_create() {
+    Libs *L = load_libs();
+    if (!L) return nullptr;
+    const void *codec = L->avcodec_find_decoder(AV_CODEC_ID_H264);
+    if (!codec) return nullptr;
+    auto *d = new Decoder();
+    d->L = L;
+    d->ctx = L->avcodec_alloc_context3(codec);
+    if (L->avcodec_open2(d->ctx, codec, nullptr) < 0) {
+        L->avcodec_free_context(&d->ctx);
+        delete d;
+        return nullptr;
+    }
+    d->frame = L->av_frame_alloc();
+    d->pkt = L->av_packet_alloc();
+    return d;
+}
+
+// Feed one annex-B access unit; if a frame comes out, convert to RGB24.
+// Returns bytes written to rgb_out (w*h*3), 0 if buffering, <0 on error.
+int64_t tr_h264_decode(Decoder *d, const uint8_t *data, int64_t size,
+                       int64_t pts, uint8_t *rgb_out, int64_t cap, int *w_out,
+                       int *h_out, int64_t *pts_out) {
+    Libs *L = d->L;
+    int ret;
+    if (data && size > 0) {
+        d->pkt->data = const_cast<uint8_t *>(data);
+        d->pkt->size = static_cast<int>(size);
+        d->pkt->pts = pts;
+        ret = L->avcodec_send_packet(d->ctx, d->pkt);
+        d->pkt->data = nullptr;
+        d->pkt->size = 0;
+        if (ret < 0 && ret != AVERROR_EAGAIN) return ret;
+    } else {
+        L->avcodec_send_packet(d->ctx, nullptr);  // flush
+    }
+
+    ret = L->avcodec_receive_frame(d->ctx, d->frame);
+    if (ret == AVERROR_EAGAIN || ret == AVERROR_EOF_) return 0;
+    if (ret < 0) return ret;
+
+    int w = d->frame->width, h = d->frame->height, fmt = d->frame->format;
+    if (static_cast<int64_t>(w) * h * 3 > cap) return -1000;
+    if (!d->sws || d->sws_w != w || d->sws_h != h || d->sws_fmt != fmt) {
+        if (d->sws) L->sws_freeContext(d->sws);
+        d->sws = L->sws_getContext(w, h, fmt, w, h, AV_PIX_FMT_RGB24,
+                                   SWS_BILINEAR, nullptr, nullptr, nullptr);
+        d->sws_w = w;
+        d->sws_h = h;
+        d->sws_fmt = fmt;
+    }
+    uint8_t *dst[1] = {rgb_out};
+    const int dst_stride[1] = {w * 3};
+    L->sws_scale(d->sws, d->frame->data, d->frame->linesize, 0, h, dst,
+                 dst_stride);
+    if (w_out) *w_out = w;
+    if (h_out) *h_out = h;
+    if (pts_out) *pts_out = d->frame->pts;
+    return static_cast<int64_t>(w) * h * 3;
+}
+
+void tr_h264_decoder_destroy(Decoder *d) {
+    if (!d) return;
+    Libs *L = d->L;
+    if (d->sws) L->sws_freeContext(d->sws);
+    if (d->pkt) L->av_packet_free(&d->pkt);
+    if (d->frame) L->av_frame_free(&d->frame);
+    if (d->ctx) L->avcodec_free_context(&d->ctx);
+    delete d;
+}
+
+}  // extern "C"

@@ -1,0 +1,242 @@
+// RFC 6184 H.264 RTP packetization / depacketization (dependency-free C++).
+//
+// The reference delegates its entire RTP layer to the aiortc fork (SURVEY.md
+// L3); this is the native-runtime equivalent for the TPU build's media plane:
+// Annex-B access units <-> RTP packets with single-NAL and FU-A modes
+// (STAP-A on receive).  Jitter handling lives in the caller; this layer is
+// pure (de)framing.
+//
+// C ABI, prefix tr_rtp_.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr size_t kRtpHeader = 12;
+constexpr uint8_t kFuA = 28;
+constexpr uint8_t kStapA = 24;
+
+void write_be16(uint8_t *p, uint16_t v) {
+    p[0] = v >> 8;
+    p[1] = v & 0xff;
+}
+void write_be32(uint8_t *p, uint32_t v) {
+    p[0] = v >> 24;
+    p[1] = (v >> 16) & 0xff;
+    p[2] = (v >> 8) & 0xff;
+    p[3] = v & 0xff;
+}
+uint16_t read_be16(const uint8_t *p) { return (uint16_t(p[0]) << 8) | p[1]; }
+uint32_t read_be32(const uint8_t *p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) | (uint32_t(p[2]) << 8) |
+           p[3];
+}
+
+struct Packetizer {
+    uint16_t seq = 0;
+    uint32_t ssrc = 0;
+    uint8_t payload_type = 96;
+    size_t mtu = 1200;
+};
+
+struct Depacketizer {
+    std::vector<uint8_t> au;        // accumulating access unit (annex-B)
+    std::vector<uint8_t> fua;       // in-flight FU-A NAL
+    uint32_t ts = 0;
+    bool have_au = false;
+    std::vector<uint8_t> ready;     // completed AU
+    uint32_t ready_ts = 0;
+    bool ready_flag = false;
+};
+
+void emit_nal(Depacketizer *d, const uint8_t *nal, size_t len) {
+    static const uint8_t start[4] = {0, 0, 0, 1};
+    d->au.insert(d->au.end(), start, start + 4);
+    d->au.insert(d->au.end(), nal, nal + len);
+}
+
+// iterate annex-B start codes
+const uint8_t *next_start(const uint8_t *p, const uint8_t *end, int *sc_len) {
+    for (const uint8_t *q = p; q + 3 <= end; ++q) {
+        if (q[0] == 0 && q[1] == 0) {
+            if (q[2] == 1) {
+                *sc_len = 3;
+                return q;
+            }
+            if (q + 4 <= end && q[2] == 0 && q[3] == 1) {
+                *sc_len = 4;
+                return q;
+            }
+        }
+    }
+    return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+Packetizer *tr_rtp_packetizer_create(uint32_t ssrc, uint8_t payload_type,
+                                     int32_t mtu) {
+    auto *p = new Packetizer();
+    p->ssrc = ssrc;
+    p->payload_type = payload_type;
+    if (mtu > 64) p->mtu = static_cast<size_t>(mtu);
+    return p;
+}
+
+void tr_rtp_packetizer_destroy(Packetizer *p) { delete p; }
+
+// Packetize one annex-B access unit. Output: length-prefixed packets
+// [u32 len][packet bytes]... written into out (cap bytes).  Returns total
+// bytes written or -1 on overflow.  marker bit set on the AU's last packet.
+int64_t tr_rtp_packetize(Packetizer *p, const uint8_t *au, int64_t au_len,
+                         uint32_t timestamp, uint8_t *out, int64_t cap) {
+    // split into NALs
+    std::vector<std::pair<const uint8_t *, size_t>> nals;
+    const uint8_t *end = au + au_len;
+    int sc = 0;
+    const uint8_t *cur = next_start(au, end, &sc);
+    while (cur) {
+        const uint8_t *nal = cur + sc;
+        int sc2 = 0;
+        const uint8_t *nxt = next_start(nal, end, &sc2);
+        size_t len = (nxt ? static_cast<size_t>(nxt - nal)
+                          : static_cast<size_t>(end - nal));
+        if (len > 0) nals.emplace_back(nal, len);
+        cur = nxt;
+        sc = sc2;  // start-code length of the NEXT NAL, not the previous one
+    }
+    if (nals.empty()) return 0;
+
+    int64_t written = 0;
+    auto put_packet = [&](const uint8_t *payload, size_t plen, bool marker,
+                          const uint8_t *hdr2, size_t hdr2_len) -> bool {
+        size_t total = 4 + kRtpHeader + hdr2_len + plen;
+        if (written + static_cast<int64_t>(total) > cap) return false;
+        uint8_t *q = out + written;
+        write_be32(q, static_cast<uint32_t>(kRtpHeader + hdr2_len + plen));
+        q += 4;
+        q[0] = 0x80;  // V=2
+        q[1] = (marker ? 0x80 : 0x00) | p->payload_type;
+        write_be16(q + 2, p->seq++);
+        write_be32(q + 4, timestamp);
+        write_be32(q + 8, p->ssrc);
+        q += kRtpHeader;
+        if (hdr2_len) {
+            memcpy(q, hdr2, hdr2_len);
+            q += hdr2_len;
+        }
+        memcpy(q, payload, plen);
+        written += static_cast<int64_t>(total);
+        return true;
+    };
+
+    size_t max_payload = p->mtu - kRtpHeader;
+    for (size_t i = 0; i < nals.size(); ++i) {
+        const uint8_t *nal = nals[i].first;
+        size_t len = nals[i].second;
+        bool last_nal = (i + 1 == nals.size());
+        if (len <= max_payload) {
+            if (!put_packet(nal, len, last_nal, nullptr, 0)) return -1;
+        } else {
+            // FU-A fragmentation
+            uint8_t nal_hdr = nal[0];
+            uint8_t fu_ind = (nal_hdr & 0xe0) | kFuA;
+            const uint8_t *pos = nal + 1;
+            size_t rem = len - 1;
+            bool first = true;
+            while (rem > 0) {
+                size_t chunk = rem < (max_payload - 2) ? rem : (max_payload - 2);
+                bool final_frag = (chunk == rem);
+                uint8_t fu_hdr = static_cast<uint8_t>(
+                    (first ? 0x80 : 0x00) | (final_frag ? 0x40 : 0x00) |
+                    (nal_hdr & 0x1f));
+                uint8_t hdr2[2] = {fu_ind, fu_hdr};
+                if (!put_packet(pos, chunk, last_nal && final_frag, hdr2, 2))
+                    return -1;
+                pos += chunk;
+                rem -= chunk;
+                first = false;
+            }
+        }
+    }
+    return written;
+}
+
+Depacketizer *tr_rtp_depacketizer_create() { return new Depacketizer(); }
+void tr_rtp_depacketizer_destroy(Depacketizer *d) { delete d; }
+
+// Feed one RTP packet. Returns 1 when a complete access unit became ready.
+int tr_rtp_depacketize(Depacketizer *d, const uint8_t *pkt, int64_t len) {
+    if (len < static_cast<int64_t>(kRtpHeader)) return 0;
+    bool marker = (pkt[1] & 0x80) != 0;
+    uint32_t ts = read_be32(pkt + 4);
+    const uint8_t *payload = pkt + kRtpHeader;
+    size_t plen = static_cast<size_t>(len) - kRtpHeader;
+    if (plen == 0) return 0;
+
+    if (d->have_au && ts != d->ts && !d->au.empty()) {
+        // timestamp changed without marker: flush previous AU
+        d->ready = d->au;
+        d->ready_ts = d->ts;
+        d->ready_flag = true;
+        d->au.clear();
+    }
+    d->ts = ts;
+    d->have_au = true;
+
+    uint8_t nal_type = payload[0] & 0x1f;
+    if (nal_type == kFuA && plen >= 2) {
+        uint8_t fu_hdr = payload[1];
+        bool start = fu_hdr & 0x80, fin = fu_hdr & 0x40;
+        if (start) {
+            d->fua.clear();
+            uint8_t nal_hdr = (payload[0] & 0xe0) | (fu_hdr & 0x1f);
+            d->fua.push_back(nal_hdr);
+        }
+        d->fua.insert(d->fua.end(), payload + 2, payload + plen);
+        if (fin && !d->fua.empty()) {
+            emit_nal(d, d->fua.data(), d->fua.size());
+            d->fua.clear();
+        }
+    } else if (nal_type == kStapA) {
+        const uint8_t *q = payload + 1;
+        const uint8_t *end = payload + plen;
+        while (q + 2 <= end) {
+            uint16_t nlen = read_be16(q);
+            q += 2;
+            if (q + nlen > end) break;
+            emit_nal(d, q, nlen);
+            q += nlen;
+        }
+    } else {
+        emit_nal(d, payload, plen);
+    }
+
+    if (marker && !d->au.empty()) {
+        d->ready = d->au;
+        d->ready_ts = ts;
+        d->ready_flag = true;
+        d->au.clear();
+        return 1;
+    }
+    return d->ready_flag ? 1 : 0;
+}
+
+// Pop the completed AU (annex-B). Returns its length, or -1 if none / -2 if
+// cap too small.
+int64_t tr_rtp_get_au(Depacketizer *d, uint8_t *out, int64_t cap, uint32_t *ts) {
+    if (!d->ready_flag) return -1;
+    if (static_cast<int64_t>(d->ready.size()) > cap) return -2;
+    memcpy(out, d->ready.data(), d->ready.size());
+    if (ts) *ts = d->ready_ts;
+    d->ready_flag = false;
+    int64_t n = static_cast<int64_t>(d->ready.size());
+    d->ready.clear();
+    return n;
+}
+
+}  // extern "C"

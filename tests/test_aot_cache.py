@@ -1,0 +1,64 @@
+"""Engine cache tests: build, persist, reload without retracing."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ai_rtc_agent_tpu.aot.cache import EngineCache, engine_key
+
+
+def test_engine_key_discipline():
+    k = engine_key("stabilityai/sd-turbo", "img2img", batch=4, hw="512x512", dtype="bf16")
+    assert k.startswith("engines--stabilityai--sd-turbo")
+    assert "mode-img2img" in k and "batch-4" in k and "hw-512x512" in k
+    # distinct configs -> distinct keys (the reference's cache-key law)
+    assert k != engine_key("stabilityai/sd-turbo", "img2img", batch=2, hw="512x512", dtype="bf16")
+
+
+def test_build_and_reload(tmp_path):
+    cache = EngineCache(cache_dir=str(tmp_path))
+    trace_count = {"n": 0}
+
+    def f(x, y):
+        trace_count["n"] += 1
+        return x @ y + 1.0
+
+    x = np.ones((4, 8), np.float32)
+    y = np.ones((8, 4), np.float32)
+    call = cache.load_or_build("engines--test--mode-x", f, (x, y))
+    out = np.asarray(call(x, y))
+    np.testing.assert_allclose(out, x @ y + 1.0)
+    assert trace_count["n"] == 1
+
+    # second load: cache hit, no retrace of python fn
+    call2 = cache.load_or_build("engines--test--mode-x", f, (x, y))
+    out2 = np.asarray(call2(x, y))
+    np.testing.assert_allclose(out2, out)
+    assert trace_count["n"] == 1  # python fn never retraced
+
+    entries = cache.entries()
+    assert len(entries) == 1 and entries[0]["key"] == "engines--test--mode-x"
+
+
+def test_shape_change_is_new_engine(tmp_path):
+    cache = EngineCache(cache_dir=str(tmp_path))
+
+    def f(x):
+        return x * 2
+
+    c1 = cache.load_or_build("engines--t", f, (np.ones((2, 2), np.float32),))
+    c2 = cache.load_or_build("engines--t", f, (np.ones((4, 4), np.float32),))
+    assert np.asarray(c1(np.ones((2, 2), np.float32))).shape == (2, 2)
+    assert np.asarray(c2(np.ones((4, 4), np.float32))).shape == (4, 4)
+
+
+def test_pytree_args(tmp_path):
+    cache = EngineCache(cache_dir=str(tmp_path))
+
+    def f(state, x):
+        return {"a": state["a"] + x}
+
+    state = {"a": jnp.ones((3,))}
+    call = cache.load_or_build("engines--tree", f, (state, jnp.ones((3,))))
+    out = call(state, jnp.ones((3,)))
+    np.testing.assert_allclose(np.asarray(out["a"]), 2 * np.ones((3,)))

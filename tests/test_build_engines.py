@@ -1,0 +1,18 @@
+"""AOT engine-build CLI on the tiny family (reference build.py parity)."""
+
+import os
+
+from ai_rtc_agent_tpu.assets.build_engines import build
+
+
+def test_build_engine_tiny(tmp_path, monkeypatch):
+    key = build("tiny-test", cache_dir=str(tmp_path))
+    d = os.path.join(tmp_path, key)
+    assert os.path.isdir(d)
+    blobs = [f for f in os.listdir(d) if f.endswith(".jaxexport")]
+    metas = [f for f in os.listdir(d) if f.endswith(".json")]
+    assert len(blobs) == 1 and len(metas) == 1
+
+    # second build: cache hit (no new blob)
+    build("tiny-test", cache_dir=str(tmp_path))
+    assert len([f for f in os.listdir(d) if f.endswith(".jaxexport")]) == 1

@@ -1,0 +1,126 @@
+"""Webhook eventing + TURN credential tests (reference lib/events.py,
+agent.py:80-120 parity)."""
+
+import asyncio
+import json
+
+import pytest
+
+from ai_rtc_agent_tpu.server import turn
+from ai_rtc_agent_tpu.server.events import (
+    StreamEndedEvent,
+    StreamEventHandler,
+    StreamStartedEvent,
+)
+
+
+def test_event_models_schema():
+    e = StreamStartedEvent(stream_id="s1", room_id="r1", timestamp=123)
+    d = e.model_dump()
+    assert d == {
+        "stream_id": "s1",
+        "room_id": "r1",
+        "timestamp": 123,
+        "event": "StreamStarted",
+    }
+    assert StreamEndedEvent(stream_id="s", room_id="r", timestamp=1).event == "StreamEnded"
+
+
+def test_handler_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("WEBHOOK_URL", raising=False)
+    monkeypatch.delenv("AUTH_TOKEN", raising=False)
+    h = StreamEventHandler()
+    assert h.handle_stream_started("s", "r") is None
+
+
+def test_handler_posts_with_bearer(monkeypatch):
+    monkeypatch.setenv("WEBHOOK_URL", "http://wh.example/hook")
+    monkeypatch.setenv("AUTH_TOKEN", "tok123")
+    posted = {}
+
+    class FakeResp:
+        status = 200
+
+    class FakeSession:
+        async def post(self, url, headers=None, json=None):
+            posted.update(url=url, headers=headers, body=json)
+            return FakeResp()
+
+    async def go():
+        h = StreamEventHandler(session_factory=FakeSession)
+        t = h.handle_stream_started("sid", "rid")
+        assert t is not None
+        await t
+
+    asyncio.run(go())
+    assert posted["url"] == "http://wh.example/hook"
+    assert posted["headers"]["Authorization"] == "Bearer tok123"
+    assert posted["body"]["event"] == "StreamStarted"
+    assert posted["body"]["stream_id"] == "sid"
+
+
+def test_unknown_event_raises():
+    h = StreamEventHandler()
+    with pytest.raises(ValueError):
+        h._event("Bogus", "s", "r")
+
+
+def test_twilio_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("TWILIO_ACCOUNT_SID", raising=False)
+    monkeypatch.delenv("TWILIO_AUTH_TOKEN", raising=False)
+    assert turn.get_twilio_token() is None
+    assert turn.get_ice_servers() == []
+
+
+def test_twilio_token_and_turn_filter(monkeypatch):
+    monkeypatch.setenv("TWILIO_ACCOUNT_SID", "AC123")
+    monkeypatch.setenv("TWILIO_AUTH_TOKEN", "secret")
+    seen = {}
+
+    def fake_post(url, headers):
+        seen["url"] = url
+        seen["auth"] = headers["Authorization"]
+        return 201, {
+            "ice_servers": [
+                {"url": "stun:stun.twilio.com", "urls": "stun:stun.twilio.com"},
+                {
+                    "url": "turn:turn.twilio.com?transport=udp",
+                    "urls": "turn:turn.twilio.com?transport=udp",
+                    "username": "u",
+                    "credential": "c",
+                },
+            ]
+        }
+
+    servers = turn.get_ice_servers(http_post=fake_post)
+    assert "AC123" in seen["url"]
+    assert seen["auth"].startswith("Basic ")
+    assert len(servers) == 1  # stun filtered out, turn kept
+    assert servers[0]["username"] == "u"
+
+    links = turn.get_link_headers(servers)
+    assert 'rel="ice-server"' in links[0]
+
+
+def test_udp_port_pinning(monkeypatch):
+    """patch_loop_datagram pins sockets to the operator's port list
+    (reference agent.py:32-69)."""
+    import socket
+
+    from ai_rtc_agent_tpu.server.agent import patch_loop_datagram
+
+    async def go():
+        patch_loop_datagram([19999])
+        loop = asyncio.get_event_loop()
+
+        class Proto(asyncio.DatagramProtocol):
+            pass
+
+        transport, _ = await loop.create_datagram_endpoint(
+            Proto, local_addr=("127.0.0.1", 0)
+        )
+        port = transport.get_extra_info("sockname")[1]
+        transport.close()
+        return port
+
+    assert asyncio.run(go()) == 19999

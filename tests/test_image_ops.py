@@ -7,15 +7,15 @@ from ai_rtc_agent_tpu.ops import image as I
 def test_preprocess_round_trip(rng):
     frame = rng.integers(0, 256, (32, 48, 3), dtype=np.uint8)
     x = I.preprocess_uint8(frame)
-    assert x.shape == (1, 3, 32, 48) and x.dtype == jnp.float32
+    assert x.shape == (1, 32, 48, 3) and x.dtype == jnp.float32
     assert float(x.max()) <= 1.0 and float(x.min()) >= 0.0
     back = np.asarray(I.postprocess_uint8(x))[0]
     np.testing.assert_array_equal(back, frame)
 
 
 def test_postprocess_clamps():
-    x = jnp.asarray(np.array([[-0.5, 0.0, 0.5, 1.0, 2.0]], np.float32))
-    x = x.reshape(1, 1, 1, 5).repeat(3, axis=1)
+    x = jnp.asarray(np.array([-0.5, 0.0, 0.5, 1.0, 2.0], np.float32))
+    x = x.reshape(1, 1, 5, 1).repeat(3, axis=3)
     out = np.asarray(I.postprocess_uint8(x))
     assert out.min() == 0 and out.max() == 255
     assert out[0, 0, 2, 0] == 128  # 0.5 -> round(127.5) = 128
@@ -23,18 +23,20 @@ def test_postprocess_clamps():
 
 def test_range_converters():
     x = jnp.asarray(np.linspace(0, 1, 5, dtype=np.float32))
-    np.testing.assert_allclose(np.asarray(I.to_unit_range(I.to_sym_range(x))), np.asarray(x), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(I.to_unit_range(I.to_sym_range(x))), np.asarray(x), atol=1e-6
+    )
 
 
 def test_resize_noop_and_shape(rng):
-    x = jnp.asarray(rng.standard_normal((1, 3, 16, 16)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((1, 16, 16, 3)).astype(np.float32))
     assert I.resize_bilinear(x, 16, 16) is x
     y = I.resize_bilinear(x, 8, 24)
-    assert y.shape == (1, 3, 8, 24)
+    assert y.shape == (1, 8, 24, 3)
 
 
 def test_similarity_identical_and_different(rng):
-    a = jnp.asarray(rng.random((1, 3, 32, 32)).astype(np.float32))
+    a = jnp.asarray(rng.random((1, 32, 32, 3)).astype(np.float32))
     b = jnp.asarray(1.0 - np.asarray(a))
     s_same = float(I.similarity(a, a)[0])
     s_diff = float(I.similarity(a, b)[0])

@@ -1,0 +1,131 @@
+"""Native media runtime tests: ring, RTP, H.264 roundtrip.
+
+These run without JAX (pure host-side), so they're fast.  The H.264 tests
+skip when the distro libavcodec isn't the gated 5.x ABI.
+"""
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.media import native
+from ai_rtc_agent_tpu.media.codec import NullCodec
+from ai_rtc_agent_tpu.media.ring import FrameRing
+
+
+def test_frame_ring_fifo(rng):
+    ring = FrameRing((4, 4, 3), n_slots=4)
+    frames = [rng.integers(0, 256, (4, 4, 3), dtype=np.uint8) for _ in range(3)]
+    for i, f in enumerate(frames):
+        assert ring.push_latest(f, meta=i)
+    assert ring.size == 3
+    for i, f in enumerate(frames):
+        got, meta = ring.pop()
+        np.testing.assert_array_equal(got, f)
+        assert meta == i
+    assert ring.pop() is None
+    ring.close()
+
+
+def test_frame_ring_latest_wins(rng):
+    ring = FrameRing((2, 2, 3), n_slots=2)
+    frames = [np.full((2, 2, 3), i, np.uint8) for i in range(5)]
+    for i, f in enumerate(frames):
+        ring.push_latest(f, meta=i)
+    # capacity 2: oldest evicted, newest retained
+    metas = []
+    while (item := ring.pop()) is not None:
+        metas.append(item[1])
+    assert metas[-1] == 4
+    assert len(metas) <= 2
+    assert ring.dropped >= 1
+    ring.close()
+
+
+def test_null_codec_roundtrip(rng):
+    f = rng.integers(0, 256, (16, 24, 3), dtype=np.uint8)
+    enc = NullCodec.encode(f, pts=77)
+    back, pts = NullCodec.decode(enc)
+    np.testing.assert_array_equal(back, f)
+    assert pts == 77
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    return lib
+
+
+def test_rtp_roundtrip_small_and_fua(native_lib):
+    from ai_rtc_agent_tpu.media.rtp import RtpDepacketizer, RtpPacketizer
+
+    rng = np.random.default_rng(0)
+    # fake annex-B AU: one small NAL + one large NAL (forces FU-A)
+    small = bytes([0x67]) + bytes(rng.integers(0, 255, 30, dtype=np.uint8))
+    large = bytes([0x65]) + bytes(rng.integers(0, 255, 5000, dtype=np.uint8))
+    au = b"\x00\x00\x00\x01" + small + b"\x00\x00\x01" + large
+
+    ptz = RtpPacketizer(mtu=1200)
+    packets = ptz.packetize(au, timestamp=9000)
+    assert len(packets) >= 1 + 5  # small NAL + >=5 FU-A fragments
+    # marker only on the last packet
+    markers = [bool(p[1] & 0x80) for p in packets]
+    assert markers[-1] and not any(markers[:-1])
+
+    dpz = RtpDepacketizer()
+    out = None
+    for p in packets:
+        r = dpz.push(p)
+        if r:
+            out = r
+    assert out is not None
+    got_au, ts = out
+    assert ts == 9000
+    # reassembled AU uses 4-byte start codes throughout
+    want = b"\x00\x00\x00\x01" + small + b"\x00\x00\x00\x01" + large
+    assert got_au == want
+
+
+def test_h264_encode_decode_roundtrip(native_lib):
+    if not native_lib.tr_h264_available():
+        pytest.skip("libavcodec 5.x not present")
+    from ai_rtc_agent_tpu.media.codec import H264Decoder, H264Encoder
+
+    w, h = 128, 96
+    enc = H264Encoder(w, h, fps=30)
+    dec = H264Decoder()
+
+    # moving gradient frames
+    frames = []
+    for i in range(8):
+        y, x = np.mgrid[0:h, 0:w]
+        f = np.stack(
+            [(x + 4 * i) % 256, (y + 2 * i) % 256, np.full_like(x, 128)], axis=-1
+        ).astype(np.uint8)
+        frames.append(f)
+
+    decoded = []
+    for i, f in enumerate(frames):
+        data = enc.encode(f, pts=i)
+        if data:
+            out = dec.decode(data, pts=i)
+            if out is not None:
+                decoded.append(out[0])
+    # drain both pipelines
+    data = enc.flush()
+    if data:
+        out = dec.decode(data)
+        if out is not None:
+            decoded.append(out[0])
+    while (out := dec.flush()) is not None:
+        decoded.append(out[0])
+
+    assert len(decoded) >= 4
+    d0 = decoded[0].astype(np.int16)
+    f0 = frames[0].astype(np.int16)
+    assert d0.shape == f0.shape
+    # lossy codec: mean abs error small on a smooth gradient
+    assert np.abs(d0 - f0).mean() < 16
+    enc.close()
+    dec.close()

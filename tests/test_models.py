@@ -1,0 +1,134 @@
+"""Tiny-config model tests on CPU (SURVEY.md section 4 'Integration' tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_rtc_agent_tpu.models import clip as C
+from ai_rtc_agent_tpu.models import controlnet as CN
+from ai_rtc_agent_tpu.models import taesd as T
+from ai_rtc_agent_tpu.models import unet as U
+
+
+def test_taesd_shapes_and_range(rng):
+    cfg = T.TAESDConfig.tiny()
+    params = T.init_taesd(jax.random.PRNGKey(0), cfg)
+    x = jnp.asarray(rng.random((2, 32, 32, 3)).astype(np.float32))
+    z = T.encode(params["encoder"], x, cfg)
+    assert z.shape == (2, 8, 8, 4)  # 2 stages -> /4
+    y = T.decode(params["decoder"], z, cfg)
+    assert y.shape == (2, 32, 32, 3)
+    assert float(y.min()) >= 0.0 and float(y.max()) <= 1.0
+
+
+def test_taesd_jit_compiles(rng):
+    cfg = T.TAESDConfig.tiny()
+    params = T.init_taesd(jax.random.PRNGKey(0), cfg)
+    f = jax.jit(lambda p, x: T.decode(p["decoder"], T.encode(p["encoder"], x, cfg), cfg))
+    y = f(params, jnp.zeros((1, 16, 16, 3)))
+    assert y.shape == (1, 16, 16, 3)
+
+
+def test_unet_tiny_forward(rng):
+    cfg = U.UNetConfig.tiny()
+    params = U.init_unet(jax.random.PRNGKey(1), cfg)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 4)).astype(np.float32))
+    t = jnp.array([999, 10])
+    ctx = jnp.asarray(rng.standard_normal((2, 7, 32)).astype(np.float32))
+    out = U.apply_unet(params, x, t, ctx, cfg)
+    assert out.shape == (2, 8, 8, 4)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_unet_sdxl_style_added_cond(rng):
+    cfg = U.UNetConfig.tiny_xl()
+    params = U.init_unet(jax.random.PRNGKey(2), cfg)
+    x = jnp.zeros((1, 8, 8, 4))
+    ctx = jnp.zeros((1, 7, 32))
+    added = {
+        "time_ids": jnp.asarray(np.array([[32, 32, 0, 0, 32, 32]], np.float32)),
+        "text_embeds": jnp.zeros((1, 16)),
+    }
+    out = U.apply_unet(params, x, jnp.array([999]), ctx, cfg, added_cond=added)
+    assert out.shape == (1, 8, 8, 4)
+    # missing added_cond must raise for text_time configs
+    import pytest
+
+    with pytest.raises(ValueError):
+        U.apply_unet(params, x, jnp.array([999]), ctx, cfg)
+
+
+def test_unet_timestep_sensitivity(rng):
+    cfg = U.UNetConfig.tiny()
+    params = U.init_unet(jax.random.PRNGKey(3), cfg)
+    x = jnp.asarray(rng.standard_normal((1, 8, 8, 4)).astype(np.float32))
+    ctx = jnp.asarray(rng.standard_normal((1, 7, 32)).astype(np.float32))
+    o1 = U.apply_unet(params, x, jnp.array([10]), ctx, cfg)
+    o2 = U.apply_unet(params, x, jnp.array([900]), ctx, cfg)
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_controlnet_zero_init_is_noop(rng):
+    cfg = U.UNetConfig.tiny()
+    unet_p = U.init_unet(jax.random.PRNGKey(4), cfg)
+    cn_p = CN.init_controlnet(jax.random.PRNGKey(5), cfg)
+    x = jnp.asarray(rng.standard_normal((1, 8, 8, 4)).astype(np.float32))
+    t = jnp.array([500])
+    ctx = jnp.asarray(rng.standard_normal((1, 7, 32)).astype(np.float32))
+    cond = jnp.asarray(rng.random((1, 64, 64, 3)).astype(np.float32))
+
+    down_res, mid_res = CN.apply_controlnet(cn_p, x, t, ctx, cond, cfg)
+    # zero convs: every residual must be exactly zero at init
+    for r in down_res + [mid_res]:
+        assert float(jnp.abs(r).max()) == 0.0
+
+    base = U.apply_unet(unet_p, x, t, ctx, cfg)
+    controlled = U.apply_unet(
+        unet_p, x, t, ctx, cfg, down_residuals=down_res, mid_residual=mid_res
+    )
+    np.testing.assert_allclose(np.asarray(base), np.asarray(controlled), atol=0)
+
+
+def test_canny_soft_edges(rng):
+    img = np.zeros((1, 32, 32, 3), np.float32)
+    img[:, :, 16:] = 1.0  # vertical step edge
+    e = np.asarray(CN.canny_soft(jnp.asarray(img)))
+    assert e.shape == (1, 32, 32, 3)
+    assert e[0, 16, 16, 0] > 0.9  # strong response at the edge
+    assert e[0, 16, 4, 0] < 0.1  # flat region quiet
+
+
+def test_clip_text_shapes_and_pooled(rng):
+    cfg = C.CLIPTextConfig.tiny()
+    params = C.init_clip_text(jax.random.PRNGKey(6), cfg)
+    ids = np.zeros((2, 16), np.int32)
+    ids[0, :5] = [10, 40, 30, 20, 255]  # eot = argmax = position 4
+    ids[1, :3] = [7, 255, 9]
+    out = C.apply_clip_text(params, jnp.asarray(ids), cfg)
+    assert out["hidden"].shape == (2, 16, 32)
+    assert out["pooled"].shape == (2, 32)
+
+
+def test_clip_causality(rng):
+    """Changing a later token must not affect earlier hidden states."""
+    cfg = C.CLIPTextConfig.tiny()
+    params = C.init_clip_text(jax.random.PRNGKey(7), cfg)
+    ids1 = np.ones((1, 8), np.int32) * 3
+    ids2 = ids1.copy()
+    ids2[0, 6] = 99
+    h1 = np.asarray(C.apply_clip_text(params, jnp.asarray(ids1), cfg)["hidden"])
+    h2 = np.asarray(C.apply_clip_text(params, jnp.asarray(ids2), cfg)["hidden"])
+    np.testing.assert_allclose(h1[0, :6], h2[0, :6], atol=1e-5)
+    assert not np.allclose(h1[0, 6:], h2[0, 6:])
+
+
+def test_clip_skip_penultimate():
+    cfg0 = C.CLIPTextConfig.tiny()
+    cfg1 = C.CLIPTextConfig(
+        vocab_size=256, max_length=16, width=32, layers=2, heads=4, clip_skip=1
+    )
+    params = C.init_clip_text(jax.random.PRNGKey(8), cfg0)
+    ids = jnp.asarray(np.ones((1, 8), np.int32))
+    h0 = np.asarray(C.apply_clip_text(params, ids, cfg0)["hidden"])
+    h1 = np.asarray(C.apply_clip_text(params, ids, cfg1)["hidden"])
+    assert not np.allclose(h0, h1)

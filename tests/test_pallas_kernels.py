@@ -1,0 +1,76 @@
+"""Pallas kernels vs XLA references (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.ops import lcm as L
+from ai_rtc_agent_tpu.ops import rcfg as R
+from ai_rtc_agent_tpu.ops import schedule as S
+from ai_rtc_agent_tpu.ops.pallas import attention as PA
+from ai_rtc_agent_tpu.ops.pallas import fused_scheduler as FS
+
+
+def _coeffs():
+    sch = S.make_schedule()
+    bt = S.batched_sub_timesteps([18, 26, 35, 45], 50)
+    return L.make_step_coeffs(sch, bt).as_jnp()
+
+
+@pytest.mark.parametrize("cfg_type", ["self", "none"])
+def test_fused_epilogue_matches_composed_ops(rng, cfg_type):
+    c = _coeffs()
+    shape = (4, 8, 8, 4)
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    eps_c = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    stock = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    noise = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    g, d = 1.5, 0.9
+
+    den, adv, stock_new = FS.fused_stream_epilogue(
+        x, eps_c, stock, noise, c, g, d, cfg_type, interpret=True
+    )
+
+    # composed reference path (ops/lcm + ops/rcfg)
+    if cfg_type == "self":
+        eps = R.combine_residual(eps_c, stock, g, d)
+    else:
+        eps = eps_c
+    den_ref = L.lcm_denoise(x, eps, c)
+    adv_ref = L.renoise_next(den_ref, noise, c)
+    np.testing.assert_allclose(np.asarray(den), np.asarray(den_ref), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(adv), np.asarray(adv_ref), rtol=1e-4, atol=1e-5)
+    if cfg_type == "self":
+        stock_ref = R.update_stock_noise(stock, eps_c, c.alpha, c.sigma)
+        np.testing.assert_allclose(
+            np.asarray(stock_new), np.asarray(stock_ref), rtol=1e-4, atol=1e-5
+        )
+    else:
+        np.testing.assert_allclose(np.asarray(stock_new), np.asarray(stock))
+
+
+def test_flash_attention_matches_dense(rng):
+    B, L_, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, L_, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, L_, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, L_, H, D)).astype(np.float32))
+    got = np.asarray(PA.flash_attention(q, k, v, block_q=32, block_k=32, interpret=True))
+    want = np.asarray(PA._xla_attention(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_ragged_falls_back(rng):
+    B, Lq, Lk, H, D = 1, 10, 7, 2, 8  # not divisible by blocks
+    q = jnp.asarray(rng.standard_normal((B, Lq, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, Lk, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, Lk, H, D)).astype(np.float32))
+    got = np.asarray(PA.flash_attention(q, k, v, block_q=8, block_k=8, interpret=True))
+    want = np.asarray(PA._xla_attention(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_rejects_mask(rng):
+    q = jnp.zeros((1, 8, 1, 4))
+    with pytest.raises(NotImplementedError):
+        PA.flash_attention(q, q, q, mask=jnp.zeros((8, 8)))

@@ -1,0 +1,54 @@
+"""Pipeline façade tests (reference lib/pipeline.py parity surface)."""
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.media.frames import VideoFrame
+from ai_rtc_agent_tpu.stream.pipeline import StreamDiffusionPipeline
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    return StreamDiffusionPipeline("tiny-test")
+
+
+def test_ndarray_path(pipe):
+    rng = np.random.default_rng(0)
+    f = rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+    out = pipe(f)
+    assert isinstance(out, np.ndarray)
+    assert out.shape == (64, 64, 3) and out.dtype == np.uint8
+
+
+def test_videoframe_path_preserves_pts(pipe):
+    from fractions import Fraction
+
+    rng = np.random.default_rng(1)
+    vf = VideoFrame.from_ndarray(rng.integers(0, 256, (64, 64, 3), dtype=np.uint8))
+    vf.pts = 12345
+    vf.time_base = Fraction(1, 90000)
+    out = pipe(vf)
+    assert isinstance(out, VideoFrame)
+    assert out.pts == 12345
+    assert out.time_base == Fraction(1, 90000)
+
+
+def test_mismatched_resolution_resized(pipe):
+    rng = np.random.default_rng(2)
+    f = rng.integers(0, 256, (48, 80, 3), dtype=np.uint8)
+    out = pipe(f)
+    assert out.shape == (64, 64, 3)
+
+
+def test_invalid_frame_type_raises(pipe):
+    with pytest.raises(TypeError):
+        pipe(object())
+
+
+def test_update_prompt_and_t_index(pipe):
+    pipe.update_prompt("new style")
+    assert pipe.prompt == "new style"
+    pipe.update_t_index_list([12, 22, 32, 42])
+    assert pipe.t_index_list == [12, 22, 32, 42]
+    with pytest.raises(ValueError):
+        pipe.update_t_index_list([1, 2, 3])

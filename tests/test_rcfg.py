@@ -47,5 +47,5 @@ def test_update_stock_noise_fixed_point(rng):
     stock = jnp.asarray(rng.standard_normal((2, 4, 2, 2)).astype(np.float32))
     alpha = jnp.asarray(np.array([0.9, 0.5], np.float32))
     sigma = jnp.asarray(np.array([0.436, 0.866], np.float32))
-    out = R.update_stock_noise(stock, stock, alpha, sigma, 1.0)
+    out = R.update_stock_noise(stock, stock, alpha, sigma)
     np.testing.assert_allclose(np.asarray(out), np.asarray(stock), rtol=1e-5)

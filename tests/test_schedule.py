@@ -28,6 +28,13 @@ def test_inference_timesteps_trailing_1_step_turbo():
     assert ts.tolist() == [999]
 
 
+def test_inference_timesteps_trailing_multi_step_descending():
+    # regression: multi-step trailing ladders must stay most-noisy-first
+    ts = S.inference_timesteps(4, spacing="trailing")
+    assert ts.tolist() == [999, 749, 499, 249]
+    assert (np.diff(ts) < 0).all()
+
+
 def test_sub_timesteps_reference_default():
     # reference default t_index_list [18,26,35,45] of 50 (lib/pipeline.py:12)
     st = S.sub_timesteps([18, 26, 35, 45], 50)
