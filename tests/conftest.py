@@ -15,6 +15,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# A sitecustomize hook may have imported jax already and pinned
+# jax_platforms to an accelerator plugin (e.g. the axon TPU tunnel) —
+# in that case the env var above is read too late, so force the config
+# directly.  Backend init of the plugin would otherwise hang the suite.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
