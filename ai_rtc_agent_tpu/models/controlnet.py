@@ -27,7 +27,18 @@ from .unet import (
 )
 
 
-def init_controlnet(key, cfg: UNetConfig, cond_channels: int = 3):
+def cond_embed_widths(num_down: int = 3) -> tuple:
+    """Conditioning-embedding channel ladder: ``num_down`` stride-2 convs
+    bring the cond image to latent resolution (2**num_down downsample).
+    num_down=3 gives (16,32,96,256) — exact diffusers
+    ControlNetConditioningEmbedding parity, so real checkpoints load."""
+    ladder = (16, 32, 96, 256)
+    if not 1 <= num_down <= len(ladder) - 1:
+        raise ValueError(f"num_down must be in [1,{len(ladder)-1}], got {num_down}")
+    return ladder[: num_down + 1]
+
+
+def init_controlnet(key, cfg: UNetConfig, cond_channels: int = 3, num_down: int = 3):
     """Params: encoder half of the UNet + cond embedding + zero convs."""
     k_unet, k_cond, k_zero = jax.random.split(key, 3)
     unet_p = init_unet(k_unet, cfg)
@@ -40,24 +51,23 @@ def init_controlnet(key, cfg: UNetConfig, cond_channels: int = 3):
     if "add_embedding" in unet_p:
         p["add_embedding"] = unet_p["add_embedding"]
 
-    # conditioning embedding: 3 -> 16 -> 32 -> 96 -> ch0 with 2x downsamples
-    # to latent resolution (8x), zero-init final conv
+    # conditioning embedding: 3 -> 16 -> 32 -> 96 -> 256 -> ch0 with three 2x
+    # downsamples to latent resolution (8x), zero-init final conv.  Channel
+    # widths match diffusers' ControlNetConditioningEmbedding exactly so real
+    # ControlNet checkpoints stream in via loader.controlnet_key_map.
     ch0 = cfg.block_out_channels[0]
-    widths = (16, 32, 96)
+    widths = cond_embed_widths(num_down)
     ks = jax.random.split(k_cond, len(widths) * 2 + 2)
     cond = {"conv_in": init_conv(ks[0], cond_channels, widths[0], 3), "blocks": []}
-    w_in = widths[0]
-    for i, w_out in enumerate(widths):
-        nxt = widths[i + 1] if i + 1 < len(widths) else ch0
+    for i in range(len(widths) - 1):
         cond["blocks"].append(
             {
-                "conv1": init_conv(ks[1 + 2 * i], w_in, w_out, 3),
-                "conv2": init_conv(ks[2 + 2 * i], w_out, nxt, 3),  # stride 2
+                "conv1": init_conv(ks[1 + 2 * i], widths[i], widths[i], 3),
+                "conv2": init_conv(ks[2 + 2 * i], widths[i], widths[i + 1], 3),  # stride 2
             }
         )
-        w_in = nxt
     cond["conv_out"] = {
-        "kernel": jnp.zeros((3, 3, ch0, ch0)),
+        "kernel": jnp.zeros((3, 3, widths[-1], ch0)),
         "bias": jnp.zeros((ch0,)),
     }
     p["cond_embedding"] = cond

@@ -144,6 +144,51 @@ def _transformer_keys(prefix: str, path: tuple, depth: int) -> Iterator[tuple[st
     yield from _leaf_keys(prefix + ".proj_out", path + ("proj_out",), "conv")
 
 
+def _encoder_keys(cfg: UNetConfig) -> Iterator[tuple[str, tuple]]:
+    """Shared encoder-half mapping: conv_in, time/add embeddings, down
+    blocks, mid block — identical between UNet2DConditionModel and
+    ControlNetModel in diffusers."""
+    yield from _leaf_keys("conv_in", ("conv_in",), "conv")
+    yield from _leaf_keys(
+        "time_embedding.linear_1", ("time_embedding", "linear_1"), "linear"
+    )
+    yield from _leaf_keys(
+        "time_embedding.linear_2", ("time_embedding", "linear_2"), "linear"
+    )
+    if cfg.addition_embed_type == "text_time":
+        yield from _leaf_keys(
+            "add_embedding.linear_1", ("add_embedding", "linear_1"), "linear"
+        )
+        yield from _leaf_keys(
+            "add_embedding.linear_2", ("add_embedding", "linear_2"), "linear"
+        )
+
+    nb = len(cfg.block_out_channels)
+    for i in range(nb):
+        base = f"down_blocks.{i}"
+        path = ("down_blocks", i)
+        for j in range(cfg.layers_per_block):
+            yield from _resnet_keys(f"{base}.resnets.{j}", path + ("resnets", j))
+            if cfg.attn_blocks[i]:
+                yield from _transformer_keys(
+                    f"{base}.attentions.{j}",
+                    path + ("attentions", j),
+                    cfg.transformer_layers_per_block[i],
+                )
+        if i < nb - 1:
+            yield from _leaf_keys(
+                f"{base}.downsamplers.0.conv", path + ("downsample",), "conv"
+            )
+
+    yield from _resnet_keys("mid_block.resnets.0", ("mid_block", "resnet1"))
+    yield from _transformer_keys(
+        "mid_block.attentions.0",
+        ("mid_block", "attention"),
+        cfg.transformer_layers_per_block[-1],
+    )
+    yield from _resnet_keys("mid_block.resnets.1", ("mid_block", "resnet2"))
+
+
 def unet_key_map(cfg: UNetConfig) -> dict[str, tuple]:
     m: dict[str, tuple] = {}
 
@@ -151,39 +196,8 @@ def unet_key_map(cfg: UNetConfig) -> dict[str, tuple]:
         for k, v in gen:
             m[k] = v
 
-    add(_leaf_keys("conv_in", ("conv_in",), "conv"))
-    add(_leaf_keys("time_embedding.linear_1", ("time_embedding", "linear_1"), "linear"))
-    add(_leaf_keys("time_embedding.linear_2", ("time_embedding", "linear_2"), "linear"))
-    if cfg.addition_embed_type == "text_time":
-        add(_leaf_keys("add_embedding.linear_1", ("add_embedding", "linear_1"), "linear"))
-        add(_leaf_keys("add_embedding.linear_2", ("add_embedding", "linear_2"), "linear"))
-
+    add(_encoder_keys(cfg))
     nb = len(cfg.block_out_channels)
-    for i in range(nb):
-        base = f"down_blocks.{i}"
-        path = ("down_blocks", i)
-        for j in range(cfg.layers_per_block):
-            add(_resnet_keys(f"{base}.resnets.{j}", path + ("resnets", j)))
-            if cfg.attn_blocks[i]:
-                add(
-                    _transformer_keys(
-                        f"{base}.attentions.{j}",
-                        path + ("attentions", j),
-                        cfg.transformer_layers_per_block[i],
-                    )
-                )
-        if i < nb - 1:
-            add(_leaf_keys(f"{base}.downsamplers.0.conv", path + ("downsample",), "conv"))
-
-    add(_resnet_keys("mid_block.resnets.0", ("mid_block", "resnet1")))
-    add(
-        _transformer_keys(
-            "mid_block.attentions.0",
-            ("mid_block", "attention"),
-            cfg.transformer_layers_per_block[-1],
-        )
-    )
-    add(_resnet_keys("mid_block.resnets.1", ("mid_block", "resnet2")))
 
     for k in range(nb):
         i = nb - 1 - k
@@ -204,6 +218,56 @@ def unet_key_map(cfg: UNetConfig) -> dict[str, tuple]:
 
     add(_leaf_keys("conv_norm_out", ("conv_norm_out",), "norm"))
     add(_leaf_keys("conv_out", ("conv_out",), "conv"))
+    return m
+
+
+def controlnet_key_map(cfg: UNetConfig, num_down: int = 3) -> dict[str, tuple]:
+    """diffusers ControlNetModel -> our controlnet tree (models/controlnet.py).
+
+    Encoder half shares the UNet naming (``_encoder_keys``); extras are the
+    conditioning embedding (flat ``blocks.{0..5}`` in diffusers vs our
+    per-stage conv1/conv2 pairs) and the zero convs
+    (``controlnet_down_blocks.{i}`` / ``controlnet_mid_block``).
+    ``num_down`` must match the init_controlnet value (3 = diffusers parity).
+    """
+    m: dict[str, tuple] = {}
+
+    def add(gen):
+        for k, v in gen:
+            m[k] = v
+
+    add(_encoder_keys(cfg))
+    nb = len(cfg.block_out_channels)
+
+    ce = "controlnet_cond_embedding"
+    add(_leaf_keys(f"{ce}.conv_in", ("cond_embedding", "conv_in"), "conv"))
+    # diffusers flat blocks [0..2s-1]: even = same-width conv1, odd = strided conv2
+    from .controlnet import cond_embed_widths
+
+    n_pairs = len(cond_embed_widths(num_down)) - 1
+    for s in range(n_pairs):
+        add(
+            _leaf_keys(
+                f"{ce}.blocks.{2 * s}",
+                ("cond_embedding", "blocks", s, "conv1"),
+                "conv",
+            )
+        )
+        add(
+            _leaf_keys(
+                f"{ce}.blocks.{2 * s + 1}",
+                ("cond_embedding", "blocks", s, "conv2"),
+                "conv",
+            )
+        )
+    add(_leaf_keys(f"{ce}.conv_out", ("cond_embedding", "conv_out"), "conv"))
+
+    n_skips = 1 + sum(
+        cfg.layers_per_block + (1 if i < nb - 1 else 0) for i in range(nb)
+    )
+    for i in range(n_skips):
+        add(_leaf_keys(f"controlnet_down_blocks.{i}", ("zero_convs", i), "conv"))
+    add(_leaf_keys("controlnet_mid_block", ("mid_zero_conv",), "conv"))
     return m
 
 

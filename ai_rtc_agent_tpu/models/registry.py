@@ -32,6 +32,7 @@ import numpy as np
 
 from ..stream.engine import StreamConfig, StreamModels
 from . import clip as C
+from . import controlnet as CN
 from . import loader as LD
 from . import lora as LR
 from . import taesd as T
@@ -133,7 +134,13 @@ def load_model_bundle(
     lora_dict: dict | None = None,
     dtype=jnp.float32,
     seed: int = 0,
+    controlnet: str | None = None,
+    latent_scale: int = 8,
 ) -> ModelBundle:
+    """``controlnet``: ControlNet model id / local path (e.g.
+    "lllyasviel/control_v11p_sd15_canny") — attaches a conditioned branch
+    (reference's ControlNet path, lib/wrapper.py:617-643).  ``latent_scale``
+    sets the annotator downsample depth (8 for SD, 4 for tiny tests)."""
     fam = family_of(model_id)
     unet_cfg, clip_cfg, taesd_cfg = _model_configs(fam)
     key = jax.random.PRNGKey(seed)
@@ -148,6 +155,18 @@ def load_model_bundle(
         params["clip2"] = C.init_clip_text(
             jax.random.fold_in(kc, 1), C.CLIPTextConfig.sdxl_g()
         )
+    if fam == "tiny":
+        latent_scale = 4
+    cnet_num_down = {8: 3, 4: 2, 2: 1}.get(latent_scale)
+    if controlnet is not None and cnet_num_down is None:
+        raise ValueError(
+            f"latent_scale {latent_scale} unsupported for controlnet "
+            "(must be 2, 4 or 8)"
+        )
+    if controlnet is not None:
+        params["controlnet"] = CN.init_controlnet(
+            jax.random.fold_in(ku, 7), unet_cfg, num_down=cnet_num_down
+        )
 
     snap = resolve_snapshot_dir(model_id)
     loaded = False
@@ -159,6 +178,31 @@ def load_model_bundle(
             "assets/download.py on a connected host)",
             model_id,
         )
+    if controlnet is not None:
+        cnet_snap = resolve_snapshot_dir(controlnet)
+        files = (
+            LD.find_safetensors(cnet_snap) or LD.find_safetensors(cnet_snap, "controlnet")
+            if cnet_snap
+            else []
+        )
+        if files:
+            sd: dict = {}
+            for f in files:
+                sd.update(LD.read_safetensors(f))
+            try:
+                params["controlnet"], n = LD.load_into_tree(
+                    params["controlnet"], sd,
+                    LD.controlnet_key_map(unet_cfg, cnet_num_down), dtype,
+                    strict=False,
+                )
+                logger.info("loaded %d tensors into controlnet", n)
+            except ValueError as e:
+                logger.warning("controlnet weight load failed: %s", e)
+        elif fam != "tiny":
+            logger.warning(
+                "no local weights for controlnet %s (snapshot=%s) — random init",
+                controlnet, cnet_snap,
+            )
 
     if lora_dict:
         km = LD.unet_key_map(unet_cfg)
@@ -178,8 +222,22 @@ def load_model_bundle(
 
     # ---- closures ---------------------------------------------------------
 
-    def unet_apply(p, x, t, ctx, added):
-        return U.apply_unet(p["unet"], x, t, ctx, unet_cfg, added_cond=added)
+    # Pallas flash attention on real TPUs (no [L,L] score matrix in HBM);
+    # plain XLA attention elsewhere (pallas interpret mode is slow on CPU)
+    attn_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+
+    def unet_apply(p, x, t, ctx, added, down_residuals=None, mid_residual=None):
+        return U.apply_unet(
+            p["unet"], x, t, ctx, unet_cfg, added_cond=added,
+            down_residuals=down_residuals, mid_residual=mid_residual,
+            attn_impl=attn_impl,
+        )
+
+    def controlnet_apply(p, x, t, ctx, cond_img, added, scale):
+        return CN.apply_controlnet(
+            p["controlnet"], x, t, ctx, cond_img, unet_cfg,
+            added_cond=added, conditioning_scale=scale, attn_impl=attn_impl,
+        )
 
     def vae_encode(p, img):
         return T.encode(p["taesd"]["encoder"], img, taesd_cfg)
@@ -214,7 +272,10 @@ def load_model_bundle(
     return ModelBundle(
         params=params,
         stream_models=StreamModels(
-            unet=unet_apply, vae_encode=vae_encode, vae_decode=vae_decode
+            unet=unet_apply,
+            vae_encode=vae_encode,
+            vae_decode=vae_decode,
+            controlnet=controlnet_apply if controlnet is not None else None,
         ),
         encode_prompt=encode_prompt,
         unet_cfg=unet_cfg,

@@ -171,10 +171,20 @@ def _init_transformer(key, ch: int, cfg: UNetConfig, depth: int, heads: int):
     return p
 
 
+class _KeyGen:
+    """Inexhaustible PRNG key stream (split-on-demand)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __next__(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+
 def init_unet(key, cfg: UNetConfig):
     nb = len(cfg.block_out_channels)
-    keys = jax.random.split(key, 6 + nb * 8)
-    ki = iter(keys)
+    ki = _KeyGen(key)
     ch0 = cfg.block_out_channels[0]
     p: dict = {
         "conv_in": init_conv(next(ki), cfg.in_channels, ch0, 3),

@@ -125,12 +125,26 @@ class MultiPeerEngine:
 
     def step_all(self, frames: np.ndarray) -> np.ndarray:
         """frames [P, H, W, 3] uint8 -> [P, H, W, 3] uint8 (all slots)."""
+        return self.fetch(self.submit(frames))
+
+    def submit(self, frames: np.ndarray):
+        """Dispatch one all-peers step without waiting (see engine.submit)."""
         if self.states is None:
             raise RuntimeError("call start() first")
         if frames.shape[0] != self.max_peers:
             raise ValueError(f"expected {self.max_peers} frame slots, got {frames.shape[0]}")
+        if isinstance(frames, np.ndarray):
+            # async upload before dispatch (same rationale as engine.submit)
+            frames = jax.device_put(frames)
         self.states, out = self._step(self.params, self.states, frames)
-        out = np.asarray(out)
+        try:
+            out.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return out
+
+    def fetch(self, pending) -> np.ndarray:
+        out = np.asarray(pending)
         if out.ndim == 5 and out.shape[1] == 1:  # [P, fbs=1, H, W, 3]
             out = out[:, 0]
         return out

@@ -27,6 +27,7 @@ import asyncio
 import json
 import logging
 import random
+import time
 import types
 import uuid
 from typing import List, Tuple
@@ -149,7 +150,7 @@ async def offer(request):
     def on_track(track):
         logger.info("Track received: %s", track.kind)
         if track.kind == "video":
-            video_track = VideoStreamTrack(track, _timed_pipeline(pipeline, stats))
+            video_track = VideoStreamTrack(track, _TimedPipeline(pipeline, stats))
             tracks["video"] = video_track
             sender = pc.addTrack(video_track)
             provider.force_codec(pc, sender, "video/H264")
@@ -276,7 +277,7 @@ async def whip(request):
         logger.info("Track received: %s", track.kind)
         if track.kind == "video":
             app["state"]["source_track"] = VideoStreamTrack(
-                track, _timed_pipeline(pipeline, stats)
+                track, _TimedPipeline(pipeline, stats)
             )
 
         @track.on("ended")
@@ -328,12 +329,32 @@ async def metrics(request):
     return web.json_response(request.app["stats"].snapshot())
 
 
-def _timed_pipeline(pipeline, stats: FrameStats):
-    def run(frame):
-        with stats.timed():
-            return pipeline(frame)
+class _TimedPipeline:
+    """Wraps a pipeline with per-frame fps/latency accounting.
 
-    return run
+    Forwards the submit/fetch pipelined surface when the underlying pipeline
+    has one, so VideoStreamTrack can keep PIPELINE_DEPTH frames in flight;
+    latency is measured submit->fetch (the true glass-to-glass slice)."""
+
+    def __init__(self, pipeline, stats: FrameStats):
+        self._pipeline = pipeline
+        self._stats = stats
+        if hasattr(pipeline, "submit"):
+            self.submit = self._submit
+            self.fetch = self._fetch
+
+    def __call__(self, frame):
+        with self._stats.timed():
+            return self._pipeline(frame)
+
+    def _submit(self, frame):
+        return self._pipeline.submit(frame), time.monotonic()
+
+    def _fetch(self, handle, src_frame=None):
+        inner, t_sub = handle
+        out = self._pipeline.fetch(inner, src_frame)
+        self._stats.record(time.monotonic() - t_sub)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -363,7 +384,9 @@ async def on_startup(app):
     if app.get("pipeline") is None:
         from ..stream.pipeline import StreamDiffusionPipeline
 
-        app["pipeline"] = StreamDiffusionPipeline(app["model_id"])
+        app["pipeline"] = StreamDiffusionPipeline(
+            app["model_id"], controlnet=app.get("controlnet")
+        )
     app["pcs"] = set()
     app["stream_event_handler"] = StreamEventHandler()
     app["state"] = {"source_track": None}
@@ -381,10 +404,12 @@ def build_app(
     udp_ports=None,
     pipeline=None,
     provider=None,
+    controlnet: str | None = None,
 ) -> web.Application:
     app = web.Application(middlewares=[cors_middleware])
     app["udp_ports"] = udp_ports
     app["model_id"] = model_id
+    app["controlnet"] = controlnet
     app["pipeline"] = pipeline  # injectable for tests; built on startup if None
     app["provider"] = provider or get_provider()
 
@@ -414,6 +439,11 @@ def main(argv=None):
         "--udp-ports", default=None, help="comma-separated UDP media ports"
     )
     parser.add_argument(
+        "--controlnet",
+        default=None,
+        help="optional ControlNet model id (enables canny-conditioned stream)",
+    )
+    parser.add_argument(
         "--log-level",
         default="INFO",
         choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
@@ -424,6 +454,7 @@ def main(argv=None):
     app = build_app(
         model_id=args.model_id,
         udp_ports=args.udp_ports.split(",") if args.udp_ports else None,
+        controlnet=args.controlnet,
     )
     web.run_app(app, host="0.0.0.0", port=args.port)
 

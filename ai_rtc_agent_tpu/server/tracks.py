@@ -12,12 +12,18 @@ reference lib/tracks.py:21-25) and the DROP_FRAMES OBS-stutter workaround
   TPU step can NEVER stall the event loop (the reference blocks its loop on
   GPU inference inside recv(), lib/tracks.py:24,38 — SURVEY.md hazard list).
   Ordering stays strict because recv() calls are serialized per track.
+* PIPELINE_DEPTH frames are kept in flight on the device (pipeline
+  submit/fetch): recv() submits the new frame, then fetches the result of
+  the frame submitted `depth` calls ago — dispatch, device compute and
+  readback overlap across consecutive frames, which is where the TPU's
+  throughput headroom lives.  depth=1 restores synchronous behavior.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+from collections import deque
 
 from ..utils import env
 
@@ -27,12 +33,18 @@ logger = logging.getLogger(__name__)
 class VideoStreamTrack:
     kind = "video"
 
-    def __init__(self, track, pipeline):
+    def __init__(self, track, pipeline, pipeline_depth: int | None = None):
         self.track = track
         self.pipeline = pipeline
         self.warmup_frame_idx = 0
         self.warmup_frames = env.warmup_frames()
         self.drop_frames = env.drop_frames()
+        self.pipeline_depth = (
+            env.pipeline_depth() if pipeline_depth is None else max(1, pipeline_depth)
+        )
+        if not hasattr(pipeline, "submit"):
+            self.pipeline_depth = 1
+        self._pending: deque = deque()
         self._handlers: dict = {}
 
     # minimal MediaStreamTrack event surface (works standalone and under
@@ -61,5 +73,14 @@ class VideoStreamTrack:
         for _ in range(self.drop_frames):
             await self.track.recv()
 
-        frame = await self.track.recv()
-        return await asyncio.to_thread(self.pipeline, frame)
+        if self.pipeline_depth == 1:
+            frame = await self.track.recv()
+            return await asyncio.to_thread(self.pipeline, frame)
+
+        # pipelined path: keep `depth` frames in flight, return the oldest
+        while len(self._pending) < self.pipeline_depth:
+            frame = await self.track.recv()
+            handle = await asyncio.to_thread(self.pipeline.submit, frame)
+            self._pending.append((frame, handle))
+        src, handle = self._pending.popleft()
+        return await asyncio.to_thread(self.pipeline.fetch, handle, src)

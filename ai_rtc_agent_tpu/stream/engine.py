@@ -67,6 +67,11 @@ class StreamConfig:
     # SDXL-style "text_time" addition conditioning: pooled text embeds +
     # micro-conditioning time_ids travel in state (prompt swaps, no retrace)
     use_added_cond: bool = False
+    # ControlNet conditioned generation (reference lib/wrapper.py:617-643):
+    # the annotator runs IN-GRAPH on the incoming frame; conditioning images
+    # ride a ring buffer in state aligned with the latent ring.
+    use_controlnet: bool = False
+    annotator: str = "canny"  # canny | identity
 
     @property
     def n_stages(self) -> int:
@@ -94,11 +99,14 @@ class StreamModels:
     unet(params, x, t, context, added_cond) -> model_out   [B,h,w,4]
     vae_encode(params, img01_nhwc) -> latents              [N,h,w,4]
     vae_decode(params, latents) -> img01_nhwc              [N,H,W,3]
+    controlnet(params, x, t, context, cond_img, added_cond, scale)
+        -> (down_residuals, mid_residual)                  [optional]
     """
 
     unet: Callable
     vae_encode: Callable
     vae_decode: Callable
+    controlnet: Callable | None = None
 
 
 def _coeff_state(cfg: StreamConfig, schedule: S.NoiseSchedule, t_index_list):
@@ -130,15 +138,32 @@ def _as_step_coeffs(d) -> L.StepCoeffs:
 def make_step_fn(models: StreamModels, cfg: StreamConfig):
     """Build the pure step function (to be jitted/AOT-compiled by the caller)."""
 
+    if cfg.use_controlnet and models.controlnet is None:
+        raise ValueError(
+            "cfg.use_controlnet=True but StreamModels.controlnet is None — "
+            "load the bundle with a controlnet model id"
+        )
     B = cfg.batch_size
     fbs = cfg.frame_buffer_size
     dt = cfg.jdtype
 
-    def unet_with_guidance(params, x_t, state, coeffs, stock):
+    def unet_with_guidance(params, x_t, state, coeffs, stock, cond_img=None):
         """One guided UNet pass over x_t [xb, h, w, c]; xb may be the full
         stream batch (denoising-batch mode) or one stage slice (sequential
-        mode).  Returns (eps, new_stock) with new_stock shaped like stock."""
+        mode).  Returns (eps, new_stock) with new_stock shaped like stock.
+        ``cond_img`` [xb,H,W,3]: ControlNet conditioning aligned with x_t."""
         xb = x_t.shape[0]
+
+        def run_unet(x, t, ctx, a, cond):
+            if cond is None:
+                return models.unet(params, x, t, ctx, a)
+            dres, mres = models.controlnet(
+                params, x, t, ctx, cond.astype(dt), a, state["cnet_scale"]
+            )
+            return models.unet(
+                params, x, t, ctx, a, down_residuals=dres, mid_residual=mres
+            )
+
         t = coeffs.timesteps
         added = None
         if cfg.use_added_cond:
@@ -166,12 +191,17 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                 if added is not None
                 else None
             )
-            out = models.unet(params, x2, t2, ctx2, added2)
+            cond2 = (
+                jnp.concatenate([cond_img, cond_img], axis=0)
+                if cond_img is not None
+                else None
+            )
+            out = run_unet(x2, t2, ctx2, added2, cond2)
             eps_u, eps_c = jnp.split(out, 2, axis=0)
             eps = R.combine_full(eps_u, eps_c, state["guidance"])
             new_stock = stock
         else:
-            eps_c = models.unet(params, x_t, t, cond, added)
+            eps_c = run_unet(x_t, t, cond, added, cond_img)
             if cfg.cfg_type == "none":
                 eps = eps_c
                 new_stock = stock
@@ -204,6 +234,19 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
         else:  # txt2img: fresh noise enters the ring
             x_new = state["noise"][:fbs].astype(dt)
 
+        # ---- ControlNet conditioning: annotate in-graph, ride a ring ----
+        cond_full = None
+        new_cnet_ring = None
+        if cfg.use_controlnet:
+            src = I.preprocess_uint8(frame_u8, dtype=dt)
+            cond_new = _annotate(src, cfg)  # [fbs,H,W,3]
+            # state["cnet_cond"] is [B-fbs,H,W,3] (possibly empty), aligned
+            # with x_buf; rotation mirrors the latent ring exactly
+            cond_full = jnp.concatenate(
+                [cond_new, state["cnet_cond"].astype(dt)], axis=0
+            )
+            new_cnet_ring = cond_full[: B - fbs]
+
         # ---- assemble the stream batch and run the UNet ----
         if cfg.use_denoising_batch:
             x_t = (
@@ -212,7 +255,7 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                 else x_new
             )
             eps, new_stock = unet_with_guidance(
-                params, x_t, state, coeffs, state["stock"]
+                params, x_t, state, coeffs, state["stock"], cond_full
             )
             if cfg.scheduler == "turbo":
                 denoised = L.turbo_denoise(x_t, eps, coeffs, cfg.prediction_type)
@@ -257,7 +300,8 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                     ]
                 )
                 eps, stock_sl = unet_with_guidance(
-                    params, x, state, sub, new_stock[sl]
+                    params, x, state, sub, new_stock[sl],
+                    cond_full[:fbs] if cond_full is not None else None,
                 )
                 new_stock = (
                     new_stock
@@ -282,9 +326,24 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
         new_state = dict(state)
         new_state["x_buf"] = new_buf
         new_state["stock"] = new_stock
+        if cfg.use_controlnet and new_cnet_ring is not None:
+            new_state["cnet_cond"] = new_cnet_ring
         return new_state, out_u8
 
     return step
+
+
+def _annotate(img01_nhwc, cfg: StreamConfig):
+    """In-graph conditioning annotator (replaces the reference's external
+    CUDA HED detector, lib/wrapper.py:39-40, with the canny conditioning
+    BASELINE.json tracks)."""
+    if cfg.annotator == "canny":
+        from ..models.controlnet import canny_soft
+
+        return canny_soft(img01_nhwc)
+    if cfg.annotator == "identity":
+        return img01_nhwc
+    raise ValueError(f"unknown annotator {cfg.annotator!r} (canny|identity)")
 
 
 class StreamEngine:
@@ -374,6 +433,11 @@ class StreamEngine:
                     ),
                 )
             )
+        if cfg.use_controlnet:
+            state["cnet_cond"] = jnp.zeros(
+                (B - cfg.frame_buffer_size, cfg.height, cfg.width, 3), cfg.jdtype
+            )
+            state["cnet_scale"] = jnp.asarray(1.0, jnp.float32)
         if cfg.cfg_type == "initialize":
             # Onetime-Negative: seed the stock noise with one real uncond pass
             coeffs = _as_step_coeffs(state["coeffs"])
@@ -404,13 +468,42 @@ class StreamEngine:
 
         With frame_buffer_size>1 pass [fbs,H,W,3] and get [fbs,H,W,3].
         """
+        return self.fetch(self.submit(frame_u8))
+
+    def submit(self, frame_u8: np.ndarray):
+        """Dispatch one stream step WITHOUT waiting for the result.
+
+        Returns an opaque pending handle; pass it to :meth:`fetch`.  The
+        engine state advances on-device immediately, so several frames can
+        be in flight — the dispatch pipeline stays full (the reference
+        blocks its event loop per frame, lib/tracks.py:24; we must not:
+        SURVEY.md section 7 "hard parts").
+        """
         if self.state is None:
             raise RuntimeError("call prepare() first")
         if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
-            return self._last_out
+            # skip the device entirely; hand back the previous output
+            return None, self._last_out
+        squeeze = frame_u8.ndim == 3
+        if isinstance(frame_u8, np.ndarray):
+            # async host->device upload BEFORE dispatch: a numpy arg makes the
+            # dispatch itself block on a synchronous transfer (device_put
+            # overlaps it with in-flight compute instead)
+            frame_u8 = jax.device_put(frame_u8)
         self.state, out = self._step(self.params, self.state, frame_u8)
+        try:  # overlap device->host copy with subsequent compute
+            out.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return out, squeeze
+
+    def fetch(self, pending) -> np.ndarray:
+        """Resolve a handle from :meth:`submit` to a host uint8 array."""
+        out, squeeze = pending
+        if out is None:  # similarity-filter skip: squeeze slot holds last out
+            return squeeze
         out = np.asarray(out)
-        if out.shape[0] == 1 and frame_u8.ndim == 3:
+        if out.shape[0] == 1 and squeeze:
             out = out[0]
         self._last_out = out
         return out
@@ -469,3 +562,10 @@ class StreamEngine:
             self.state["guidance"] = jnp.asarray(guidance_scale, jnp.float32)
         if delta is not None:
             self.state["delta"] = jnp.asarray(delta, jnp.float32)
+
+    def update_controlnet_scale(self, scale: float):
+        """Runtime conditioning-strength swap (no recompile) — analog of the
+        reference's fixed conditioning scale (lib/wrapper.py:870-877)."""
+        if not self.cfg.use_controlnet:
+            raise RuntimeError("engine built without use_controlnet")
+        self.state["cnet_scale"] = jnp.asarray(scale, jnp.float32)

@@ -50,11 +50,22 @@ class StreamDiffusionPipeline:
         prompt: str = DEFAULT_PROMPT,
         lora_dict: dict | None = None,
         seed: int = 2,
+        controlnet: str | None = None,
     ):
         self.prompt = prompt
         self.model_id = model_id
-        bundle = registry.load_model_bundle(model_id, lora_dict=lora_dict)
-        cfg = config or registry.default_stream_config(model_id)
+        cfg = config or registry.default_stream_config(
+            model_id, **({"use_controlnet": True} if controlnet else {})
+        )
+        if cfg.use_controlnet and controlnet is None:
+            raise ValueError(
+                "StreamConfig.use_controlnet=True requires a controlnet model "
+                "id (pass controlnet=... to StreamDiffusionPipeline)"
+            )
+        bundle = registry.load_model_bundle(
+            model_id, lora_dict=lora_dict, controlnet=controlnet,
+            latent_scale=cfg.latent_scale,
+        )
         self.t_index_list = list(cfg.t_index_list)
         self.engine = StreamEngine(
             models=bundle.stream_models,
@@ -117,6 +128,22 @@ class StreamDiffusionPipeline:
         out = self.predict(pre)
         if hasattr(frame, "pts") and not env.hw_encode():
             return self.postprocess(out, frame)
+        return out
+
+    # -- pipelined (async-dispatch) frame path ------------------------------
+
+    def submit(self, frame):
+        """Dispatch one frame without waiting (see engine.submit); returns a
+        handle for :meth:`fetch`.  Lets the caller keep several frames in
+        flight so device compute, dispatch and readback overlap."""
+        pre = self.preprocess(frame)
+        return self.engine.submit(pre)
+
+    def fetch(self, handle, src_frame=None):
+        """Resolve a submit() handle; attaches pts metadata like __call__."""
+        out = self.engine.fetch(handle)
+        if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
+            return self.postprocess(out, src_frame)
         return out
 
 

@@ -90,3 +90,12 @@ def hw_encode() -> bool:
 
 def hw_decode() -> bool:
     return get_bool("HW_DECODE", get_bool("NVDEC", False))
+
+
+def pipeline_depth() -> int:
+    """Frames kept in flight on the device per track (PIPELINE_DEPTH).
+
+    1 = fully synchronous (reference behavior).  >1 overlaps dispatch,
+    device compute and device->host copy across consecutive frames —
+    throughput rises at the cost of `depth` frames of latency."""
+    return max(1, get_int("PIPELINE_DEPTH", 2))

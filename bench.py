@@ -39,16 +39,22 @@ def build_engine(config: str):
     from ai_rtc_agent_tpu.stream.engine import StreamEngine
 
     dtype = "bfloat16" if jax.default_backend() != "cpu" else "float32"
+    controlnet = None
     if config == "turbo512":
         model_id, overrides = "stabilityai/sd-turbo", dict(dtype=dtype)
     elif config == "lcm4x512":
         model_id, overrides = "lykon/dreamshaper-8", dict(dtype=dtype)
     elif config == "sdxl1024":
         model_id, overrides = "stabilityai/sdxl-turbo", dict(dtype=dtype)
+    elif config == "controlnet512":
+        # BASELINE configs[3]: ControlNet-canny conditioned stream (SD1.5+LCM)
+        model_id = "lykon/dreamshaper-8"
+        overrides = dict(dtype=dtype, use_controlnet=True)
+        controlnet = "lllyasviel/control_v11p_sd15_canny"
     else:
         raise ValueError(config)
 
-    bundle = registry.load_model_bundle(model_id)
+    bundle = registry.load_model_bundle(model_id, controlnet=controlnet)
     cfg = registry.default_stream_config(model_id, **overrides)
     if dtype == "bfloat16":
         bundle.params = jax.tree.map(
@@ -62,46 +68,124 @@ def build_engine(config: str):
     return eng, cfg
 
 
-def run_bench(config: str, frames: int):
+def _pipelined_loop(submit, fetch, make_frame, n_iters: int,
+                    pipeline_depth: int, frames_per_iter: int):
+    """Shared streaming measurement loop: submit each 'arriving' frame,
+    fetch results ``pipeline_depth`` iterations later on a small thread pool
+    so device->host readbacks overlap each other and in-flight compute (one
+    readback RTT otherwise serializes the loop on remote-attached TPUs).
+    Returns (result dict, last output)."""
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    lats = []
+    pending: deque = deque()
+    out = None
+    t_start = time.monotonic()
+    with ThreadPoolExecutor(max_workers=pipeline_depth) as pool:
+        for i in range(n_iters):
+            t_sub = time.monotonic()
+            fut = pool.submit(fetch, submit(make_frame(i)))
+            pending.append((t_sub, fut))
+            if len(pending) >= pipeline_depth:
+                t_sub, fut = pending.popleft()
+                out = fut.result()
+                lats.append(time.monotonic() - t_sub)
+        while pending:
+            t_sub, fut = pending.popleft()
+            out = fut.result()
+            lats.append(time.monotonic() - t_sub)
+    total = time.monotonic() - t_start
+    lats = np.array(lats)
+    return {
+        "fps": float(n_iters * frames_per_iter / total),
+        "latency_p50_ms": float(np.percentile(lats, 50) * 1e3),
+        "latency_p90_ms": float(np.percentile(lats, 90) * 1e3),
+        "out_shape": list(np.asarray(out).shape),
+    }, out
+
+
+def run_bench(config: str, frames: int, pipeline_depth: int = 4):
+    """Streaming benchmark: frames are SUBMITTED as they 'arrive' and results
+    fetched ``pipeline_depth`` frames later — the dispatch pipeline stays
+    full, exactly like the async serving loop (stream/engine.py submit/fetch).
+    fps = sustained throughput; latency = submit->fetch wall time per frame.
+    """
     eng, cfg = build_engine(config)
     rng = np.random.default_rng(0)
     frame = rng.integers(0, 256, (cfg.height, cfg.width, 3), dtype=np.uint8)
+    frame_flipped = frame[::-1].copy()
 
     # warm-up: compile + cache (reference drops 10 warm-up frames at connect,
     # lib/tracks.py:21-25 — same idea)
     t0 = time.monotonic()
     for _ in range(3):
-        out = eng(frame)
+        eng(frame)
     logger.info("warm-up (incl. compile): %.1fs", time.monotonic() - t0)
 
-    lats = []
-    for i in range(frames):
-        f = frame if i % 2 == 0 else frame[::-1].copy()
-        t1 = time.monotonic()
-        out = eng(f)
-        lats.append(time.monotonic() - t1)
-    lats = np.array(lats)
-    fps = 1.0 / lats.mean()
-    return {
-        "fps": float(fps),
-        "latency_p50_ms": float(np.percentile(lats, 50) * 1e3),
-        "latency_p90_ms": float(np.percentile(lats, 90) * 1e3),
-        "out_shape": list(np.asarray(out).shape),
-    }
+    r, _ = _pipelined_loop(
+        eng.submit, eng.fetch,
+        lambda i: frame if i % 2 == 0 else frame_flipped,
+        frames, pipeline_depth, 1,
+    )
+    return r
+
+
+def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4):
+    """BASELINE configs[4]: N concurrent streams batched on one chip.
+    fps is AGGREGATE (frames/sec across all peers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.parallel.multipeer import MultiPeerEngine
+
+    dtype = "bfloat16" if jax.default_backend() != "cpu" else "float32"
+    model_id = "stabilityai/sd-turbo"
+    bundle = registry.load_model_bundle(model_id)
+    cfg = registry.default_stream_config(model_id, dtype=dtype)
+    if dtype == "bfloat16":
+        bundle.params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+            bundle.params,
+        )
+    eng = MultiPeerEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_peers=peers,
+    ).start("a benchmark prompt")
+
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, 256, (peers, cfg.height, cfg.width, 3), dtype=np.uint8)
+    t0 = time.monotonic()
+    for _ in range(3):
+        eng.step_all(batch)
+    logger.info("warm-up (incl. compile): %.1fs", time.monotonic() - t0)
+
+    ticks = max(1, frames // peers)
+    r, _ = _pipelined_loop(
+        eng.submit, eng.fetch, lambda i: batch, ticks, pipeline_depth, peers
+    )
+    r["peers"] = peers
+    return r
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="turbo512",
-                    choices=["turbo512", "lcm4x512", "sdxl1024"])
+                    choices=["turbo512", "lcm4x512", "sdxl1024",
+                             "controlnet512", "multipeer"])
     ap.add_argument("--frames", type=int, default=30)
+    ap.add_argument("--peers", type=int, default=4)
     args = ap.parse_args()
 
     import jax
 
     backend = jax.default_backend()
     try:
-        r = run_bench(args.config, args.frames)
+        if args.config == "multipeer":
+            r = run_bench_multipeer(args.frames, args.peers)
+        else:
+            r = run_bench(args.config, args.frames)
         result = {
             "metric": f"e2e_fps_{args.config}_singlechip",
             "value": round(r["fps"], 2),
