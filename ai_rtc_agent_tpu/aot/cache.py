@@ -63,18 +63,29 @@ class EngineCache:
             d, f"{digest}.json"
         )
 
+    def _signature(self, key: str, example_args):
+        """(specs, args_spec, digest) for a key + example-arg signature —
+        the single source of truth shared by has() and load_or_build()."""
+        specs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tuple(example_args)
+        )
+        args_spec = ";".join(f"{s.shape}:{s.dtype}" for s in jax.tree.leaves(specs))
+        return specs, args_spec, _digest(key, args_spec, jax.default_backend())
+
+    def has(self, key: str, example_args) -> bool:
+        """True when a serialized engine exists for this key + signature."""
+        _, _, digest = self._signature(key, example_args)
+        _, blob_path, _ = self._paths(key, digest)
+        return os.path.exists(blob_path)
+
     def load_or_build(self, key: str, fn, example_args, donate_argnums=()):
         """Return a callable backed by a cached executable when possible.
 
         ``fn`` must be a pure function; ``example_args`` a tuple of arrays /
         ShapeDtypeStructs defining the static signature.
         """
-        specs = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tuple(example_args)
-        )
         platform = jax.default_backend()
-        args_spec = ";".join(f"{s.shape}:{s.dtype}" for s in jax.tree.leaves(specs))
-        digest = _digest(key, args_spec, platform)
+        specs, args_spec, digest = self._signature(key, example_args)
         d, blob_path, meta_path = self._paths(key, digest)
 
         if os.path.exists(blob_path):

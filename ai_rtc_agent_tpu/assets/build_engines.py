@@ -25,9 +25,9 @@ logger = logging.getLogger(__name__)
 
 
 def build(model_id: str, lora_dict: dict | None = None, cache_dir: str | None = None):
-    from ..aot.cache import EngineCache, engine_key
+    from ..aot.cache import EngineCache
     from ..models import registry
-    from ..stream.engine import StreamEngine, make_step_fn
+    from ..stream.engine import StreamEngine, make_step_fn, stream_engine_key
 
     bundle = registry.load_model_bundle(model_id, lora_dict=lora_dict)
     cfg = registry.default_stream_config(model_id)
@@ -47,15 +47,7 @@ def build(model_id: str, lora_dict: dict | None = None, cache_dir: str | None = 
         else (cfg.frame_buffer_size, cfg.height, cfg.width, 3),
         np.uint8,
     )
-    key = engine_key(
-        model_id,
-        cfg.mode,
-        batch=cfg.batch_size,
-        hw=f"{cfg.height}x{cfg.width}",
-        dtype=cfg.dtype,
-        cfgtype=cfg.cfg_type,
-        sched=cfg.scheduler,
-    )
+    key = stream_engine_key(model_id, cfg)
     cache = EngineCache(cache_dir)
     call = cache.load_or_build(
         key, step, (bundle.params, engine.state, frame), donate_argnums=(1,)

@@ -6,7 +6,8 @@ download.py:28-41).  Honors HF_HUB_CACHE / CIVITAI_CACHE exactly like the
 reference (lib/utils.py:6-10).  Network access is required — on a zero-egress
 TPU VM, run this on a connected host and ship the caches.
 
-Usage: python -m ai_rtc_agent_tpu.assets.download [--model-set default|sd15|turbo|sdxl]
+Usage: python -m ai_rtc_agent_tpu.assets.download
+         [--model-set default|sd15|turbo|sdxl|controlnet|safety]
 """
 
 from __future__ import annotations
@@ -28,6 +29,10 @@ HF_MODEL_SETS = {
     ],
     "turbo": ["stabilityai/sd-turbo", "madebyollin/taesd"],
     "sdxl": ["stabilityai/sdxl-turbo", "madebyollin/taesdxl"],
+    # conditioned generation + safety (reference wires these optionally:
+    # lib/wrapper.py:617-643 ControlNet, :930-942 safety checker)
+    "controlnet": ["lllyasviel/control_v11p_sd15_canny"],
+    "safety": ["CompVis/stable-diffusion-safety-checker"],
 }
 HF_MODEL_SETS["default"] = (
     HF_MODEL_SETS["sd15"] + HF_MODEL_SETS["turbo"] + HF_MODEL_SETS["sdxl"]

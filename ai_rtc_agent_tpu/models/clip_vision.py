@@ -1,0 +1,136 @@
+"""CLIP vision tower (ViT) — image embeddings for the safety checker.
+
+TPU-native replacement for ``transformers.CLIPVisionModel`` as used inside
+the reference's optional safety checker
+(``StableDiffusionSafetyChecker``/``CLIPFeatureExtractor``, reference
+lib/wrapper.py:930-942).  NHWC patches; non-causal attention; class-token
+pooling with pre/post layer norms per the HF architecture.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .layers import ACTIVATIONS, init_linear, init_norm, layer_norm, linear
+
+# CLIP's pixel normalization constants (OpenAI ViT-L/14 preprocessing)
+CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+@dataclass(frozen=True)
+class CLIPVisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    width: int = 1024
+    layers: int = 24
+    heads: int = 16
+    activation: str = "quick_gelu"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @staticmethod
+    def vit_l14() -> "CLIPVisionConfig":
+        """The safety checker's tower (openai/clip-vit-large-patch14)."""
+        return CLIPVisionConfig()
+
+    @staticmethod
+    def tiny() -> "CLIPVisionConfig":
+        return CLIPVisionConfig(
+            image_size=32, patch_size=8, width=32, layers=2, heads=4
+        )
+
+
+def init_clip_vision(key, cfg: CLIPVisionConfig):
+    keys = jax.random.split(key, 5 + cfg.layers)
+    p = {
+        # patch embedding as a conv kernel [P,P,3,width] (HWIO); "kernel"
+        # leaf so the loader applies the OIHW->HWIO transpose
+        "patch_embedding": {
+            "kernel": jax.random.normal(
+                keys[0], (cfg.patch_size, cfg.patch_size, 3, cfg.width)
+            )
+            * 0.02
+        },
+        "class_embedding": jax.random.normal(keys[1], (cfg.width,)) * 0.02,
+        "position_embedding": jax.random.normal(
+            keys[2], (cfg.num_patches + 1, cfg.width)
+        )
+        * 0.01,
+        "pre_norm": init_norm(cfg.width),
+        "post_norm": init_norm(cfg.width),
+        "layers": [],
+    }
+    for i in range(cfg.layers):
+        k1, k2, k3, k4, k5, k6 = jax.random.split(keys[5 + i], 6)
+        p["layers"].append(
+            {
+                "ln1": init_norm(cfg.width),
+                "q": init_linear(k1, cfg.width, cfg.width),
+                "k": init_linear(k2, cfg.width, cfg.width),
+                "v": init_linear(k3, cfg.width, cfg.width),
+                "out": init_linear(k4, cfg.width, cfg.width),
+                "ln2": init_norm(cfg.width),
+                "fc1": init_linear(k5, cfg.width, cfg.width * 4),
+                "fc2": init_linear(k6, cfg.width * 4, cfg.width),
+            }
+        )
+    return p
+
+
+def _attn(layer, x, heads: int):
+    b, l, d = x.shape
+    hd = d // heads
+    q = linear(layer["q"], x).reshape(b, l, heads, hd)
+    k = linear(layer["k"], x).reshape(b, l, heads, hd)
+    v = linear(layer["v"], x).reshape(b, l, heads, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * hd**-0.5
+    w = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, l, d)
+    return linear(layer["out"], o)
+
+
+def preprocess_clip(img01_nhwc, cfg: CLIPVisionConfig):
+    """[N,H,W,3] in [0,1] -> resized + CLIP-normalized [N,S,S,3]."""
+    n, h, w, c = img01_nhwc.shape
+    s = cfg.image_size
+    if (h, w) != (s, s):
+        img01_nhwc = jax.image.resize(
+            img01_nhwc, (n, s, s, c), method="bilinear"
+        )
+    mean = jnp.asarray(CLIP_MEAN, img01_nhwc.dtype)
+    std = jnp.asarray(CLIP_STD, img01_nhwc.dtype)
+    return (img01_nhwc - mean) / std
+
+
+def apply_clip_vision(p, img_nhwc, cfg: CLIPVisionConfig):
+    """Preprocessed [N,S,S,3] -> dict(hidden [N,L,width], pooled [N,width])."""
+    n = img_nhwc.shape[0]
+    patches = jax.lax.conv_general_dilated(
+        img_nhwc,
+        p["patch_embedding"]["kernel"].astype(img_nhwc.dtype),
+        window_strides=(cfg.patch_size, cfg.patch_size),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )  # [N, S/P, S/P, width]
+    x = patches.reshape(n, -1, cfg.width)
+    cls = jnp.broadcast_to(
+        p["class_embedding"].astype(x.dtype), (n, 1, cfg.width)
+    )
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + p["position_embedding"][: x.shape[1]].astype(x.dtype)
+    x = layer_norm(p["pre_norm"], x)
+    for layer in p["layers"]:
+        h = layer_norm(layer["ln1"], x)
+        x = x + _attn(layer, h, cfg.heads)
+        h = layer_norm(layer["ln2"], x)
+        h = linear(layer["fc1"], h)
+        h = ACTIVATIONS[cfg.activation](h)
+        x = x + linear(layer["fc2"], h)
+    pooled = layer_norm(p["post_norm"], x[:, 0])
+    return {"hidden": x, "pooled": pooled}

@@ -97,6 +97,8 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
             cfg_type="self",
         )
     base.update(overrides)
+    # fused Pallas epilogue on real TPUs (interpret-mode is slow on CPU)
+    base.setdefault("use_fused_epilogue", jax.default_backend() == "tpu")
     return StreamConfig(**base)
 
 

@@ -1,0 +1,180 @@
+"""Safety checker — optional NSFW gate on generated frames.
+
+TPU-native replacement for diffusers' ``StableDiffusionSafetyChecker`` +
+``CLIPFeatureExtractor`` pair, which the reference enables with
+``use_safety_checker`` and uses to blank flagged outputs (reference
+lib/wrapper.py:930-942: flagged frames are replaced by a fallback image).
+
+Architecture (HF parity so real checkpoint weights stream in):
+  CLIP ViT-L/14 vision tower -> visual_projection (width -> 768) ->
+  cosine similarity against 17 fixed "concept" embeddings and 3
+  "special care" embeddings, each with a learned threshold; an image is
+  flagged when any adjusted score is positive.
+
+The whole check is ONE jitted function (resize + normalize + ViT + heads
+in-graph); the host only reads back a [N] bool vector.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import clip_vision as CV
+from . import loader as LD
+from .layers import init_linear, linear
+
+logger = logging.getLogger(__name__)
+
+PROJECTION_DIM = 768
+N_CONCEPTS = 17
+N_SPECIAL = 3
+
+
+def init_safety_checker(key, cfg: CV.CLIPVisionConfig, projection_dim: int = PROJECTION_DIM):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "vision": CV.init_clip_vision(k1, cfg),
+        "visual_projection": init_linear(k2, cfg.width, projection_dim, bias=False),
+        "concept_embeds": jax.random.normal(k3, (N_CONCEPTS, projection_dim)) * 0.02,
+        "special_care_embeds": jax.random.normal(k4, (N_SPECIAL, projection_dim)) * 0.02,
+        # thresholds init high so a random-weight checker flags nothing
+        "concept_embeds_weights": jnp.full((N_CONCEPTS,), 1.0),
+        "special_care_embeds_weights": jnp.full((N_SPECIAL,), 1.0),
+    }
+
+
+def safety_key_map(cfg: CV.CLIPVisionConfig) -> dict[str, tuple]:
+    """HF StableDiffusionSafetyChecker state dict -> our tree."""
+    m: dict[str, tuple] = {
+        "vision_model.vision_model.embeddings.patch_embedding.weight": (
+            "vision", "patch_embedding", "kernel",
+        ),
+        "vision_model.vision_model.embeddings.class_embedding": (
+            "vision", "class_embedding",
+        ),
+        "vision_model.vision_model.embeddings.position_embedding.weight": (
+            "vision", "position_embedding",
+        ),
+        "visual_projection.weight": ("visual_projection", "kernel"),
+        "concept_embeds": ("concept_embeds",),
+        "special_care_embeds": ("special_care_embeds",),
+        "concept_embeds_weights": ("concept_embeds_weights",),
+        "special_care_embeds_weights": ("special_care_embeds_weights",),
+    }
+    for pre, ours in (
+        ("vision_model.vision_model.pre_layrnorm", ("vision", "pre_norm")),
+        ("vision_model.vision_model.post_layernorm", ("vision", "post_norm")),
+    ):
+        m[pre + ".weight"] = ours + ("scale",)
+        m[pre + ".bias"] = ours + ("bias",)
+    for i in range(cfg.layers):
+        base = f"vision_model.vision_model.encoder.layers.{i}"
+        path = ("vision", "layers", i)
+        pairs = [
+            (".layer_norm1", "ln1", "norm"),
+            (".self_attn.q_proj", "q", "linear"),
+            (".self_attn.k_proj", "k", "linear"),
+            (".self_attn.v_proj", "v", "linear"),
+            (".self_attn.out_proj", "out", "linear"),
+            (".layer_norm2", "ln2", "norm"),
+            (".mlp.fc1", "fc1", "linear"),
+            (".mlp.fc2", "fc2", "linear"),
+        ]
+        for suffix, ours, kind in pairs:
+            for k, v in LD._leaf_keys(base + suffix, path + (ours,), kind):
+                m[k] = v
+    return m
+
+
+def check_images(params, img01_nhwc, cfg: CV.CLIPVisionConfig):
+    """[N,H,W,3] float in [0,1] -> [N] bool (True = flagged NSFW).
+
+    Mirrors the HF cosine-distance logic: special-care hits lower the
+    concept thresholds (the 0.01 adjustment), then any positive adjusted
+    concept score flags the image.
+    """
+    x = CV.preprocess_clip(img01_nhwc, cfg)
+    pooled = CV.apply_clip_vision(params["vision"], x, cfg)["pooled"]
+    emb = linear(params["visual_projection"], pooled)
+    emb = emb / (jnp.linalg.norm(emb, axis=-1, keepdims=True) + 1e-8)
+
+    def cos(a, b):  # a [N,D], b [K,D] -> [N,K]
+        bn = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-8)
+        return a @ bn.T
+
+    special_scores = (
+        cos(emb, params["special_care_embeds"])
+        - params["special_care_embeds_weights"][None, :]
+    )
+    has_special = (special_scores > 0).any(axis=-1)
+    adjustment = jnp.where(has_special, 0.01, 0.0)[:, None]
+    concept_scores = (
+        cos(emb, params["concept_embeds"])
+        - params["concept_embeds_weights"][None, :]
+        + adjustment
+    )
+    return (concept_scores > 0).any(axis=-1)
+
+
+@dataclass
+class SafetyChecker:
+    """Host-side wrapper: jitted check + blanked output on flags (the
+    reference replaces flagged frames with a fallback image)."""
+
+    params: dict
+    cfg: CV.CLIPVisionConfig
+    loaded_real_weights: bool = False
+
+    def __post_init__(self):
+        self._check = jax.jit(partial(check_images, cfg=self.cfg))
+
+    @staticmethod
+    def load(snapshot_dir: str | None = None, cfg: CV.CLIPVisionConfig | None = None,
+             seed: int = 0) -> "SafetyChecker":
+        """Build from an HF safety-checker snapshot (subfolder
+        ``safety_checker`` of an SD repo, or a standalone checkpoint dir);
+        random weights + never-flag thresholds when absent."""
+        cfg = cfg or CV.CLIPVisionConfig.vit_l14()
+        params = init_safety_checker(jax.random.PRNGKey(seed), cfg)
+        loaded = False
+        if snapshot_dir:
+            files = LD.find_safetensors(snapshot_dir, "safety_checker") or (
+                LD.find_safetensors(snapshot_dir)
+            )
+            if files:
+                sd: dict = {}
+                for f in files:
+                    sd.update(LD.read_safetensors(f))
+                try:
+                    params, n = LD.load_into_tree(
+                        params, sd, safety_key_map(cfg), strict=False
+                    )
+                    loaded = n > 0
+                    logger.info("safety checker: loaded %d tensors", n)
+                except ValueError as e:
+                    logger.warning("safety checker weight load failed: %s", e)
+        if not loaded:
+            logger.warning(
+                "safety checker running with RANDOM weights — it will flag "
+                "nothing (thresholds init at 1.0)"
+            )
+        return SafetyChecker(params=params, cfg=cfg, loaded_real_weights=loaded)
+
+    def __call__(self, frames_u8: np.ndarray) -> np.ndarray:
+        """[N,H,W,3] or [H,W,3] uint8 -> same shape with flagged frames
+        blanked to black."""
+        squeeze = frames_u8.ndim == 3
+        batch = frames_u8[None] if squeeze else frames_u8
+        img01 = jnp.asarray(batch, jnp.float32) / 255.0
+        flags = np.asarray(self._check(self.params, img01))
+        if flags.any():
+            batch = batch.copy()
+            batch[flags] = 0
+            logger.info("safety checker blanked %d frame(s)", int(flags.sum()))
+        return batch[0] if squeeze else batch

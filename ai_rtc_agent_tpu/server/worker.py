@@ -1,0 +1,111 @@
+"""Serverless worker — parity with the reference's Runpod handler.
+
+The reference ships ``runpod/handler.py``: a sidecar that (1) polls the
+agent's health endpoint until it comes up (60s budget, reference
+runpod/handler.py:11-27), (2) publishes the pod's public connection info as
+a progress update (:41-47), and (3) sleeps ``agent_timeout`` seconds to keep
+the pod alive (:50).  This module is the platform-agnostic TPU-VM
+equivalent: the publish step is an injectable callback (HTTP POST to
+``WORKER_PUBLISH_URL`` by default — works for any queue/orchestrator, not
+just Runpod), and identity comes from env instead of the Runpod SDK.
+
+Run next to the agent (the reference starts both from runpod/start.sh):
+
+    python -m ai_rtc_agent_tpu.server.worker --agent-port 8888
+
+Env: WORKER_ID, PUBLIC_IP, PUBLIC_PORT, WORKER_PUBLISH_URL, AUTH_TOKEN,
+AGENT_TIMEOUT (keep-alive seconds, default 600 like the reference).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+import urllib.error
+import urllib.request
+
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+HEALTH_BUDGET_S = 60  # reference runpod/handler.py gives the agent 60s
+POLL_INTERVAL_S = 1.0
+
+
+def check_server(url: str, budget_s: float = HEALTH_BUDGET_S) -> bool:
+    """Poll the agent health endpoint until OK or budget exhausted
+    (reference check_server, runpod/handler.py:11-27)."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status == 200:
+                    logger.info("agent is up at %s", url)
+                    return True
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(POLL_INTERVAL_S)
+    logger.error("agent did not come up within %.0fs", budget_s)
+    return False
+
+
+def default_publish(info: dict) -> None:
+    """POST connection info to WORKER_PUBLISH_URL (Bearer AUTH_TOKEN) —
+    the generic analog of Runpod's progress_update."""
+    url = env.get_str("WORKER_PUBLISH_URL")
+    if not url:
+        logger.info("no WORKER_PUBLISH_URL; connection info: %s", info)
+        return
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(info).encode(),
+        headers={
+            "Content-Type": "application/json",
+            **(
+                {"Authorization": f"Bearer {env.get_str('AUTH_TOKEN')}"}
+                if env.get_str("AUTH_TOKEN")
+                else {}
+            ),
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            logger.info("published worker info (%d)", r.status)
+    except (urllib.error.URLError, OSError) as e:
+        logger.warning("worker publish failed: %s", e)
+
+
+def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
+    """One worker job: await agent, publish identity, hold the lease.
+
+    Returns 0 on success, 1 if the agent never became healthy (the
+    orchestrator should recycle the worker — the reference just errors)."""
+    if not check_server(f"http://127.0.0.1:{agent_port}/", HEALTH_BUDGET_S):
+        return 1
+    publish(
+        {
+            "worker_id": os.getenv("WORKER_ID", os.uname().nodename),
+            "public_ip": os.getenv("PUBLIC_IP", ""),
+            "public_port": os.getenv("PUBLIC_PORT", str(agent_port)),
+            "status": "ready",
+        }
+    )
+    keep_alive = env.get_int("AGENT_TIMEOUT", 600)
+    logger.info("holding worker lease for %ds", keep_alive)
+    sleep(keep_alive)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="serverless agent sidecar")
+    ap.add_argument("--agent-port", type=int, default=8888)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    return handler(args.agent_port)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

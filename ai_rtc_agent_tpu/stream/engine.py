@@ -72,6 +72,13 @@ class StreamConfig:
     # ride a ring buffer in state aligned with the latent ring.
     use_controlnet: bool = False
     annotator: str = "canny"  # canny | identity
+    # Fuse the whole post-UNet scheduler chain (R-CFG combine -> LCM blend ->
+    # ring renoise -> stock update) into ONE Pallas kernel: a single HBM
+    # read/write of the latent slabs instead of 6+ elementwise passes
+    # (BASELINE north star: "Pallas for ... the LCM scheduler step").
+    # Supported for epsilon-prediction + cfg_type none/self/initialize in
+    # denoising-batch mode; other combos fall back to composed XLA ops.
+    use_fused_epilogue: bool = False
 
     @property
     def n_stages(self) -> int:
@@ -89,6 +96,10 @@ class StreamConfig:
     @property
     def jdtype(self):
         return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+# sentinel marking a similarity-filter skip in a submit() handle
+_SKIP = object()
 
 
 @dataclass
@@ -147,11 +158,23 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
     fbs = cfg.frame_buffer_size
     dt = cfg.jdtype
 
-    def unet_with_guidance(params, x_t, state, coeffs, stock, cond_img=None):
+    fused_ok = (
+        cfg.use_fused_epilogue
+        and cfg.use_denoising_batch
+        and cfg.prediction_type == "epsilon"
+        and cfg.cfg_type in ("none", "self", "initialize")
+    )
+
+    def unet_with_guidance(
+        params, x_t, state, coeffs, stock, cond_img=None, return_raw=False
+    ):
         """One guided UNet pass over x_t [xb, h, w, c]; xb may be the full
         stream batch (denoising-batch mode) or one stage slice (sequential
         mode).  Returns (eps, new_stock) with new_stock shaped like stock.
-        ``cond_img`` [xb,H,W,3]: ControlNet conditioning aligned with x_t."""
+        ``cond_img`` [xb,H,W,3]: ControlNet conditioning aligned with x_t.
+        ``return_raw``: skip the guidance combine + stock update and return
+        the raw conditioned prediction (the fused epilogue kernel does the
+        rest in one pass); only valid for cfg_type none/self/initialize."""
         xb = x_t.shape[0]
 
         def run_unet(x, t, ctx, a, cond):
@@ -202,6 +225,8 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
             new_stock = stock
         else:
             eps_c = run_unet(x_t, t, cond, added, cond_img)
+            if return_raw:
+                return eps_c, stock
             if cfg.cfg_type == "none":
                 eps = eps_c
                 new_stock = stock
@@ -254,34 +279,73 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                 if B > fbs
                 else x_new
             )
-            eps, new_stock = unet_with_guidance(
-                params, x_t, state, coeffs, state["stock"], cond_full
-            )
-            if cfg.scheduler == "turbo":
-                denoised = L.turbo_denoise(x_t, eps, coeffs, cfg.prediction_type)
-            else:
-                denoised = L.lcm_denoise(x_t, eps, coeffs, cfg.prediction_type)
-
-            # ---- rotate the ring: advance every entry one stage ----
-            out_latent = denoised[B - fbs :]
-            if B > fbs:
-                stage_noise = state["noise"][fbs:].astype(dt)
-                advanced = L.renoise_next(
-                    denoised[: B - fbs],
-                    stage_noise,
-                    L.StepCoeffs(
-                        *[
-                            getattr(coeffs, f)[: B - fbs]
-                            for f in (
-                                "timesteps", "alpha", "sigma", "c_skip", "c_out",
-                                "next_alpha", "next_sigma",
-                            )
-                        ]
-                    ),
+            if fused_ok:
+                eps_c, _ = unet_with_guidance(
+                    params, x_t, state, coeffs, state["stock"], cond_full,
+                    return_raw=True,
                 )
-                new_buf = advanced
+                kc = coeffs
+                if cfg.scheduler == "turbo":
+                    # turbo step is pred_x0 == LCM blend with c_skip=0, c_out=1
+                    kc = L.StepCoeffs(
+                        coeffs.timesteps, coeffs.alpha, coeffs.sigma,
+                        jnp.zeros_like(coeffs.c_skip),
+                        jnp.ones_like(coeffs.c_out),
+                        coeffs.next_alpha, coeffs.next_sigma,
+                    )
+                # align noise with "next stage": entry b renoises with the
+                # noise of slot b+fbs; exit entries get next_sigma=0
+                noise_next = (
+                    jnp.concatenate(
+                        [state["noise"][fbs:], jnp.zeros_like(state["noise"][:fbs])],
+                        axis=0,
+                    )
+                    if B > fbs
+                    else jnp.zeros_like(state["noise"])
+                )
+                from ..ops.pallas.fused_scheduler import fused_stream_epilogue
+
+                denoised, advanced, new_stock = fused_stream_epilogue(
+                    x_t,
+                    eps_c,
+                    state["stock"].astype(dt),
+                    noise_next.astype(dt),
+                    kc,
+                    state["guidance"],
+                    state["delta"],
+                    cfg_type=cfg.cfg_type,
+                )
+                out_latent = denoised[B - fbs :]
+                new_buf = advanced[: B - fbs] if B > fbs else state["x_buf"]
             else:
-                new_buf = state["x_buf"]
+                eps, new_stock = unet_with_guidance(
+                    params, x_t, state, coeffs, state["stock"], cond_full
+                )
+                if cfg.scheduler == "turbo":
+                    denoised = L.turbo_denoise(x_t, eps, coeffs, cfg.prediction_type)
+                else:
+                    denoised = L.lcm_denoise(x_t, eps, coeffs, cfg.prediction_type)
+
+                # ---- rotate the ring: advance every entry one stage ----
+                out_latent = denoised[B - fbs :]
+                if B > fbs:
+                    stage_noise = state["noise"][fbs:].astype(dt)
+                    advanced = L.renoise_next(
+                        denoised[: B - fbs],
+                        stage_noise,
+                        L.StepCoeffs(
+                            *[
+                                getattr(coeffs, f)[: B - fbs]
+                                for f in (
+                                    "timesteps", "alpha", "sigma", "c_skip", "c_out",
+                                    "next_alpha", "next_sigma",
+                                )
+                            ]
+                        ),
+                    )
+                    new_buf = advanced
+                else:
+                    new_buf = state["x_buf"]
         else:
             # sequential (non-stream) mode: all stages for this frame now —
             # n UNet passes of batch fbs; parity with the reference's
@@ -331,6 +395,27 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
         return new_state, out_u8
 
     return step
+
+
+def stream_engine_key(model_id: str, cfg: StreamConfig) -> str:
+    """Canonical engine-cache key for a (model, stream config) pair — shared
+    by the build CLI and the serving fast path (reference cache-key
+    discipline: lib/wrapper.py:732-746)."""
+    from ..aot.cache import engine_key
+
+    return engine_key(
+        model_id,
+        cfg.mode,
+        batch=cfg.batch_size,
+        hw=f"{cfg.height}x{cfg.width}",
+        dtype=cfg.dtype,
+        cfgtype=cfg.cfg_type,
+        sched=cfg.scheduler,
+        # graph-changing flags that do NOT change arg shapes — must be part
+        # of the key or different graphs collide on one cache entry
+        cnet=f"{int(cfg.use_controlnet)}{cfg.annotator if cfg.use_controlnet else ''}",
+        fused=int(cfg.use_fused_epilogue),
+    )
 
 
 def _annotate(img01_nhwc, cfg: StreamConfig):
@@ -461,6 +546,41 @@ class StreamEngine:
         self.state = state
         return self
 
+    # -- AOT engine adoption ------------------------------------------------
+
+    def use_aot_cache(
+        self, model_id: str, cache_dir: str | None = None,
+        build_on_miss: bool = True,
+    ) -> bool:
+        """Swap the jitted step for a serialized AOT executable — the serving
+        side of the reference's "load engines without base weights" fast path
+        (lib/wrapper.py:409-512).  Key discipline matches build_engines, so a
+        prebuilt engine from the CLI is adopted directly.
+
+        Returns True when an engine (cached or freshly built) is now in use;
+        with ``build_on_miss=False`` a miss leaves the plain jit step and
+        returns False.
+        """
+        from ..aot.cache import EngineCache
+
+        if self.state is None:
+            raise RuntimeError("call prepare() first (state defines the signature)")
+        cache = EngineCache(cache_dir)
+        key = stream_engine_key(model_id, self.cfg)
+        fbs = self.cfg.frame_buffer_size
+        frame_spec = jax.ShapeDtypeStruct(
+            (self.cfg.height, self.cfg.width, 3)
+            if fbs == 1
+            else (fbs, self.cfg.height, self.cfg.width, 3),
+            jnp.uint8,
+        )
+        args = (self.params, self.state, frame_spec)
+        if not build_on_miss and not cache.has(key, args):
+            return False
+        step = make_step_fn(self.models, self.cfg)
+        self._step = cache.load_or_build(key, step, args, donate_argnums=(1,))
+        return True
+
     # -- hot path -----------------------------------------------------------
 
     def __call__(self, frame_u8: np.ndarray) -> np.ndarray:
@@ -482,8 +602,10 @@ class StreamEngine:
         if self.state is None:
             raise RuntimeError("call prepare() first")
         if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
-            # skip the device entirely; hand back the previous output
-            return None, self._last_out
+            # skip the device entirely; the marker resolves to the CURRENT
+            # last output at fetch() time (capturing _last_out here would
+            # lag the stream by the pipeline depth and step backwards)
+            return None, _SKIP
         squeeze = frame_u8.ndim == 3
         if isinstance(frame_u8, np.ndarray):
             # async host->device upload BEFORE dispatch: a numpy arg makes the
@@ -500,8 +622,8 @@ class StreamEngine:
     def fetch(self, pending) -> np.ndarray:
         """Resolve a handle from :meth:`submit` to a host uint8 array."""
         out, squeeze = pending
-        if out is None:  # similarity-filter skip: squeeze slot holds last out
-            return squeeze
+        if out is None:  # similarity-filter skip: repeat the latest output
+            return self._last_out
         out = np.asarray(out)
         if out.shape[0] == 1 and squeeze:
             out = out[0]

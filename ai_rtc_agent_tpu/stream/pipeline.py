@@ -51,9 +51,32 @@ class StreamDiffusionPipeline:
         lora_dict: dict | None = None,
         seed: int = 2,
         controlnet: str | None = None,
+        use_safety_checker: bool | None = None,
     ):
         self.prompt = prompt
         self.model_id = model_id
+        # optional NSFW gate (reference use_safety_checker,
+        # lib/wrapper.py:930-942); env SAFETY_CHECKER enables it globally
+        if use_safety_checker is None:
+            use_safety_checker = env.get_bool("SAFETY_CHECKER", False)
+        self.safety_checker = None
+        if use_safety_checker:
+            from ..models.safety import SafetyChecker
+
+            # prefer the base model's bundled safety_checker/ subfolder,
+            # else the standalone checkpoint the download CLI ships
+            # (--model-set safety)
+            snap = registry.resolve_snapshot_dir(model_id)
+            from ..models import loader as _LD
+
+            if not snap or not _LD.find_safetensors(snap, "safety_checker"):
+                snap = (
+                    registry.resolve_snapshot_dir(
+                        "CompVis/stable-diffusion-safety-checker"
+                    )
+                    or snap
+                )
+            self.safety_checker = SafetyChecker.load(snap)
         cfg = config or registry.default_stream_config(
             model_id, **({"use_controlnet": True} if controlnet else {})
         )
@@ -80,6 +103,17 @@ class StreamDiffusionPipeline:
             seed=seed,
         )
         self.config = cfg
+        # Serving fast path: adopt a prebuilt AOT engine when one exists
+        # (always), or compile-and-persist one when AOT_ENGINES=1
+        # (reference _load_trt_model-vs-compile split, lib/wrapper.py:583-615)
+        try:
+            adopted = self.engine.use_aot_cache(
+                model_id, build_on_miss=env.get_bool("AOT_ENGINES", False)
+            )
+            if adopted:
+                logger.info("serving from AOT engine cache")
+        except Exception as e:  # cache trouble must never block serving
+            logger.warning("AOT engine adoption failed (%s); using jit", e)
 
     # -- control plane (reference lib/pipeline.py:44-48) --------------------
 
@@ -109,7 +143,10 @@ class StreamDiffusionPipeline:
         return arr
 
     def predict(self, frame_u8: np.ndarray) -> np.ndarray:
-        return self.engine(frame_u8)
+        out = self.engine(frame_u8)
+        if self.safety_checker is not None:
+            out = self.safety_checker(out)
+        return out
 
     def postprocess(self, out_u8: np.ndarray, src_frame=None):
         """Attach timing metadata when the input carried it (VideoFrame
@@ -142,6 +179,8 @@ class StreamDiffusionPipeline:
     def fetch(self, handle, src_frame=None):
         """Resolve a submit() handle; attaches pts metadata like __call__."""
         out = self.engine.fetch(handle)
+        if self.safety_checker is not None:
+            out = self.safety_checker(out)
         if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
             return self.postprocess(out, src_frame)
         return out

@@ -16,3 +16,35 @@ def test_build_engine_tiny(tmp_path, monkeypatch):
     # second build: cache hit (no new blob)
     build("tiny-test", cache_dir=str(tmp_path))
     assert len([f for f in os.listdir(d) if f.endswith(".jaxexport")]) == 1
+
+
+def test_serving_adopts_prebuilt_engine(tmp_path, monkeypatch):
+    """The pipeline must hit the deserialize fast path when the CLI built an
+    engine (reference _load_trt_model fast path, lib/wrapper.py:409-512)."""
+    import numpy as np
+
+    from ai_rtc_agent_tpu.stream.pipeline import StreamDiffusionPipeline
+
+    monkeypatch.setenv("XLA_ENGINES_CACHE", str(tmp_path))
+    build("tiny-test", cache_dir=str(tmp_path))
+
+    pipe = StreamDiffusionPipeline("tiny-test")
+    assert pipe.engine.use_aot_cache("tiny-test", build_on_miss=False)
+    frame = np.random.default_rng(0).integers(0, 256, (64, 64, 3), np.uint8)
+    out = pipe(frame)
+    assert out.shape == (64, 64, 3) and out.dtype == np.uint8
+
+
+def test_no_adoption_without_prebuilt_engine(tmp_path, monkeypatch):
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    monkeypatch.setenv("XLA_ENGINES_CACHE", str(tmp_path))
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test")
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        jit_compile=False,
+    )
+    eng.prepare("x")
+    assert not eng.use_aot_cache("tiny-test", build_on_miss=False)

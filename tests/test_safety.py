@@ -1,0 +1,120 @@
+"""Safety checker (reference lib/wrapper.py:930-942 parity).
+
+Covers: CLIP vision tower shapes, HF key-map round trip, flagging logic
+(threshold crossing incl. the special-care adjustment), frame blanking, and
+the never-flag property of a random-weight checker.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ai_rtc_agent_tpu.models import clip_vision as CV
+from ai_rtc_agent_tpu.models import loader as LD
+from ai_rtc_agent_tpu.models.safety import (
+    SafetyChecker,
+    check_images,
+    init_safety_checker,
+    safety_key_map,
+)
+
+CFG = CV.CLIPVisionConfig.tiny()
+
+
+def _checker(seed=0):
+    params = init_safety_checker(jax.random.PRNGKey(seed), CFG)
+    return SafetyChecker(params=params, cfg=CFG)
+
+
+def test_clip_vision_shapes():
+    p = CV.init_clip_vision(jax.random.PRNGKey(0), CFG)
+    img = jnp.zeros((2, CFG.image_size, CFG.image_size, 3))
+    out = CV.apply_clip_vision(p, img, CFG)
+    assert out["hidden"].shape == (2, CFG.num_patches + 1, CFG.width)
+    assert out["pooled"].shape == (2, CFG.width)
+
+
+def test_preprocess_resizes_and_normalizes():
+    img = jnp.ones((1, 64, 48, 3)) * 0.5
+    x = CV.preprocess_clip(img, CFG)
+    assert x.shape == (1, CFG.image_size, CFG.image_size, 3)
+    expect = (0.5 - np.array(CV.CLIP_MEAN)) / np.array(CV.CLIP_STD)
+    np.testing.assert_allclose(np.asarray(x[0, 0, 0]), expect, atol=1e-5)
+
+
+def test_random_checker_flags_nothing():
+    chk = _checker()
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (3, 40, 40, 3), dtype=np.uint8)
+    out = chk(frames)
+    np.testing.assert_array_equal(out, frames)  # untouched
+
+
+def test_threshold_crossing_flags_and_blanks():
+    chk = _checker()
+    rng = np.random.default_rng(1)
+    frame = rng.integers(0, 256, (40, 40, 3), dtype=np.uint8)
+    # aim concept 0 at this exact frame's embedding -> cosine sim = 1
+    img01 = jnp.asarray(frame[None], jnp.float32) / 255.0
+    x = CV.preprocess_clip(img01, CFG)
+    pooled = CV.apply_clip_vision(chk.params["vision"], x, CFG)["pooled"]
+    from ai_rtc_agent_tpu.models.layers import linear
+
+    emb = linear(chk.params["visual_projection"], pooled)[0]
+    chk.params["concept_embeds"] = (
+        chk.params["concept_embeds"].at[0].set(emb / jnp.linalg.norm(emb))
+    )
+    chk.params["concept_embeds_weights"] = (
+        chk.params["concept_embeds_weights"].at[0].set(0.5)  # sim 1 > 0.5
+    )
+    out = chk(frame)
+    assert (out == 0).all()  # blanked
+    # restoring the threshold above max cosine sim (1.0) must un-flag it
+    chk.params["concept_embeds_weights"] = (
+        chk.params["concept_embeds_weights"].at[0].set(1.5)
+    )
+    np.testing.assert_array_equal(chk(frame), frame)
+
+
+def test_special_care_adjustment():
+    params = init_safety_checker(jax.random.PRNGKey(0), CFG)
+    img = jnp.zeros((1, CFG.image_size, CFG.image_size, 3))
+    # compute the actual embedding, then set special embed to match it with
+    # a threshold it barely crosses, and a concept at exactly threshold-0.005
+    x = CV.preprocess_clip(img, CFG)
+    pooled = CV.apply_clip_vision(params["vision"], x, CFG)["pooled"]
+    from ai_rtc_agent_tpu.models.layers import linear
+
+    emb = linear(params["visual_projection"], pooled)[0]
+    embn = emb / jnp.linalg.norm(emb)
+    params["special_care_embeds"] = params["special_care_embeds"].at[0].set(embn)
+    params["special_care_embeds_weights"] = (
+        params["special_care_embeds_weights"].at[0].set(0.9)
+    )
+    params["concept_embeds"] = params["concept_embeds"].at[0].set(embn)
+    # sim = 1.0; threshold 1.005: only the +0.01 special adjustment crosses
+    params["concept_embeds_weights"] = (
+        params["concept_embeds_weights"].at[0].set(1.005)
+    )
+    flags = check_images(params, img, CFG)
+    assert bool(flags[0])
+    # without the special hit it must NOT flag
+    params["special_care_embeds_weights"] = (
+        params["special_care_embeds_weights"].at[0].set(2.0)
+    )
+    flags = check_images(params, img, CFG)
+    assert not bool(flags[0])
+
+
+def test_safety_key_map_round_trip(tmp_path):
+    params = init_safety_checker(jax.random.PRNGKey(2), CFG)
+    km = safety_key_map(CFG)
+    sd = LD.tree_to_state_dict(params, km)
+    assert "visual_projection.weight" in sd
+    assert sd["vision_model.vision_model.embeddings.patch_embedding.weight"].shape == (
+        CFG.width, 3, CFG.patch_size, CFG.patch_size,
+    )
+    p2, n = LD.load_into_tree(params, sd, km, strict=False)
+    assert n == len(sd)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -138,3 +138,35 @@ def test_guidance_update():
     eng.update_guidance(guidance_scale=2.0, delta=0.8)
     assert float(eng.state["guidance"]) == 2.0
     assert float(eng.state["delta"]) == pytest.approx(0.8)
+
+
+def test_fused_epilogue_parity():
+    """Fused Pallas epilogue == composed XLA ops, bitwise-near (both stream
+    LCM 'self' and turbo 'none' shapes), including ring + stock evolution."""
+    import numpy as np
+
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    rng = np.random.default_rng(7)
+    frames = [rng.integers(0, 256, (64, 64, 3), dtype=np.uint8) for _ in range(3)]
+
+    for overrides in (
+        dict(),  # tiny default: 4-stage LCM stream batch, cfg self
+        dict(t_index_list=(0,), num_inference_steps=1,
+             timestep_spacing="trailing", scheduler="turbo", cfg_type="none"),
+    ):
+        outs = {}
+        for fused in (False, True):
+            bundle = registry.load_model_bundle("tiny-test")
+            cfg = registry.default_stream_config(
+                "tiny-test", use_fused_epilogue=fused, **overrides
+            )
+            eng = StreamEngine(
+                bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+                jit_compile=False, donate=False,
+            )
+            eng.prepare("parity", guidance_scale=1.4, delta=0.7, seed=5)
+            outs[fused] = [np.asarray(eng(f), np.int32) for f in frames]
+        for a, b in zip(outs[False], outs[True]):
+            assert np.abs(a - b).max() <= 1, overrides  # uint8 rounding slack

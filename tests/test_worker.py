@@ -1,0 +1,48 @@
+"""Serverless worker sidecar (reference runpod/handler.py parity)."""
+
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from ai_rtc_agent_tpu.server import worker
+
+
+def _serve_health(port, status=200, n_requests=10):
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(status)
+            self.end_headers()
+            self.wfile.write(b"OK")
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", port), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def test_handler_publishes_and_holds():
+    srv = _serve_health(18931)
+    published = []
+    rc = worker.handler(
+        18931, publish=published.append, sleep=lambda s: published.append(("slept", s))
+    )
+    srv.shutdown()
+    assert rc == 0
+    info = published[0]
+    assert info["status"] == "ready"
+    assert info["public_port"] == "18931"
+    assert published[1][0] == "slept"
+
+
+def test_handler_fails_when_agent_down(monkeypatch):
+    monkeypatch.setattr(worker, "HEALTH_BUDGET_S", 1.5)
+    rc = worker.handler(18999, publish=lambda i: None, sleep=lambda s: None)
+    assert rc == 1
+
+
+def test_check_server_times_out():
+    t0 = __import__("time").monotonic()
+    assert not worker.check_server("http://127.0.0.1:18998/", budget_s=1.0)
+    assert __import__("time").monotonic() - t0 < 5
