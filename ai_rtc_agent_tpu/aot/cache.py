@@ -78,11 +78,14 @@ class EngineCache:
         _, blob_path, _ = self._paths(key, digest)
         return os.path.exists(blob_path)
 
-    def load_or_build(self, key: str, fn, example_args, donate_argnums=()):
+    def load_or_build(self, key: str, fn, example_args, donate_argnums=(),
+                      build: bool = True):
         """Return a callable backed by a cached executable when possible.
 
         ``fn`` must be a pure function; ``example_args`` a tuple of arrays /
-        ShapeDtypeStructs defining the static signature.
+        ShapeDtypeStructs defining the static signature.  With
+        ``build=False``, a miss (including an unreadable blob) returns None
+        instead of compiling — the caller keeps its plain jit path.
         """
         platform = jax.default_backend()
         specs, args_spec, digest = self._signature(key, example_args)
@@ -94,8 +97,10 @@ class EngineCache:
                     exp = jax_export.deserialize(f.read())
                 logger.info("engine cache HIT %s (%s)", key, digest)
                 return exp.call
-            except Exception as e:  # corrupted/incompatible: rebuild
-                logger.warning("engine cache entry unreadable (%s); rebuilding", e)
+            except Exception as e:  # corrupted/incompatible
+                logger.warning("engine cache entry unreadable (%s)", e)
+        if not build:
+            return None
 
         logger.info("engine cache MISS %s — compiling (first run is slow)", key)
         t0 = time.time()

@@ -24,13 +24,22 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
-def build(model_id: str, lora_dict: dict | None = None, cache_dir: str | None = None):
+def build(
+    model_id: str,
+    lora_dict: dict | None = None,
+    cache_dir: str | None = None,
+    controlnet: str | None = None,
+):
     from ..aot.cache import EngineCache
     from ..models import registry
     from ..stream.engine import StreamEngine, make_step_fn, stream_engine_key
 
-    bundle = registry.load_model_bundle(model_id, lora_dict=lora_dict)
-    cfg = registry.default_stream_config(model_id)
+    bundle = registry.load_model_bundle(
+        model_id, lora_dict=lora_dict, controlnet=controlnet
+    )
+    cfg = registry.default_stream_config(
+        model_id, **({"use_controlnet": True} if controlnet else {})
+    )
     engine = StreamEngine(
         bundle.stream_models,
         bundle.params,
@@ -70,12 +79,17 @@ def main(argv=None):
         help="path.safetensors:scale (repeatable)",
     )
     ap.add_argument("--cache-dir", default=None)
+    ap.add_argument(
+        "--controlnet", default=None,
+        help="ControlNet model id: builds the conditioned engine variant "
+             "(reference lib/wrapper.py:870-877)",
+    )
     args = ap.parse_args(argv)
     lora_dict = {}
     for spec in args.lora:
         path, _, scale = spec.rpartition(":")
         lora_dict[path or spec] = float(scale) if path else 1.0
-    build(args.model_id, lora_dict or None, args.cache_dir)
+    build(args.model_id, lora_dict or None, args.cache_dir, args.controlnet)
 
 
 if __name__ == "__main__":

@@ -52,13 +52,13 @@ def check_server(url: str, budget_s: float = HEALTH_BUDGET_S) -> bool:
     return False
 
 
-def default_publish(info: dict) -> None:
+def default_publish(info: dict) -> bool:
     """POST connection info to WORKER_PUBLISH_URL (Bearer AUTH_TOKEN) —
-    the generic analog of Runpod's progress_update."""
+    the generic analog of Runpod's progress_update.  Returns success."""
     url = env.get_str("WORKER_PUBLISH_URL")
     if not url:
         logger.info("no WORKER_PUBLISH_URL; connection info: %s", info)
-        return
+        return True
     req = urllib.request.Request(
         url,
         data=json.dumps(info).encode(),
@@ -74,18 +74,22 @@ def default_publish(info: dict) -> None:
     try:
         with urllib.request.urlopen(req, timeout=5) as r:
             logger.info("published worker info (%d)", r.status)
+            return 200 <= r.status < 300
     except (urllib.error.URLError, OSError) as e:
         logger.warning("worker publish failed: %s", e)
+        return False
 
 
 def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
     """One worker job: await agent, publish identity, hold the lease.
 
-    Returns 0 on success, 1 if the agent never became healthy (the
-    orchestrator should recycle the worker — the reference just errors)."""
+    Returns 0 on success, 1 if the agent never became healthy, 2 if the
+    connection info could not be published (a worker nobody can reach is
+    useless — exit promptly so the orchestrator recycles it instead of
+    burning the whole lease invisible)."""
     if not check_server(f"http://127.0.0.1:{agent_port}/", HEALTH_BUDGET_S):
         return 1
-    publish(
+    ok = publish(
         {
             "worker_id": os.getenv("WORKER_ID", os.uname().nodename),
             "public_ip": os.getenv("PUBLIC_IP", ""),
@@ -93,6 +97,8 @@ def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
             "status": "ready",
         }
     )
+    if ok is False:  # None (no return value) counts as success
+        return 2
     keep_alive = env.get_int("AGENT_TIMEOUT", 600)
     logger.info("holding worker lease for %ds", keep_alive)
     sleep(keep_alive)

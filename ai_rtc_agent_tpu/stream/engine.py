@@ -98,10 +98,6 @@ class StreamConfig:
         return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
 
 
-# sentinel marking a similarity-filter skip in a submit() handle
-_SKIP = object()
-
-
 @dataclass
 class StreamModels:
     """Apply-fn bundle the engine drives (duck-typed, so any model family —
@@ -466,6 +462,7 @@ class StreamEngine:
         self.state = None
         self._skip_count = 0
         self._last_out = None
+        self._last_submitted = None
         self._prev_frame_small = None
 
     # -- state construction -------------------------------------------------
@@ -578,7 +575,12 @@ class StreamEngine:
         if not build_on_miss and not cache.has(key, args):
             return False
         step = make_step_fn(self.models, self.cfg)
-        self._step = cache.load_or_build(key, step, args, donate_argnums=(1,))
+        call = cache.load_or_build(
+            key, step, args, donate_argnums=(1,), build=build_on_miss
+        )
+        if call is None:  # unreadable blob with build_on_miss=False
+            return False
+        self._step = call
         return True
 
     # -- hot path -----------------------------------------------------------
@@ -602,10 +604,14 @@ class StreamEngine:
         if self.state is None:
             raise RuntimeError("call prepare() first")
         if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
-            # skip the device entirely; the marker resolves to the CURRENT
-            # last output at fetch() time (capturing _last_out here would
-            # lag the stream by the pipeline depth and step backwards)
-            return None, _SKIP
+            # skip the device step entirely: the handle DUPLICATES the most
+            # recently submitted output buffer, so resolution order stays
+            # correct even when fetches run concurrently on pool threads
+            # (resolving against host-side _last_out would race the
+            # in-flight frames and step the stream backwards)
+            if self._last_submitted is not None:
+                return ("dup",) + self._last_submitted
+            return None, frame_u8.ndim == 3
         squeeze = frame_u8.ndim == 3
         if isinstance(frame_u8, np.ndarray):
             # async host->device upload BEFORE dispatch: a numpy arg makes the
@@ -617,12 +623,16 @@ class StreamEngine:
             out.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass
+        self._last_submitted = (out, squeeze)
         return out, squeeze
 
     def fetch(self, pending) -> np.ndarray:
         """Resolve a handle from :meth:`submit` to a host uint8 array."""
-        out, squeeze = pending
-        if out is None:  # similarity-filter skip: repeat the latest output
+        if len(pending) == 3:  # ("dup", out, squeeze): similarity skip
+            _, out, squeeze = pending
+        else:
+            out, squeeze = pending
+        if out is None:  # skip before any real frame was submitted
             return self._last_out
         out = np.asarray(out)
         if out.shape[0] == 1 and squeeze:

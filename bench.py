@@ -31,7 +31,7 @@ logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 logger = logging.getLogger("bench")
 
 
-def build_engine(config: str):
+def build_engine(config: str, fbs: int = 1):
     import jax
     import jax.numpy as jnp
 
@@ -54,6 +54,8 @@ def build_engine(config: str):
     else:
         raise ValueError(config)
 
+    if fbs > 1:
+        overrides["frame_buffer_size"] = fbs
     bundle = registry.load_model_bundle(model_id, controlnet=controlnet)
     cfg = registry.default_stream_config(model_id, **overrides)
     if dtype == "bfloat16":
@@ -105,15 +107,20 @@ def _pipelined_loop(submit, fetch, make_frame, n_iters: int,
     }, out
 
 
-def run_bench(config: str, frames: int, pipeline_depth: int = 4):
+def run_bench(config: str, frames: int, pipeline_depth: int = 4, fbs: int = 1):
     """Streaming benchmark: frames are SUBMITTED as they 'arrive' and results
     fetched ``pipeline_depth`` frames later — the dispatch pipeline stays
     full, exactly like the async serving loop (stream/engine.py submit/fetch).
     fps = sustained throughput; latency = submit->fetch wall time per frame.
+
+    ``fbs`` > 1 batches frames per step (the reference's frame_buffer_size,
+    lib/wrapper.py:159-163): one dispatch + one readback amortize over fbs
+    frames at the cost of fbs frames of extra latency.
     """
-    eng, cfg = build_engine(config)
+    eng, cfg = build_engine(config, fbs=fbs)
     rng = np.random.default_rng(0)
-    frame = rng.integers(0, 256, (cfg.height, cfg.width, 3), dtype=np.uint8)
+    shape = (cfg.height, cfg.width, 3) if fbs == 1 else (fbs, cfg.height, cfg.width, 3)
+    frame = rng.integers(0, 256, shape, dtype=np.uint8)
     frame_flipped = frame[::-1].copy()
 
     # warm-up: compile + cache (reference drops 10 warm-up frames at connect,
@@ -123,10 +130,11 @@ def run_bench(config: str, frames: int, pipeline_depth: int = 4):
         eng(frame)
     logger.info("warm-up (incl. compile): %.1fs", time.monotonic() - t0)
 
+    ticks = max(1, frames // fbs)
     r, _ = _pipelined_loop(
         eng.submit, eng.fetch,
         lambda i: frame if i % 2 == 0 else frame_flipped,
-        frames, pipeline_depth, 1,
+        ticks, pipeline_depth, fbs,
     )
     return r
 
@@ -176,6 +184,8 @@ def main():
                              "controlnet512", "multipeer"])
     ap.add_argument("--frames", type=int, default=30)
     ap.add_argument("--peers", type=int, default=4)
+    ap.add_argument("--fbs", type=int, default=1,
+                    help="frames per stream-batch step (frame_buffer_size)")
     args = ap.parse_args()
 
     import jax
@@ -185,7 +195,7 @@ def main():
         if args.config == "multipeer":
             r = run_bench_multipeer(args.frames, args.peers)
         else:
-            r = run_bench(args.config, args.frames)
+            r = run_bench(args.config, args.frames, fbs=args.fbs)
         result = {
             "metric": f"e2e_fps_{args.config}_singlechip",
             "value": round(r["fps"], 2),
@@ -195,6 +205,10 @@ def main():
             "latency_p90_ms": round(r["latency_p90_ms"], 1),
             "backend": backend,
         }
+        if "peers" in r:
+            result["peers"] = r["peers"]
+        if args.fbs > 1:
+            result["fbs"] = args.fbs
     except Exception as e:  # still emit the contract line on failure
         logger.exception("bench failed")
         result = {

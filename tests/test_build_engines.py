@@ -48,3 +48,12 @@ def test_no_adoption_without_prebuilt_engine(tmp_path, monkeypatch):
     )
     eng.prepare("x")
     assert not eng.use_aot_cache("tiny-test", build_on_miss=False)
+
+
+def test_build_controlnet_engine_variant(tmp_path):
+    """ControlNet engine variant gets its own cache key (reference compiles a
+    separate UNet+ControlNet engine, lib/wrapper.py:870-877)."""
+    key_plain = build("tiny-test", cache_dir=str(tmp_path))
+    key_cnet = build("tiny-test", cache_dir=str(tmp_path), controlnet="tiny-cnet")
+    assert key_plain != key_cnet
+    assert os.path.isdir(os.path.join(tmp_path, key_cnet))
