@@ -1,0 +1,56 @@
+# TPU-native agent image — the deployment analog of the reference's 2-stage
+# CUDA build (reference Dockerfile:1-68), re-targeted at Cloud TPU VMs:
+# no CUDA/TensorRT stages, jax[tpu] wheels carry the TPU runtime (libtpu),
+# the native media shim builds against the distro toolchain and dlopens the
+# distro libavcodec at runtime (native/h264.cpp).
+#
+# Build:  docker build -t ai-rtc-agent-tpu .
+# Run (on a TPU VM, which exposes /dev/accel*):
+#   docker run --privileged --net=host \
+#     -v /var/cache/models:/models ai-rtc-agent-tpu
+
+FROM python:3.11-slim-bookworm AS builder
+
+WORKDIR /app
+
+# toolchain for the native media runtime (frame ring / RTP / H.264 shim)
+RUN apt-get update && \
+  apt-get install -y --no-install-recommends build-essential make && \
+  rm -rf /var/lib/apt/lists/*
+
+# TPU jax + serving deps (torch/TensorRT have no role here)
+RUN pip install --no-cache-dir "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && \
+    pip install --no-cache-dir aiohttp huggingface_hub numpy
+
+COPY native /app/native
+RUN make -C /app/native
+
+FROM python:3.11-slim-bookworm
+
+WORKDIR /app
+
+# runtime codec libraries: the native shim dlopens libavcodec 5.x
+# (replaces the reference's NVENC/NVDEC + ffmpeg stack, Dockerfile:42)
+RUN apt-get update && \
+  apt-get install -y --no-install-recommends libavcodec59 libavutil57 ffmpeg && \
+  rm -rf /var/lib/apt/lists/*
+
+COPY --from=builder /usr/local/lib/python3.11 /usr/local/lib/python3.11
+COPY --from=builder /usr/local/bin /usr/local/bin
+COPY --from=builder /app/native /app/native
+
+# cache layout parity (reference Dockerfile:49-57)
+ENV HF_HOME=/models
+ENV HF_HUB_CACHE=/models/hub
+ENV CIVITAI_CACHE=/models/civitai
+ENV XLA_ENGINES_CACHE=/models/engines
+# host-CPU H.264 through the native shim (the NVENC/NVDEC analog)
+ENV HW_ENCODE=true
+ENV HW_DECODE=true
+ENV PYTHONUNBUFFERED=1
+
+COPY ai_rtc_agent_tpu /app/ai_rtc_agent_tpu
+COPY bench.py /app/bench.py
+
+CMD ["python", "-m", "ai_rtc_agent_tpu.server.agent"]

@@ -25,6 +25,9 @@ class VideoFrame:
     _rgb: np.ndarray  # [H,W,3] uint8
     pts: int | None = None
     time_base: Fraction | None = None
+    # wall-clock of decode completion; carried through the pipeline so the
+    # encoder side can compute true glass-to-glass latency (/metrics `glass`)
+    wall_ts: float | None = None
 
     @classmethod
     def from_ndarray(cls, arr: np.ndarray, format: str = "rgb24") -> "VideoFrame":
@@ -47,3 +50,15 @@ class VideoFrame:
     @property
     def height(self) -> int:
         return self._rgb.shape[0]
+
+
+def wrap_processed(out_u8: np.ndarray, src_frame) -> "VideoFrame":
+    """Wrap a processed frame with the SOURCE frame's timing metadata —
+    the single place the pts/time_base/wall_ts propagation contract lives
+    (reference preserves pts/time_base at lib/pipeline.py:89-93; wall_ts
+    feeds the glass-to-glass gauge)."""
+    vf = VideoFrame.from_ndarray(out_u8)
+    vf.pts = src_frame.pts
+    vf.time_base = src_frame.time_base
+    vf.wall_ts = getattr(src_frame, "wall_ts", None)
+    return vf

@@ -96,13 +96,26 @@ def _attn(layer, x, heads: int):
 
 
 def preprocess_clip(img01_nhwc, cfg: CLIPVisionConfig):
-    """[N,H,W,3] in [0,1] -> resized + CLIP-normalized [N,S,S,3]."""
+    """[N,H,W,3] in [0,1] -> resized + CLIP-normalized [N,S,S,3].
+
+    Matches HF ``CLIPFeatureExtractor`` (the reference pairs the safety
+    checker with it, lib/wrapper.py:930-942): shortest-edge resize to S with
+    bicubic interpolation, then center crop to SxS — NOT a squash-resize,
+    which skews near-threshold scores on non-square frames.
+    """
     n, h, w, c = img01_nhwc.shape
     s = cfg.image_size
     if (h, w) != (s, s):
+        # shortest-edge resize (static shapes: h, w are trace-time python ints)
+        if h <= w:
+            rh, rw = s, max(s, int(round(w * s / h)))
+        else:
+            rh, rw = max(s, int(round(h * s / w))), s
         img01_nhwc = jax.image.resize(
-            img01_nhwc, (n, s, s, c), method="bilinear"
+            img01_nhwc, (n, rh, rw, c), method="cubic"
         )
+        top, left = (rh - s) // 2, (rw - s) // 2
+        img01_nhwc = img01_nhwc[:, top : top + s, left : left + s, :]
     mean = jnp.asarray(CLIP_MEAN, img01_nhwc.dtype)
     std = jnp.asarray(CLIP_STD, img01_nhwc.dtype)
     return (img01_nhwc - mean) / std

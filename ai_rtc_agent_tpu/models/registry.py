@@ -118,6 +118,16 @@ def _model_configs(fam: str):
     raise ValueError(fam)
 
 
+def cast_params(params, dtype: str):
+    """Cast fp32 param leaves to the serving compute dtype (bf16 on TPU);
+    non-fp32 leaves (ints, embeddings tables already cast) pass through."""
+    if dtype != "bfloat16":
+        return params
+    return jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a, params
+    )
+
+
 def resolve_snapshot_dir(model_id: str) -> str | None:
     """Find a local HF snapshot for model_id (no network; HF_HUB_CACHE layout
     parity with reference Dockerfile:50)."""
@@ -225,8 +235,18 @@ def load_model_bundle(
     # ---- closures ---------------------------------------------------------
 
     # Pallas flash attention on real TPUs (no [L,L] score matrix in HBM);
-    # plain XLA attention elsewhere (pallas interpret mode is slow on CPU)
-    attn_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    # plain XLA attention elsewhere (pallas interpret mode is slow on CPU).
+    # ATTN_IMPL env overrides (xla | pallas | ring | ulysses — the sp modes
+    # route through parallel/ring_attention under an sp_attention_mesh).
+    attn_impl = os.getenv("ATTN_IMPL") or (
+        "pallas" if jax.default_backend() == "tpu" else "xla"
+    )
+    if attn_impl not in ("xla", "pallas", "ring", "ulysses"):
+        # fail fast: a typo would otherwise silently fall through to the
+        # dense-XLA branch and serve with the flash path disabled
+        raise ValueError(
+            f"ATTN_IMPL={attn_impl!r} unknown (xla | pallas | ring | ulysses)"
+        )
 
     def unet_apply(p, x, t, ctx, added, down_residuals=None, mid_residual=None):
         return U.apply_unet(

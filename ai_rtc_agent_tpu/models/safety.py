@@ -18,6 +18,7 @@ in-graph); the host only reads back a [N] bool vector.
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass
 from functools import partial
 
@@ -135,6 +136,9 @@ class SafetyChecker:
         self._check = jax.jit(partial(check_images, cfg=self.cfg))
         self._memo_in = None
         self._memo_out = None
+        # pipeline.fetch runs on worker threads and tracks share one
+        # pipeline — the memo read-compare-update must be atomic
+        self._memo_lock = threading.Lock()
 
     @staticmethod
     def load(snapshot_dir: str | None = None, cfg: CV.CLIPVisionConfig | None = None,
@@ -179,20 +183,22 @@ class SafetyChecker:
         # unique among live objects — a params swap always invalidates.
         leaves = jax.tree.leaves(self.params)
         token = tuple(map(id, leaves))
-        if (
-            self._memo_in is not None
-            and getattr(self, "_memo_token", None) == token
-            and batch.shape == self._memo_in.shape
-            and np.array_equal(batch, self._memo_in)
-        ):
-            flags = self._memo_flags
-        else:
+        with self._memo_lock:
+            hit = (
+                self._memo_in is not None
+                and getattr(self, "_memo_token", None) == token
+                and batch.shape == self._memo_in.shape
+                and np.array_equal(batch, self._memo_in)
+            )
+            flags = self._memo_flags if hit else None
+        if flags is None:
             img01 = jnp.asarray(batch, jnp.float32) / 255.0
             flags = np.asarray(self._check(self.params, img01))
-            self._memo_in = batch.copy()
-            self._memo_flags = flags
-            self._memo_token = token
-            self._memo_leaves = leaves
+            with self._memo_lock:
+                self._memo_in = batch.copy()
+                self._memo_flags = flags
+                self._memo_token = token
+                self._memo_leaves = leaves
         if flags.any():
             batch = batch.copy()
             batch[flags] = 0

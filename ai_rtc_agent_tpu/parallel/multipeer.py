@@ -19,6 +19,7 @@ batched on one TPU chip") and the honest replacement for DataParallel
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Callable
 
 import jax
@@ -26,9 +27,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..stream.engine import StreamConfig, StreamEngine, StreamModels, make_step_fn
+from ..stream.engine import (
+    StreamConfig,
+    StreamEngine,
+    StreamModels,
+    _coeff_state,
+    make_step_fn,
+)
 
 logger = logging.getLogger(__name__)
+
+
+class CapacityError(RuntimeError):
+    """All peer slots are claimed (maps to HTTP 503 in the agent)."""
 
 
 class MultiPeerEngine:
@@ -74,10 +85,15 @@ class MultiPeerEngine:
             self._step = jax.jit(vstep, donate_argnums=(1,))
         self.states = None  # stacked pytree [P, ...]
         self.active = [False] * max_peers
+        # guards the shared template engine during heavy state builds
+        # (text-encode + prepare) so concurrent connects don't race it;
+        # deliberately separate from any caller-level step lock
+        self._heavy_lock = threading.Lock()
 
     def _fresh_state(self, prompt: str, seed: int):
-        self._template.prepare(prompt, seed=seed)
-        return self._template.state
+        with self._heavy_lock:
+            self._template.prepare(prompt, seed=seed)
+            return self._template.state
 
     def start(self, default_prompt: str = ""):
         per_slot = [self._fresh_state(default_prompt, seed=i) for i in range(self.max_peers)]
@@ -86,25 +102,85 @@ class MultiPeerEngine:
 
     # -- slot management ----------------------------------------------------
 
-    def connect(self, prompt: str, seed: int | None = None) -> int:
-        slot = self.active.index(False)
+    @property
+    def free_slots(self) -> int:
+        return self.active.count(False)
+
+    def reserve(self) -> int:
+        """Cheap slot claim (no model work — safe under a serving lock)."""
+        try:
+            slot = self.active.index(False)
+        except ValueError:
+            raise CapacityError(
+                f"all {self.max_peers} peer slots in use"
+            ) from None
         self.active[slot] = True
-        self._set_slot_state(
-            slot, self._fresh_state(prompt, seed=slot if seed is None else seed)
-        )
+        return slot
+
+    def build_state(self, prompt: str, seed: int):
+        """The HEAVY half of connect (text-encode + prepare) — run it
+        outside any lock that gates the vmapped step."""
+        return self._fresh_state(prompt, seed=seed)
+
+    def install(self, slot: int, state):
+        """Cheap slot-state write (device .at[slot].set)."""
+        self._set_slot_state(slot, state)
         logger.info("peer connected -> slot %d", slot)
+
+    def connect(self, prompt: str, seed: int | None = None) -> int:
+        slot = self.reserve()
+        try:
+            self.install(
+                slot, self.build_state(prompt, seed=slot if seed is None else seed)
+            )
+        except Exception:
+            self.active[slot] = False
+            raise
         return slot
 
     def disconnect(self, slot: int):
+        """Release a slot.  No state reset here: connect() always installs a
+        fresh state before the slot is reused, and inactive slots' outputs
+        are discarded — a reset would cost a full prepare() per disconnect
+        and stall every live peer."""
+        if not (0 <= slot < self.max_peers):
+            raise ValueError(f"slot {slot} out of range [0, {self.max_peers})")
         self.active[slot] = False
         logger.info("peer disconnected <- slot %d", slot)
+
+    def encode(self, prompt: str):
+        """Heavy half of a prompt update (text-encoder forward) — call it
+        OUTSIDE any lock that gates the step."""
+        with self._heavy_lock:
+            return self._template_encode(prompt)
+
+    def apply_prompt(self, slot: int, cond, uncond, extras):
+        """Cheap half: write the pre-encoded embeddings into the slot."""
+        self._set_slot_leaf(("cond",), slot, cond)
+        self._set_slot_leaf(("uncond",), slot, uncond)
+        # SDXL-style conditioning extras must swap with the prompt too
+        # (round-1 defect: pooled embeds silently kept the old prompt's)
+        if self.cfg.use_added_cond and "pooled" in extras:
+            self._set_slot_leaf(("added_text",), slot, extras["pooled"])
 
     def update_prompt(self, slot: int, prompt: str):
         """Per-peer prompt update (an upgrade over the reference's global
         prompt mutation, agent.py:154-168)."""
-        cond, uncond, extras = self._template_encode(prompt)
-        self._set_slot_leaf(("cond",), slot, cond)
-        self._set_slot_leaf(("uncond",), slot, uncond)
+        self.apply_prompt(slot, *self.encode(prompt))
+
+    def update_t_index(self, slot: int, t_index_list):
+        """Per-peer t_index update: a coefficient swap into this slot's
+        state rows, zero recompile (same-length rule as
+        StreamEngine.update_t_index_list)."""
+        t_index_list = tuple(int(t) for t in t_index_list)
+        if len(t_index_list) != self.cfg.n_stages:
+            raise ValueError(
+                f"t_index_list length must stay {self.cfg.n_stages} "
+                "(compiled batch size)"
+            )
+        coeffs = _coeff_state(self.cfg, self._template.schedule, t_index_list)
+        for k, v in coeffs.items():
+            self.states["coeffs"][k] = self.states["coeffs"][k].at[slot].set(v)
 
     def _template_encode(self, prompt):
         res = self.encode_prompt(prompt)
@@ -134,8 +210,13 @@ class MultiPeerEngine:
         if frames.shape[0] != self.max_peers:
             raise ValueError(f"expected {self.max_peers} frame slots, got {frames.shape[0]}")
         if isinstance(frames, np.ndarray):
-            # async upload before dispatch (same rationale as engine.submit)
-            frames = jax.device_put(frames)
+            # async upload before dispatch (same rationale as engine.submit);
+            # on a dp mesh, land the batch PRE-SHARDED so the jitted step
+            # never gathers the whole batch onto device 0
+            if self.mesh is not None and self.mesh.shape.get("dp", 1) > 1:
+                frames = jax.device_put(frames, NamedSharding(self.mesh, P("dp")))
+            else:
+                frames = jax.device_put(frames)
         self.states, out = self._step(self.params, self.states, frames)
         try:
             out.copy_to_host_async()

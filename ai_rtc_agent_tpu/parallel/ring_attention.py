@@ -64,9 +64,10 @@ def _ring_body(q, k, v, axis: str):
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp"):
-    """q,k,v: [B, L, H, D] globally; L sharded over `axis`."""
-    spec = P(None, axis, None, None)
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", batch_axis=None):
+    """q,k,v: [B, L, H, D] globally; L sharded over `axis`.  ``batch_axis``
+    optionally co-shards the batch dim (composes with dp under one jit)."""
+    spec = P(batch_axis, axis, None, None)
     f = shard_map(
         partial(_ring_body, axis=axis),
         mesh=mesh,
@@ -93,14 +94,41 @@ def _ulysses_body(q, k, v, axis: str):
     return lax.all_to_all(og, axis_name=axis, split_axis=1, concat_axis=2, tiled=True)
 
 
-def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp"):
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", batch_axis=None):
     """q,k,v: [B, L, H, D] globally; L sharded over `axis`; needs H % n == 0."""
-    spec = P(None, axis, None, None)
+    spec = P(batch_axis, axis, None, None)
     f = shard_map(
         partial(_ulysses_body, axis=axis),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_rep=False,
+    )
+    return f(q, k, v)
+
+
+def _cross_body(q, k, v, axis: str):
+    """Cross-attention under SP: queries stay sharded over `axis`, the short
+    encoder context (77 CLIP tokens) is replicated — every chip attends its
+    own query slice against the full K/V with zero collectives."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = (
+        jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        * scale
+    )
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def sp_cross_attention(q, k, v, mesh: Mesh, axis: str = "sp", batch_axis=None):
+    """q: [B, Lq, H, D] sharded over `axis`; k,v: [B, Lk, H, D] replicated."""
+    qspec = P(batch_axis, axis, None, None)
+    kvspec = P(batch_axis, None, None, None)
+    f = shard_map(
+        partial(_cross_body, axis=axis),
+        mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec),
+        out_specs=qspec,
         check_rep=False,
     )
     return f(q, k, v)

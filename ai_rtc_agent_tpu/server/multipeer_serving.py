@@ -1,0 +1,256 @@
+"""Serve N concurrent peers off ONE batched engine (--multipeer N).
+
+The reference shares a single mutable pipeline across peers — every prompt
+update is global and frames are processed serially per track (reference
+agent.py:144-176, 423-430).  Here the agent serves BASELINE configs[4]
+properly: each WebRTC connection claims a slot in a ``MultiPeerEngine``
+(parallel/multipeer.py), a coordinator thread batches one frame per active
+slot into a single vmapped device step, and per-peer datachannel messages
+update only that peer's prompt/t-indices.
+
+Design notes
+* ``PeerPipeline`` duck-types the pipeline surface ``VideoStreamTrack``
+  expects (__call__ / submit / fetch / update_prompt / update_t_index_list),
+  so the track layer is identical for single- and multi-peer serving.
+* The coordinator owns the engine: all state mutations (step, prompt swaps,
+  slot resets) happen under one lock, so per-peer control traffic can never
+  race the vmapped step.
+* Each tick consumes at most ONE queued frame per slot (a peer's stream
+  advances one stream-batch stage per step, exactly like single-peer);
+  slots with no fresh frame re-feed their last frame and their output is
+  discarded — the batch shape is static, which is what keeps the step AOT
+  compatible.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..models import registry
+from ..parallel.multipeer import CapacityError, MultiPeerEngine
+from ..stream.pipeline import DEFAULT_PROMPT, coerce_frame
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MultiPeerPipeline", "PeerPipeline", "CapacityError"]
+
+
+class PeerPipeline:
+    """Per-peer view over the shared batched engine (one claimed slot)."""
+
+    def __init__(self, owner: "MultiPeerPipeline", slot: int):
+        self._owner = owner
+        self.slot = slot
+        self._released = False
+
+    # -- pipeline duck-type (VideoStreamTrack surface) ----------------------
+
+    def submit(self, frame):
+        arr = coerce_frame(frame, self._owner.height, self._owner.width)
+        return self._owner._enqueue(self.slot, arr)
+
+    def fetch(self, handle: Future, src_frame=None):
+        out = handle.result(timeout=self._owner.fetch_timeout)
+        if src_frame is not None and hasattr(src_frame, "pts"):
+            from ..media.frames import wrap_processed
+
+            return wrap_processed(out, src_frame)
+        return out
+
+    def __call__(self, frame):
+        return self.fetch(self.submit(frame), frame)
+
+    # -- per-peer control plane --------------------------------------------
+
+    def update_prompt(self, prompt: str):
+        # text-encode outside the coordinator lock; only the embedding
+        # writes go through _control
+        encoded = self._owner.engine.encode(prompt)
+        self._owner._control(lambda e: e.apply_prompt(self.slot, *encoded))
+
+    def update_t_index_list(self, t_index_list):
+        self._owner._control(lambda e: e.update_t_index(self.slot, t_index_list))
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._owner.release(self.slot)
+
+
+class MultiPeerPipeline:
+    """Owns the MultiPeerEngine + the batching coordinator thread."""
+
+    def __init__(
+        self,
+        model_id: str = "stabilityai/sd-turbo",
+        max_peers: int = 4,
+        config=None,
+        prompt: str = DEFAULT_PROMPT,
+        mesh=None,
+        fetch_timeout: float = 120.0,
+        controlnet: str | None = None,
+    ):
+        cfg = config or registry.default_stream_config(
+            model_id, **({"use_controlnet": True} if controlnet else {})
+        )
+        bundle = registry.load_model_bundle(
+            model_id, controlnet=controlnet, latent_scale=cfg.latent_scale
+        )
+        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
+        self.engine = MultiPeerEngine(
+            bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+            max_peers=max_peers, mesh=mesh,
+        ).start(prompt)
+        self.config = cfg
+        self.height, self.width = cfg.height, cfg.width
+        self.max_peers = max_peers
+        self.fetch_timeout = fetch_timeout
+
+        self._lock = threading.Lock()  # guards engine state + queues
+        self._has_work = threading.Condition(self._lock)
+        self._queues = [deque() for _ in range(max_peers)]  # (frame, Future)
+        self._last_frame = [
+            np.zeros((cfg.height, cfg.width, 3), np.uint8) for _ in range(max_peers)
+        ]
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="multipeer-coordinator", daemon=True
+        )
+        self._thread.start()
+
+    # -- slot lifecycle ------------------------------------------------------
+
+    def claim(self, prompt: str | None = None) -> PeerPipeline:
+        """Claim a slot for a new connection; raises CapacityError when full
+        (the agent maps it to HTTP 503).
+
+        The heavy state build (text-encode + prepare) runs OUTSIDE the
+        coordinator lock so live peers keep stepping while someone joins;
+        only the reserve and the slot-row writes hold it."""
+        with self._lock:
+            slot = self.engine.reserve()
+        try:
+            state = self.engine.build_state(
+                prompt if prompt is not None else DEFAULT_PROMPT, seed=slot
+            )
+        except Exception:
+            with self._lock:
+                self.engine.disconnect(slot)
+            raise
+        with self._lock:
+            self.engine.install(slot, state)
+            self._queues[slot].clear()
+            self._last_frame[slot][:] = 0
+        return PeerPipeline(self, slot)
+
+    def release(self, slot: int):
+        with self._lock:
+            for _, fut in self._queues[slot]:
+                fut.cancel()
+            self._queues[slot].clear()
+            self.engine.disconnect(slot)
+
+    @property
+    def free_slots(self) -> int:
+        with self._lock:
+            return self.engine.free_slots
+
+    # -- global control plane (POST /config parity: the reference's config
+    # endpoint mutates every peer, agent.py:398-412) ------------------------
+
+    def update_prompt(self, prompt: str):
+        encoded = self.engine.encode(prompt)  # heavy — outside the lock
+        with self._lock:
+            for s, active in enumerate(self.engine.active):
+                if active:
+                    self.engine.apply_prompt(s, *encoded)
+
+    def update_t_index_list(self, t_index_list):
+        with self._lock:
+            for s, active in enumerate(self.engine.active):
+                if active:
+                    self.engine.update_t_index(s, t_index_list)
+
+    # -- coordinator ---------------------------------------------------------
+
+    def _enqueue(self, slot: int, frame: np.ndarray) -> Future:
+        fut: Future = Future()
+        with self._has_work:
+            self._queues[slot].append((frame, fut))
+            self._has_work.notify()
+        return fut
+
+    def _control(self, apply):
+        """Run a per-peer engine mutation under the coordinator lock."""
+        with self._lock:
+            apply(self.engine)
+
+    # keep up to this many all-peers steps in flight: step N's readback
+    # overlaps step N+1's dispatch (same rationale as the single-peer
+    # submit/fetch pipeline, stream/engine.py)
+    PIPELINE_DEPTH = 2
+
+    def _run(self):
+        from collections import deque as _dq
+
+        inflight: _dq = _dq()  # (pending_handle, futs)
+        while True:
+            with self._has_work:
+                while not self._stop and not any(self._queues) and not inflight:
+                    self._has_work.wait(timeout=1.0)
+                if self._stop:
+                    for q in self._queues:
+                        for _, fut in q:
+                            fut.cancel()
+                        q.clear()
+                    break
+                # snapshot one frame per slot and DISPATCH under the lock
+                # (engine state is single-writer); the blocking readback
+                # happens outside it
+                if any(self._queues):
+                    futs: list = [None] * self.max_peers
+                    for s, q in enumerate(self._queues):
+                        if q:
+                            frame, fut = q.popleft()
+                            self._last_frame[s] = frame
+                            futs[s] = fut
+                    batch = np.stack(self._last_frame)
+                    try:
+                        inflight.append((self.engine.submit(batch), futs))
+                    except Exception as e:
+                        for fut in futs:
+                            if fut is not None and not fut.cancelled():
+                                fut.set_exception(e)
+                more_queued = any(self._queues)
+            # fetch (device->host) outside the lock: engine.fetch only reads
+            # the output buffer, so control traffic and the next dispatch
+            # proceed while the readback drains
+            if inflight and (len(inflight) >= self.PIPELINE_DEPTH or not more_queued):
+                pending, futs = inflight.popleft()
+                try:
+                    out = self.engine.fetch(pending)
+                except Exception as e:
+                    for fut in futs:
+                        if fut is not None and not fut.cancelled():
+                            fut.set_exception(e)
+                    continue
+                for s, fut in enumerate(futs):
+                    if fut is not None and not fut.cancelled():
+                        fut.set_result(out[s])
+        # drain on stop
+        while inflight:
+            _, futs = inflight.popleft()
+            for fut in futs:
+                if fut is not None and not fut.cancelled():
+                    fut.cancel()
+
+    def close(self):
+        with self._has_work:
+            self._stop = True
+            self._has_work.notify()
+        self._thread.join(timeout=10)

@@ -286,6 +286,10 @@ def get_provider(name: str | None = None):
     name = name or os.getenv("WEBRTC_PROVIDER")
     if name == "loopback":
         return LoopbackProvider()
+    if name == "native-rtp":
+        from .rtc_native import NativeRtpProvider
+
+        return NativeRtpProvider()
     try:
         return AiortcProvider()
     except ImportError:

@@ -614,9 +614,11 @@ class StreamEngine:
             return None, frame_u8.ndim == 3
         squeeze = frame_u8.ndim == 3
         if isinstance(frame_u8, np.ndarray):
-            # async host->device upload BEFORE dispatch: a numpy arg makes the
-            # dispatch itself block on a synchronous transfer (device_put
-            # overlaps it with in-flight compute instead)
+            # async host->HBM staging BEFORE dispatch (the DeviceFeeder
+            # pattern from media/ring.py, inlined): device_put returns
+            # immediately and the transfer rides under in-flight compute; a
+            # numpy arg would block the dispatch on a synchronous copy
+            # (reference NVDEC zero-copy analog, README.md:11-15)
             frame_u8 = jax.device_put(frame_u8)
         self.state, out = self._step(self.params, self.state, frame_u8)
         try:  # overlap device->host copy with subsequent compute

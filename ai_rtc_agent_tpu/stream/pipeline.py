@@ -129,18 +129,7 @@ class StreamDiffusionPipeline:
 
     def preprocess(self, frame) -> np.ndarray:
         """Duck-typed frame -> [H,W,3] uint8 ndarray (+ pts metadata)."""
-        if hasattr(frame, "to_ndarray"):
-            arr = frame.to_ndarray(format="rgb24")
-        elif isinstance(frame, np.ndarray):
-            arr = frame
-        else:
-            raise TypeError(f"invalid frame type: {type(frame)!r}")
-        if arr.dtype != np.uint8 or arr.ndim != 3 or arr.shape[-1] != 3:
-            raise ValueError(f"expected HxWx3 uint8 RGB, got {arr.shape} {arr.dtype}")
-        h, w = self.config.height, self.config.width
-        if arr.shape[:2] != (h, w):
-            arr = _resize_u8(arr, h, w)
-        return arr
+        return coerce_frame(frame, self.config.height, self.config.width)
 
     def predict(self, frame_u8: np.ndarray) -> np.ndarray:
         out = self.engine(frame_u8)
@@ -152,12 +141,9 @@ class StreamDiffusionPipeline:
         """Attach timing metadata when the input carried it (VideoFrame
         contract: pts/time_base preserved, reference lib/pipeline.py:89-93)."""
         if src_frame is not None and hasattr(src_frame, "pts"):
-            from ..media.frames import VideoFrame
+            from ..media.frames import wrap_processed
 
-            vf = VideoFrame.from_ndarray(out_u8)
-            vf.pts = src_frame.pts
-            vf.time_base = src_frame.time_base
-            return vf
+            return wrap_processed(out_u8, src_frame)
         return out_u8
 
     def __call__(self, frame):
@@ -184,6 +170,22 @@ class StreamDiffusionPipeline:
         if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
             return self.postprocess(out, src_frame)
         return out
+
+
+def coerce_frame(frame, h: int, w: int) -> np.ndarray:
+    """Duck-typed frame (ndarray | av.VideoFrame-like) -> [h,w,3] uint8
+    (frame contract preserved from reference lib/tracks.py:34-37)."""
+    if hasattr(frame, "to_ndarray"):
+        arr = frame.to_ndarray(format="rgb24")
+    elif isinstance(frame, np.ndarray):
+        arr = frame
+    else:
+        raise TypeError(f"invalid frame type: {type(frame)!r}")
+    if arr.dtype != np.uint8 or arr.ndim != 3 or arr.shape[-1] != 3:
+        raise ValueError(f"expected HxWx3 uint8 RGB, got {arr.shape} {arr.dtype}")
+    if arr.shape[:2] != (h, w):
+        arr = _resize_u8(arr, h, w)
+    return arr
 
 
 def _resize_u8(arr: np.ndarray, h: int, w: int) -> np.ndarray:

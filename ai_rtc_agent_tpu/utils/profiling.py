@@ -15,11 +15,19 @@ import time
 
 
 class FrameStats:
-    """Sliding-window fps + latency percentiles (thread-safe, O(1) record)."""
+    """Sliding-window fps + latency percentiles (thread-safe, O(1) record).
+
+    Besides the headline submit->fetch latency, per-stage gauges
+    (decode / infer / encode / glass) can be recorded via
+    :meth:`record_stage` so the <100 ms glass-to-glass target
+    (BASELINE.md north star) is continuously observable at /metrics —
+    the reference has no metrics at all (SURVEY.md section 5)."""
 
     def __init__(self, window: int = 240):
         self._lat = collections.deque(maxlen=window)
         self._times = collections.deque(maxlen=window)
+        self._stages: dict = {}
+        self._window = window
         self._lock = threading.Lock()
         self.frames_total = 0
 
@@ -28,6 +36,13 @@ class FrameStats:
             self._lat.append(latency_s)
             self._times.append(t if t is not None else time.monotonic())
             self.frames_total += 1
+
+    def record_stage(self, stage: str, seconds: float):
+        with self._lock:
+            q = self._stages.get(stage)
+            if q is None:
+                q = self._stages[stage] = collections.deque(maxlen=self._window)
+            q.append(seconds)
 
     def timed(self):
         """Context manager: with stats.timed(): process(frame)."""
@@ -48,6 +63,7 @@ class FrameStats:
         with self._lock:
             lat = sorted(self._lat)
             times = list(self._times)
+            stages = {k: sorted(q) for k, q in self._stages.items()}
         out = {
             "frames_total": self.frames_total,
             "fps": 0.0,
@@ -61,6 +77,10 @@ class FrameStats:
             out["latency_p50_ms"] = 1e3 * lat[len(lat) // 2]
             out["latency_p90_ms"] = 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.9))]
             out["latency_max_ms"] = 1e3 * lat[-1]
+        for name, q in stages.items():
+            if q:
+                out[f"{name}_p50_ms"] = 1e3 * q[len(q) // 2]
+                out[f"{name}_p90_ms"] = 1e3 * q[min(len(q) - 1, int(len(q) * 0.9))]
         return out
 
 

@@ -1,0 +1,68 @@
+"""Full-architecture key-map completeness — no weights needed.
+
+VERDICT r1 item 8: tiny configs can't catch mapping drift at real geometry
+(SD1.5 / SD2.1 / SDXL / ControlNet).  Here we synthesize a COMPLETE state
+dict from the param tree itself (zeros via eval_shape — no RNG cost), then
+strict-load it back: every key-map path must resolve in the tree, shapes
+must round-trip through the OIHW<->HWIO / [O,I]<->[I,O] conventions, and —
+the completeness half — every array leaf of the tree must be covered by the
+map (reference load surface: lib/wrapper.py:645-669).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.models import clip as C
+from ai_rtc_agent_tpu.models import controlnet as CN
+from ai_rtc_agent_tpu.models import loader as LD
+from ai_rtc_agent_tpu.models import taesd as T
+from ai_rtc_agent_tpu.models import unet as U
+
+
+def _zeros_tree(init_fn):
+    """Materialize the init tree as numpy zeros (calloc — fast at any size)."""
+    shapes = jax.eval_shape(init_fn)
+    return jax.tree.map(lambda s: np.zeros(s.shape, np.float32), shapes)
+
+
+def _roundtrip(params, km):
+    sd = LD.tree_to_state_dict(params, km)
+    out, n = LD.load_into_tree(params, sd, km, strict=True)
+    total = len(jax.tree.leaves(params))
+    assert n == len(sd), f"loaded {n} != synthesized {len(sd)}"
+    assert n == total, (
+        f"key map covers {n}/{total} leaves — "
+        f"{total - n} tree leaves unreachable from the checkpoint"
+    )
+    return out
+
+
+@pytest.mark.parametrize("fam", ["sd15", "sd21", "sdxl"])
+def test_unet_keymap_full_geometry(fam):
+    cfg = getattr(U.UNetConfig, fam)()
+    params = _zeros_tree(lambda: U.init_unet(jax.random.PRNGKey(0), cfg))
+    _roundtrip(params, LD.unet_key_map(cfg))
+
+
+@pytest.mark.parametrize(
+    "cfg_name", ["sd15", "sd21", "sdxl_g"]
+)
+def test_clip_keymap_full_geometry(cfg_name):
+    cfg = getattr(C.CLIPTextConfig, cfg_name)()
+    params = _zeros_tree(lambda: C.init_clip_text(jax.random.PRNGKey(0), cfg))
+    _roundtrip(params, LD.clip_key_map(cfg))
+
+
+def test_taesd_keymap_full_geometry():
+    cfg = T.TAESDConfig()
+    params = _zeros_tree(lambda: T.init_taesd(jax.random.PRNGKey(0), cfg))
+    _roundtrip(params, LD.taesd_key_map(cfg))
+
+
+def test_controlnet_keymap_full_geometry():
+    cfg = U.UNetConfig.sd15()
+    params = _zeros_tree(
+        lambda: CN.init_controlnet(jax.random.PRNGKey(0), cfg, num_down=3)
+    )
+    _roundtrip(params, LD.controlnet_key_map(cfg, num_down=3))

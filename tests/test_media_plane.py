@@ -1,0 +1,285 @@
+"""Media-plane serving-path tests (VERDICT r1 items 3+4+10).
+
+Proves the full native path the reference gets from its NVDEC/NVENC aiortc
+fork (reference lib/pipeline.py:76-96, README.md:11-15):
+
+  H.264 bytes -> RTP -> depacketize -> decode -> FrameRing ->
+  VideoStreamTrack -> pipeline -> encode -> RTP -> H.264 bytes
+
+including over a REAL UDP socket pair against the agent's /offer endpoint
+(NativeRtpProvider), with decode/encode/glass-to-glass gauges landing in
+/metrics.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_rtc_agent_tpu.media import native
+from ai_rtc_agent_tpu.media.frames import VideoFrame
+from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
+from ai_rtc_agent_tpu.server.agent import build_app
+from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    return lib
+
+
+def _h264():
+    return native.h264_available()
+
+
+class InvertPipeline:
+    """Metadata-preserving stand-in for StreamDiffusionPipeline."""
+
+    def __call__(self, frame):
+        arr = frame.to_ndarray(format="rgb24")
+        out = VideoFrame.from_ndarray(255 - arr)
+        out.pts = frame.pts
+        out.time_base = frame.time_base
+        out.wall_ts = frame.wall_ts
+        return out
+
+
+def test_source_sink_rtp_roundtrip(native_lib):
+    """Encoder -> RTP packets -> source (depacketize+decode+ring) -> frames;
+    constant-color frames survive the lossy H.264 trip within tolerance."""
+    stats = FrameStats()
+    w = h = 64
+    sink = H264Sink(w, h, stats=stats, use_h264=_h264())
+    src = H264RingSource(w, h, stats=stats, use_h264=_h264())
+    vals = [30, 90, 150, 210, 60, 120, 180, 240]
+    got = []
+    for i, v in enumerate(vals):
+        frame = VideoFrame.from_ndarray(np.full((h, w, 3), v, np.uint8))
+        frame.pts = i * 3000
+        frame.wall_ts = 0.0
+        for pkt in sink.consume(frame):
+            src.feed_packet(pkt)
+        item = src._ring.pop()
+        if item is not None:
+            got.append(item[0])
+    # flush any encoder delay
+    au = sink.flush()
+    while au:
+        src.feed_au(au)
+        au = sink.flush()
+    while (item := src._ring.pop()) is not None:
+        got.append(item[0])
+    assert len(got) >= len(vals) - 2, "decoder swallowed too many frames"
+    for arr in got:
+        assert arr.shape == (h, w, 3)
+        spread = float(arr.astype(np.float32).std())
+        assert spread < 25.0, "constant frame came back non-constant"
+    snap = stats.snapshot()
+    assert "decode_p50_ms" in snap and "encode_p50_ms" in snap
+    sink.close()
+    src.close()
+
+
+def test_agent_native_rtp_e2e(native_lib, monkeypatch):
+    """The full wire: a client encodes frames, sends RTP over UDP to the
+    agent; the agent decodes -> pipeline -> encodes -> RTP back over UDP;
+    the client decodes and checks the processed pixels + /metrics stages."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    use_h264 = _h264()
+    w = h = 64
+
+    async def go():
+        provider = NativeRtpProvider(use_h264=use_h264)
+        app = build_app(pipeline=InvertPipeline(), provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        loop = asyncio.get_event_loop()
+        recv_q: asyncio.Queue = asyncio.Queue()
+
+        class _ClientRecv(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                recv_q.put_nowait(data)
+
+        client_transport, _ = await loop.create_datagram_endpoint(
+            _ClientRecv, local_addr=("127.0.0.1", 0)
+        )
+        client_port = client_transport.get_extra_info("sockname")[1]
+        try:
+            offer = json.dumps(
+                {
+                    "native_rtp": True,
+                    "video": True,
+                    "client_addr": ["127.0.0.1", client_port],
+                    "width": w,
+                    "height": h,
+                }
+            )
+            r = await client.post(
+                "/offer",
+                json={"room_id": "rtp-room", "offer": {"sdp": offer, "type": "offer"}},
+            )
+            assert r.status == 200
+            answer = await r.json()
+            server_port = json.loads(answer["sdp"])["server_port"]
+            assert server_port
+
+            # client-side media: encode constant frames -> RTP -> server
+            out_sink = H264Sink(w, h, use_h264=use_h264)
+            back_src = H264RingSource(w, h, use_h264=use_h264)
+            send_transport, _ = await loop.create_datagram_endpoint(
+                asyncio.DatagramProtocol,
+                remote_addr=("127.0.0.1", server_port),
+            )
+            try:
+                val = 200
+                decoded = []
+                for i in range(12):
+                    f = VideoFrame.from_ndarray(np.full((h, w, 3), val, np.uint8))
+                    f.pts = i * 3000
+                    for pkt in out_sink.consume(f):
+                        send_transport.sendto(pkt)
+                    # drain whatever came back so far
+                    try:
+                        while True:
+                            data = recv_q.get_nowait()
+                            back_src.feed_packet(data)
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := back_src._ring.pop()) is not None:
+                        decoded.append(item[0])
+                    await asyncio.sleep(0.05)
+                # grace period for in-flight frames
+                for _ in range(40):
+                    if decoded:
+                        break
+                    await asyncio.sleep(0.05)
+                    try:
+                        while True:
+                            back_src.feed_packet(recv_q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := back_src._ring.pop()) is not None:
+                        decoded.append(item[0])
+
+                assert decoded, "no processed frames made it back over UDP"
+                mean = float(decoded[-1].astype(np.float32).mean())
+                # pipeline inverts: 200 -> 55 (lossy codec tolerance)
+                assert abs(mean - (255 - val)) < 20, mean
+
+                m = await client.get("/metrics")
+                snap = await m.json()
+                assert snap.get("decode_p50_ms") is not None
+                assert snap.get("encode_p50_ms") is not None
+                if use_h264:
+                    assert snap.get("glass_p50_ms") is not None
+            finally:
+                out_sink.close()
+                back_src.close()
+                send_transport.close()
+        finally:
+            client_transport.close()
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_agent_native_rtp_real_engine_e2e(native_lib, monkeypatch):
+    """H.264 bytes -> agent -> REAL StreamEngine (tiny hermetic model) ->
+    H.264 bytes: the decode->diffuse->encode path the reference's headline
+    is about (lib/pipeline.py:76-96), over real UDP."""
+    monkeypatch.setenv("WARMUP_FRAMES", "1")
+    use_h264 = _h264()
+
+    async def go():
+        provider = NativeRtpProvider(use_h264=use_h264)
+        app = build_app(model_id="tiny-test", provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()  # builds the tiny pipeline (jit compile)
+        pipe_cfg = app["pipeline"].config
+        w, h = pipe_cfg.width, pipe_cfg.height
+        loop = asyncio.get_event_loop()
+        recv_q: asyncio.Queue = asyncio.Queue()
+
+        class _ClientRecv(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                recv_q.put_nowait(data)
+
+        client_transport, _ = await loop.create_datagram_endpoint(
+            _ClientRecv, local_addr=("127.0.0.1", 0)
+        )
+        client_port = client_transport.get_extra_info("sockname")[1]
+        try:
+            offer = json.dumps(
+                {
+                    "native_rtp": True,
+                    "video": True,
+                    "client_addr": ["127.0.0.1", client_port],
+                    "width": w,
+                    "height": h,
+                }
+            )
+            r = await client.post(
+                "/offer",
+                json={"room_id": "real", "offer": {"sdp": offer, "type": "offer"}},
+            )
+            assert r.status == 200
+            server_port = json.loads((await r.json())["sdp"])["server_port"]
+
+            out_sink = H264Sink(w, h, use_h264=use_h264)
+            back_src = H264RingSource(w, h, use_h264=use_h264)
+            send_transport, _ = await loop.create_datagram_endpoint(
+                asyncio.DatagramProtocol,
+                remote_addr=("127.0.0.1", server_port),
+            )
+            try:
+                decoded = []
+                rng = np.random.default_rng(0)
+                for i in range(60):
+                    arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+                    f = VideoFrame.from_ndarray(arr)
+                    f.pts = i * 3000
+                    for pkt in out_sink.consume(f):
+                        send_transport.sendto(pkt)
+                    try:
+                        while True:
+                            back_src.feed_packet(recv_q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := back_src._ring.pop()) is not None:
+                        decoded.append(item[0])
+                    if decoded:
+                        break
+                    # tiny-model step takes a moment on CPU; keep feeding
+                    await asyncio.sleep(0.1)
+                for _ in range(100):
+                    if decoded:
+                        break
+                    await asyncio.sleep(0.1)
+                    try:
+                        while True:
+                            back_src.feed_packet(recv_q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := back_src._ring.pop()) is not None:
+                        decoded.append(item[0])
+
+                assert decoded, "no diffused frames made it back"
+                assert decoded[0].shape == (h, w, 3)
+                m = await client.get("/metrics")
+                snap = await m.json()
+                assert snap["frames_total"] >= 1
+            finally:
+                out_sink.close()
+                back_src.close()
+                send_transport.close()
+        finally:
+            client_transport.close()
+            await client.close()
+
+    asyncio.run(go())

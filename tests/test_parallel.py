@@ -134,3 +134,48 @@ def test_sharded_trainer_loss_decreases(rng):
     assert np.isfinite(l0) and np.isfinite(l1)
     assert l1 < l0
     assert int(np.asarray(tr.state["step"])) == 2
+
+
+def test_unet_ring_attention_matches_xla(rng):
+    """sp>1 must change the attention code path, not just the test file
+    (VERDICT r1 item 6): the full tiny UNet forward under an sp mesh with
+    attn_impl="ring" must match the single-device dense result."""
+    from ai_rtc_agent_tpu.models import unet as U
+    from ai_rtc_agent_tpu.models.layers import sp_attention_mesh
+
+    cfg = U.UNetConfig.tiny()
+    params = U.init_unet(jax.random.PRNGKey(0), cfg)
+    x = rng.standard_normal((2, 8, 8, 4)).astype(np.float32)
+    t = np.array([5, 9], np.int32)
+    ctx = rng.standard_normal((2, 7, 32)).astype(np.float32)
+
+    ref = U.apply_unet(params, x, t, ctx, cfg, attn_impl="xla")
+
+    mesh = M.make_mesh(sp=8)
+    with sp_attention_mesh(mesh, axis="sp"):
+        out_ring = jax.jit(
+            lambda p, x, t, c: U.apply_unet(p, x, t, c, cfg, attn_impl="ring")
+        )(params, x, t, ctx)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(ref), atol=2e-4)
+
+    # ulysses needs heads % sp == 0 (tiny has 2 heads -> sp=2 mesh)
+    mesh2 = M.make_mesh(sp=2)
+    with sp_attention_mesh(mesh2, axis="sp"):
+        out_uly = jax.jit(
+            lambda p, x, t, c: U.apply_unet(p, x, t, c, cfg, attn_impl="ulysses")
+        )(params, x, t, ctx)
+    np.testing.assert_allclose(np.asarray(out_uly), np.asarray(ref), atol=2e-4)
+
+
+def test_unet_ring_attention_no_mesh_falls_back(rng):
+    """attn_impl="ring" without an active sp mesh = plain dense attention."""
+    from ai_rtc_agent_tpu.models import unet as U
+
+    cfg = U.UNetConfig.tiny()
+    params = U.init_unet(jax.random.PRNGKey(0), cfg)
+    x = rng.standard_normal((1, 8, 8, 4)).astype(np.float32)
+    t = np.array([3], np.int32)
+    ctx = rng.standard_normal((1, 7, 32)).astype(np.float32)
+    a = U.apply_unet(params, x, t, ctx, cfg, attn_impl="ring")
+    b = U.apply_unet(params, x, t, ctx, cfg, attn_impl="xla")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
