@@ -74,11 +74,17 @@ class H264RingSource:
 
     # -- network side (any thread) ------------------------------------------
 
-    def feed_packet(self, packet: bytes):
-        """One RTP packet; completes an AU -> decode -> ring."""
+    def depacketize(self, packet: bytes):
+        """One RTP packet -> completed (AU bytes, ts) or None.  Microseconds
+        of work — safe to call inline on the receive path; only the AU
+        decode (feed_au) needs a worker thread."""
         if self._depkt is None:
             raise RuntimeError("native RTP runtime unavailable")
-        got = self._depkt.push(packet)
+        return self._depkt.push(packet)
+
+    def feed_packet(self, packet: bytes):
+        """One RTP packet; completes an AU -> decode -> ring."""
+        got = self.depacketize(packet)
         if got is not None:
             au, ts = got
             self.feed_au(au, ts)

@@ -247,6 +247,17 @@ def load_model_bundle(
         raise ValueError(
             f"ATTN_IMPL={attn_impl!r} unknown (xla | pallas | ring | ulysses)"
         )
+    if attn_impl in ("ring", "ulysses"):
+        # the sp modes need layers.sp_attention_mesh active around tracing
+        # (the trainer/dryrun do this); the serving engines don't yet — the
+        # dispatch then falls back to DENSE XLA, which is slower than the
+        # default flash path.  Warn loudly instead of degrading silently.
+        logger.warning(
+            "ATTN_IMPL=%s only takes effect under an active sp_attention_mesh"
+            " (parallel training / dryrun); serving paths fall back to dense"
+            " XLA attention — prefer ATTN_IMPL=pallas on TPU",
+            attn_impl,
+        )
 
     def unet_apply(p, x, t, ctx, added, down_residuals=None, mid_residual=None):
         return U.apply_unet(

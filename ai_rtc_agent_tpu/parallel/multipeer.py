@@ -197,6 +197,51 @@ class MultiPeerEngine:
             node = node[k]
         node[path[-1]] = node[path[-1]].at[slot].set(jnp.asarray(value, self.cfg.jdtype))
 
+    # -- AOT engine adoption ------------------------------------------------
+
+    def use_aot_cache(
+        self, model_id: str, cache_dir: str | None = None,
+        build_on_miss: bool = True,
+    ) -> bool:
+        """Swap the jitted all-peers step for a serialized AOT executable —
+        the multipeer analog of StreamEngine.use_aot_cache (same key
+        discipline with a ``peers-N`` attribute; reference engine-cache
+        contract: lib/wrapper.py:732-746, :409-512).  Mesh-sharded engines
+        are not exported (serialization is per-topology); returns False.
+        """
+        if self.mesh is not None and np.prod(list(self.mesh.shape.values())) > 1:
+            return False
+        if self.states is None:
+            raise RuntimeError("call start() first (states define the signature)")
+        from ..aot.cache import EngineCache, engine_key
+        from ..stream.engine import make_step_fn
+
+        key = engine_key(
+            model_id,
+            self.cfg.mode,
+            batch=self.cfg.batch_size,
+            hw=f"{self.cfg.height}x{self.cfg.width}",
+            dtype=self.cfg.dtype,
+            cfgtype=self.cfg.cfg_type,
+            sched=self.cfg.scheduler,
+            peers=self.max_peers,
+        )
+        cache = EngineCache(cache_dir)
+        frame_spec = jax.ShapeDtypeStruct(
+            (self.max_peers, self.cfg.height, self.cfg.width, 3), jnp.uint8
+        )
+        args = (self.params, self.states, frame_spec)
+        if not build_on_miss and not cache.has(key, args):
+            return False
+        vstep = jax.vmap(make_step_fn(self.models, self.cfg), in_axes=(None, 0, 0))
+        call = cache.load_or_build(
+            key, vstep, args, donate_argnums=(1,), build=build_on_miss
+        )
+        if call is None:
+            return False
+        self._step = call
+        return True
+
     # -- hot path -----------------------------------------------------------
 
     def step_all(self, frames: np.ndarray) -> np.ndarray:

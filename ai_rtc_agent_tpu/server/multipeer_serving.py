@@ -33,7 +33,7 @@ import numpy as np
 
 from ..models import registry
 from ..parallel.multipeer import CapacityError, MultiPeerEngine
-from ..stream.pipeline import DEFAULT_PROMPT, coerce_frame
+from ..stream.pipeline import DEFAULT_PROMPT, coerce_frame, maybe_load_safety_checker
 
 logger = logging.getLogger(__name__)
 
@@ -56,6 +56,8 @@ class PeerPipeline:
 
     def fetch(self, handle: Future, src_frame=None):
         out = handle.result(timeout=self._owner.fetch_timeout)
+        if self._owner.safety_checker is not None:
+            out = self._owner.safety_checker(out)
         if src_frame is not None and hasattr(src_frame, "pts"):
             from ..media.frames import wrap_processed
 
@@ -110,6 +112,20 @@ class MultiPeerPipeline:
         self.height, self.width = cfg.height, cfg.width
         self.max_peers = max_peers
         self.fetch_timeout = fetch_timeout
+        # NSFW gate applies per-peer on fetch, same as single-peer serving
+        self.safety_checker = maybe_load_safety_checker(model_id)
+        # AOT fast path: adopt (or build, with AOT_ENGINES=1) a serialized
+        # executable for the vmapped all-peers step — same cold-start story
+        # as the single-peer pipeline (stream/pipeline.py:109-117)
+        try:
+            from ..utils import env as _env
+
+            if self.engine.use_aot_cache(
+                model_id, build_on_miss=_env.get_bool("AOT_ENGINES", False)
+            ):
+                logger.info("multipeer serving from AOT engine cache")
+        except Exception as e:  # cache trouble must never block serving
+            logger.warning("multipeer AOT adoption failed (%s); using jit", e)
 
         self._lock = threading.Lock()  # guards engine state + queues
         self._has_work = threading.Condition(self._lock)
@@ -145,7 +161,9 @@ class MultiPeerPipeline:
         with self._lock:
             self.engine.install(slot, state)
             self._queues[slot].clear()
-            self._last_frame[slot][:] = 0
+            # fresh buffer, NOT in-place zeroing: the old array may be a
+            # caller-owned frame stored by reference in a previous session
+            self._last_frame[slot] = np.zeros_like(self._last_frame[slot])
         return PeerPipeline(self, slot)
 
     def release(self, slot: int):
@@ -196,9 +214,7 @@ class MultiPeerPipeline:
     PIPELINE_DEPTH = 2
 
     def _run(self):
-        from collections import deque as _dq
-
-        inflight: _dq = _dq()  # (pending_handle, futs)
+        inflight: deque = deque()  # (pending_handle, futs)
         while True:
             with self._has_work:
                 while not self._stop and not any(self._queues) and not inflight:
@@ -217,7 +233,10 @@ class MultiPeerPipeline:
                     for s, q in enumerate(self._queues):
                         if q:
                             frame, fut = q.popleft()
-                            self._last_frame[s] = frame
+                            # copy: coerce_frame may return the caller's
+                            # array by reference, and this buffer is re-fed
+                            # on idle ticks after the caller may mutate it
+                            self._last_frame[s] = np.array(frame, copy=True)
                             futs[s] = fut
                     batch = np.stack(self._last_frame)
                     try:

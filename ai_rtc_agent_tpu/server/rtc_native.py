@@ -59,17 +59,26 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
     def datagram_received(self, data, addr):
         try:
-            self._q.put_nowait(data)
+            # depacketize inline (microseconds); queue only COMPLETED access
+            # units so the worker hop is paid per frame, not per packet
+            got = self.source.depacketize(data)
+        except Exception:
+            logger.exception("RTP depacketize error")
+            return
+        if got is None:
+            return
+        try:
+            self._q.put_nowait(got)
         except asyncio.QueueFull:
             pass  # real-time: drop rather than queue latency
 
     async def _decode_loop(self):
         while True:
-            data = await self._q.get()
+            au, ts = await self._q.get()
             try:
-                await asyncio.to_thread(self.source.feed_packet, data)
+                await asyncio.to_thread(self.source.feed_au, au, ts)
             except Exception:
-                logger.exception("RTP receive error")
+                logger.exception("H.264 decode error")
 
     def close(self):
         self._task.cancel()

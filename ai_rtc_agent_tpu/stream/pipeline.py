@@ -57,26 +57,7 @@ class StreamDiffusionPipeline:
         self.model_id = model_id
         # optional NSFW gate (reference use_safety_checker,
         # lib/wrapper.py:930-942); env SAFETY_CHECKER enables it globally
-        if use_safety_checker is None:
-            use_safety_checker = env.get_bool("SAFETY_CHECKER", False)
-        self.safety_checker = None
-        if use_safety_checker:
-            from ..models.safety import SafetyChecker
-
-            # prefer the base model's bundled safety_checker/ subfolder,
-            # else the standalone checkpoint the download CLI ships
-            # (--model-set safety)
-            snap = registry.resolve_snapshot_dir(model_id)
-            from ..models import loader as _LD
-
-            if not snap or not _LD.find_safetensors(snap, "safety_checker"):
-                snap = (
-                    registry.resolve_snapshot_dir(
-                        "CompVis/stable-diffusion-safety-checker"
-                    )
-                    or snap
-                )
-            self.safety_checker = SafetyChecker.load(snap)
+        self.safety_checker = maybe_load_safety_checker(model_id, use_safety_checker)
         cfg = config or registry.default_stream_config(
             model_id, **({"use_controlnet": True} if controlnet else {})
         )
@@ -170,6 +151,28 @@ class StreamDiffusionPipeline:
         if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
             return self.postprocess(out, src_frame)
         return out
+
+
+def maybe_load_safety_checker(model_id: str, use: bool | None = None):
+    """NSFW-gate loader shared by single- and multi-peer serving (reference
+    use_safety_checker, lib/wrapper.py:930-942).  ``use=None`` defers to the
+    SAFETY_CHECKER env var; returns None when disabled."""
+    if use is None:
+        use = env.get_bool("SAFETY_CHECKER", False)
+    if not use:
+        return None
+    from ..models import loader as _LD
+    from ..models.safety import SafetyChecker
+
+    # prefer the base model's bundled safety_checker/ subfolder, else the
+    # standalone checkpoint the download CLI ships (--model-set safety)
+    snap = registry.resolve_snapshot_dir(model_id)
+    if not snap or not _LD.find_safetensors(snap, "safety_checker"):
+        snap = (
+            registry.resolve_snapshot_dir("CompVis/stable-diffusion-safety-checker")
+            or snap
+        )
+    return SafetyChecker.load(snap)
 
 
 def coerce_frame(frame, h: int, w: int) -> np.ndarray:

@@ -218,6 +218,26 @@ def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4):
     return r
 
 
+def _backend_responsive(timeout_s: int) -> tuple:
+    """Probe backend init in a SUBPROCESS so a wedged accelerator tunnel
+    can't hang this process in an uninterruptible native claim (the exact
+    failure mode that voided two round-1/2 bench runs: the axon claim loop
+    blocks SIGTERM handling for 30+ minutes).  -> (ok, backend_or_error)."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init exceeded {timeout_s}s (tunnel wedged?)"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return False, tail[-1] if tail else f"probe rc={r.returncode}"
+    return True, r.stdout.strip()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="turbo512",
@@ -227,6 +247,9 @@ def main():
     ap.add_argument("--peers", type=int, default=4)
     ap.add_argument("--fbs", type=int, default=1,
                     help="frames per stream-batch step (frame_buffer_size)")
+    ap.add_argument("--probe-timeout", type=int, default=300,
+                    help="seconds to wait for backend init before declaring "
+                         "the accelerator unreachable (0 = skip probe)")
     args = ap.parse_args()
 
     # The contract line MUST be printed on every exit path (round-1 failure
@@ -248,6 +271,16 @@ def main():
         "backend": "unknown",
     }
     try:
+        if args.probe_timeout:
+            ok, info = _backend_responsive(args.probe_timeout)
+            if not ok:
+                # Do NOT import jax here: the claim would hang this process
+                # beyond any SIGTERM.  The finally block emits the contract
+                # line.
+                result["error"] = f"accelerator unreachable: {info}"
+                return
+            logger.info("backend probe ok: %s", info)
+
         import jax
 
         try:

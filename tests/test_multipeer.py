@@ -70,3 +70,35 @@ def test_multipeer_wrong_slot_count(bundle):
     mp = _mp(bundle)
     with pytest.raises(ValueError):
         mp.step_all(np.zeros((3, 64, 64, 3), np.uint8))
+
+
+def test_multipeer_aot_cache_roundtrip(bundle, tmp_path):
+    """The vmapped all-peers step exports/reloads through the engine cache
+    (peers-N key attribute); a mesh-sharded engine refuses (returns False)."""
+    mp = _mp(bundle, max_peers=2)
+    ok = mp.use_aot_cache("tiny-test", cache_dir=str(tmp_path), build_on_miss=True)
+    assert ok
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (2, 64, 64, 3), dtype=np.uint8)
+    out = mp.step_all(frames)
+    assert out.shape == (2, 64, 64, 3)
+
+    # fresh engine adopts WITHOUT building
+    mp2 = _mp(bundle, max_peers=2)
+    assert mp2.use_aot_cache(
+        "tiny-test", cache_dir=str(tmp_path), build_on_miss=False
+    )
+    out2 = mp2.step_all(frames)
+    assert out2.shape == (2, 64, 64, 3)
+
+    # different peer count = different key -> miss
+    mp3 = _mp(bundle, max_peers=4)
+    assert not mp3.use_aot_cache(
+        "tiny-test", cache_dir=str(tmp_path), build_on_miss=False
+    )
+
+    # sharded engines are not exportable
+    mp4 = _mp(bundle, mesh=M.make_mesh(dp=4))
+    assert not mp4.use_aot_cache(
+        "tiny-test", cache_dir=str(tmp_path), build_on_miss=True
+    )
