@@ -50,6 +50,10 @@ def build_engine(config: str, fbs: int = 1):
         model_id = "lykon/dreamshaper-8"
         overrides = dict(dtype=dtype, use_controlnet=True)
         controlnet = "lllyasviel/control_v11p_sd15_canny"
+    elif config == "tiny64":
+        # hermetic tiny model (64x64, random weights): exercises the FULL
+        # bench pipeline cheaply on CPU — used by tests/test_bench_contract
+        model_id, overrides = "tiny-test", {}
     else:
         raise ValueError(config)
 
@@ -242,7 +246,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="turbo512",
                     choices=["turbo512", "lcm4x512", "sdxl1024",
-                             "controlnet512", "multipeer"])
+                             "controlnet512", "multipeer", "tiny64"])
     ap.add_argument("--frames", type=int, default=30)
     ap.add_argument("--peers", type=int, default=4)
     ap.add_argument("--fbs", type=int, default=1,
