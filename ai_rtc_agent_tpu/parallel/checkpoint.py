@@ -1,0 +1,73 @@
+"""Trainer checkpoint/resume — the training-side persistence tier.
+
+The reference is inference-only; its "checkpoints" are weight/engine caches
+(SURVEY.md section 5).  The TPU rebuild ships a real sharded trainer
+(parallel/trainer.py), so it also ships real checkpointing: orbax-backed
+save/restore of the full train state (params + optimizer + step), correct
+under dp/tp/sp sharding — restore places leaves back onto the SAME mesh
+shardings the trainer computed, so a resumed run is bitwise-continuous.
+
+Layout: ``<dir>/step_<N>/`` orbax PyTree checkpoints, latest-step resolution
+mirrors the HF-snapshot convention used by the inference caches.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def save_train_state(ckpt_dir: str, state, step: int | None = None) -> str:
+    """Persist a trainer state pytree; returns the checkpoint path."""
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        step = int(np.asarray(state["step"]))
+    path = os.path.abspath(os.path.join(ckpt_dir, f"step_{step:08d}"))
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=True)
+    logger.info("saved train state (step %d) -> %s", step, path)
+    return path
+
+
+def latest_checkpoint(ckpt_dir: str) -> str | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for name in os.listdir(ckpt_dir):
+        m = re.fullmatch(r"step_(\d+)", name)
+        if m:
+            steps.append((int(m.group(1)), name))
+    if not steps:
+        return None
+    return os.path.join(ckpt_dir, max(steps)[1])
+
+
+def restore_train_state(ckpt_dir: str, like_state):
+    """Restore the newest checkpoint in ``ckpt_dir`` shaped/placed like
+    ``like_state`` (the freshly initialized trainer state — its shardings
+    carry the dp/tp/sp placement).  Returns None when no checkpoint exists.
+    """
+    import orbax.checkpoint as ocp
+
+    path = latest_checkpoint(ckpt_dir)
+    if path is None:
+        return None
+    ckptr = ocp.PyTreeCheckpointer()
+    restore_args = jax.tree.map(
+        lambda x: ocp.ArrayRestoreArgs(sharding=getattr(x, "sharding", None)),
+        like_state,
+    )
+    state = ckptr.restore(
+        path, args=ocp.args.PyTreeRestore(
+            item=like_state, restore_args=restore_args
+        ),
+    )
+    logger.info("restored train state <- %s", path)
+    return state

@@ -33,6 +33,22 @@ from ..ops import schedule as S
 from . import sharding as SH
 
 
+def _place(arr, sharding):
+    """Place a host array onto a (possibly multi-host) mesh sharding.
+
+    Single-process: plain device_put.  Under ``jax.distributed`` the mesh
+    spans non-addressable devices, so each process materializes only its
+    addressable shards from the (host-replicated) global value — the DCN
+    story: every host holds the same batch/params and contributes its slice.
+    """
+    if jax.process_count() > 1:
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+    return jax.device_put(arr, sharding)
+
+
 @dataclass
 class TrainerConfig:
     learning_rate: float = 1e-5
@@ -94,7 +110,7 @@ class ShardedTrainer:
     def __init__(self, unet_apply, schedule, mesh: Mesh, params, tcfg=TrainerConfig()):
         self.mesh = mesh
         init_fn, step_fn = make_train_step(unet_apply, schedule, tcfg)
-        params = jax.device_put(params, SH.param_shardings(mesh, params))
+        params = jax.tree.map(_place, params, SH.param_shardings(mesh, params))
         self.state = jax.jit(init_fn)(params)
         self._step = jax.jit(step_fn, donate_argnums=(0,))
         dp = "dp" if mesh.shape.get("dp", 1) > 1 else None
@@ -104,10 +120,28 @@ class ShardedTrainer:
 
     def place_batch(self, batch: dict) -> dict:
         out = dict(batch)
-        out["latents"] = jax.device_put(jnp.asarray(batch["latents"]), self._lat_sh)
-        out["context"] = jax.device_put(jnp.asarray(batch["context"]), self._ctx_sh)
+        out["latents"] = _place(jnp.asarray(batch["latents"]), self._lat_sh)
+        out["context"] = _place(jnp.asarray(batch["context"]), self._ctx_sh)
         return out
 
     def step(self, batch: dict, key) -> float:
         self.state, loss = self._step(self.state, self.place_batch(batch), key)
         return float(loss)
+
+    # -- checkpoint / resume (parallel/checkpoint.py) -----------------------
+
+    def save(self, ckpt_dir: str) -> str:
+        from . import checkpoint as CK
+
+        return CK.save_train_state(ckpt_dir, self.state)
+
+    def restore(self, ckpt_dir: str) -> bool:
+        """Resume from the newest checkpoint under ckpt_dir (leaves land on
+        this trainer's mesh shardings).  False when none exists."""
+        from . import checkpoint as CK
+
+        state = CK.restore_train_state(ckpt_dir, self.state)
+        if state is None:
+            return False
+        self.state = state
+        return True

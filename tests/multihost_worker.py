@@ -1,0 +1,57 @@
+"""Worker for the multi-host (multi-process) trainer test.
+
+Each process owns 4 virtual CPU devices; jax.distributed joins them into one
+8-device fleet (2 "hosts"), the dp x tp x sp mesh spans BOTH processes, and
+one sharded train step runs — collectives cross the process boundary over
+the Gloo transport (the CPU stand-in for DCN).  Prints "LOSS <value>".
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+port, pid, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(
+    f"127.0.0.1:{port}", num_processes=n, process_id=pid
+)
+assert jax.process_count() == n, jax.process_count()
+assert len(jax.devices()) == 4 * n, len(jax.devices())
+
+import numpy as np  # noqa: E402
+
+from ai_rtc_agent_tpu.models import unet as U  # noqa: E402
+from ai_rtc_agent_tpu.ops import schedule as S  # noqa: E402
+from ai_rtc_agent_tpu.parallel import mesh as M  # noqa: E402
+from ai_rtc_agent_tpu.parallel.trainer import (  # noqa: E402
+    ShardedTrainer,
+    TrainerConfig,
+)
+
+mesh = M.make_mesh(dp=2, tp=2, sp=2)  # spans both processes
+cfg = U.UNetConfig.tiny()
+params = U.init_unet(jax.random.PRNGKey(0), cfg)  # identical on every host
+
+
+def unet_apply(p, x, t, ctx, added):
+    return U.apply_unet(p, x, t, ctx, cfg, added_cond=added)
+
+
+tr = ShardedTrainer(
+    unet_apply, S.make_schedule(), mesh, params, TrainerConfig(learning_rate=1e-3)
+)
+rng = np.random.default_rng(0)  # identical batch on every host
+batch = {
+    "latents": rng.standard_normal((4, 8, 8, 4)).astype(np.float32),
+    "context": rng.standard_normal((4, 7, 32)).astype(np.float32),
+}
+l0 = tr.step(batch, jax.random.PRNGKey(1))
+l1 = tr.step(batch, jax.random.PRNGKey(1))
+assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+assert l1 < l0, (l0, l1)  # same batch+key twice -> loss drops
+print(f"LOSS {l0:.6f} {l1:.6f}", flush=True)
