@@ -486,8 +486,13 @@ async def on_startup(app):
     elif app.get("pipeline") is None and not app.get("multipeer_pipeline"):
         from ..stream.pipeline import StreamDiffusionPipeline
 
+        mesh = None
+        if app.get("tp", 0) > 1:
+            from ..parallel import mesh as M
+
+            mesh = M.make_mesh(tp=app["tp"])
         app["pipeline"] = StreamDiffusionPipeline(
-            app["model_id"], controlnet=app.get("controlnet")
+            app["model_id"], controlnet=app.get("controlnet"), mesh=mesh
         )
     app["pcs"] = set()
     app["stream_event_handler"] = StreamEventHandler()
@@ -521,6 +526,7 @@ def build_app(
     controlnet: str | None = None,
     multipeer: int = 0,
     multipeer_pipeline=None,
+    tp: int = 0,
 ) -> web.Application:
     app = web.Application(middlewares=[cors_middleware])
     app["udp_ports"] = udp_ports
@@ -529,6 +535,7 @@ def build_app(
     app["pipeline"] = pipeline  # injectable for tests; built on startup if None
     app["multipeer"] = multipeer
     app["multipeer_pipeline"] = multipeer_pipeline  # injectable for tests
+    app["tp"] = tp
     app["provider"] = provider or get_provider()
 
     app.on_startup.append(on_startup)
@@ -572,18 +579,41 @@ def main(argv=None):
         "(BASELINE configs[4]); 0 = single shared pipeline",
     )
     parser.add_argument(
+        "--tp",
+        default=0,
+        type=int,
+        metavar="N",
+        help="tensor-parallel serving over N chips (Megatron-style UNet "
+        "sharding, psums over ICI); 0 = single chip",
+    )
+    parser.add_argument(
         "--log-level",
         default="INFO",
         choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
     )
+    parser.add_argument(
+        "--profile-port",
+        default=0,
+        type=int,
+        help="start a jax.profiler trace server on this port (tensorboard-"
+        "connectable; the nvtx/pynvml analog, SURVEY sec.5)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=args.log_level.upper())
+    if args.profile_port:
+        from ..utils.profiling import start_profiler_server
+
+        start_profiler_server(args.profile_port)
+        logging.getLogger(__name__).info(
+            "jax profiler server on :%d", args.profile_port
+        )
 
     app = build_app(
         model_id=args.model_id,
         udp_ports=args.udp_ports.split(",") if args.udp_ports else None,
         controlnet=args.controlnet,
         multipeer=args.multipeer,
+        tp=args.tp,
     )
     web.run_app(app, host="0.0.0.0", port=args.port)
 

@@ -447,13 +447,25 @@ class StreamEngine:
         schedule: S.NoiseSchedule | None = None,
         jit_compile: bool = True,
         donate: bool = True,
+        mesh=None,
     ):
+        """``mesh``: optional multi-chip serving mesh.  With a tp axis > 1
+        the UNet/VAE params are placed by the Megatron-style rules
+        (parallel/sharding.py) and ONE stream step runs tensor-parallel
+        across the chips — XLA inserts the psums over ICI.  Single-stream
+        scale-out for when one chip can't hit the fps bar (SURVEY sec.2c
+        TP row)."""
         self.models = models
-        self.params = params
         self.cfg = cfg
         self.encode_prompt = encode_prompt
         self.schedule = schedule or S.make_schedule()
+        self.mesh = mesh
         self._t_index_list = tuple(cfg.t_index_list)
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            from ..parallel import sharding as SH
+
+            params = jax.device_put(params, SH.param_shardings(mesh, params))
+        self.params = params
         step = make_step_fn(models, cfg)
         if jit_compile:
             self._step = jax.jit(step, donate_argnums=(1,) if donate else ())

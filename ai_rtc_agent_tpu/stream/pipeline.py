@@ -52,6 +52,7 @@ class StreamDiffusionPipeline:
         seed: int = 2,
         controlnet: str | None = None,
         use_safety_checker: bool | None = None,
+        mesh=None,
     ):
         self.prompt = prompt
         self.model_id = model_id
@@ -76,6 +77,7 @@ class StreamDiffusionPipeline:
             params=bundle.params,
             cfg=cfg,
             encode_prompt=bundle.encode_prompt,
+            mesh=mesh,
         )
         self.engine.prepare(
             prompt=prompt,

@@ -207,3 +207,26 @@ def test_similar_image_filter_with_pipelined_depth():
     # duplicated (skipped) handles resolve to SOME real output bytes —
     # identical to the most recent real frame's output at submit time
     assert all(o.dtype == np.uint8 for o in outs)
+
+
+def test_tp_sharded_stream_engine_matches_single():
+    """Tensor-parallel single-stream serving (--tp N): the tp=2-sharded
+    engine computes the same stream as the single-device one (SURVEY
+    sec.2c TP row — Megatron rules on the serving step, psums over ICI)."""
+    from ai_rtc_agent_tpu.parallel import mesh as M
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test")
+    mk = lambda mesh: StreamEngine(
+        models=bundle.stream_models,
+        params=bundle.params,
+        cfg=cfg,
+        encode_prompt=bundle.encode_prompt,
+        mesh=mesh,
+    ).prepare("tp parity", seed=5)
+    eng1 = mk(None)
+    eng2 = mk(M.make_mesh(tp=2))
+    for f in _frames(3, seed=9):
+        o1, o2 = eng1(f), eng2(f)
+        # same math modulo reduction order: uint8 outputs within 2 LSB
+        assert np.abs(o1.astype(int) - o2.astype(int)).max() <= 2
