@@ -248,14 +248,17 @@ def load_model_bundle(
             f"ATTN_IMPL={attn_impl!r} unknown (xla | pallas | ring | ulysses)"
         )
     if attn_impl in ("ring", "ulysses"):
-        # the sp modes need layers.sp_attention_mesh active around tracing
-        # (the trainer/dryrun do this); the serving engines don't yet — the
-        # dispatch then falls back to DENSE XLA, which is slower than the
-        # default flash path.  Warn loudly instead of degrading silently.
+        # the sp modes need layers.sp_attention_mesh active around tracing:
+        # the trainer/dryrun activate it themselves, and serving does when
+        # the engine is built with an sp>1 mesh (StreamEngine(mesh=...) /
+        # agent --sp N).  Without one the dispatch falls back to DENSE XLA —
+        # slower than the default flash path.  Warn so that combination is
+        # never silent.
         logger.warning(
-            "ATTN_IMPL=%s only takes effect under an active sp_attention_mesh"
-            " (parallel training / dryrun); serving paths fall back to dense"
-            " XLA attention — prefer ATTN_IMPL=pallas on TPU",
+            "ATTN_IMPL=%s takes effect only under an active sp_attention_mesh"
+            " (trainer/dryrun, or serving with an sp>1 mesh via --sp);"
+            " otherwise attention falls back to dense XLA — prefer"
+            " ATTN_IMPL=pallas for single-chip TPU serving",
             attn_impl,
         )
 

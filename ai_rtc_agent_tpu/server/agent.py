@@ -487,10 +487,12 @@ async def on_startup(app):
         from ..stream.pipeline import StreamDiffusionPipeline
 
         mesh = None
-        if app.get("tp", 0) > 1:
+        if app.get("tp", 0) > 1 or app.get("sp", 0) > 1:
             from ..parallel import mesh as M
 
-            mesh = M.make_mesh(tp=app["tp"])
+            mesh = M.make_mesh(
+                tp=max(1, app.get("tp", 0)), sp=max(1, app.get("sp", 0))
+            )
         app["pipeline"] = StreamDiffusionPipeline(
             app["model_id"], controlnet=app.get("controlnet"), mesh=mesh
         )
@@ -527,6 +529,7 @@ def build_app(
     multipeer: int = 0,
     multipeer_pipeline=None,
     tp: int = 0,
+    sp: int = 0,
 ) -> web.Application:
     app = web.Application(middlewares=[cors_middleware])
     app["udp_ports"] = udp_ports
@@ -536,6 +539,7 @@ def build_app(
     app["multipeer"] = multipeer
     app["multipeer_pipeline"] = multipeer_pipeline  # injectable for tests
     app["tp"] = tp
+    app["sp"] = sp
     app["provider"] = provider or get_provider()
 
     app.on_startup.append(on_startup)
@@ -587,6 +591,14 @@ def main(argv=None):
         "sharding, psums over ICI); 0 = single chip",
     )
     parser.add_argument(
+        "--sp",
+        default=0,
+        type=int,
+        metavar="N",
+        help="sequence-parallel serving over N chips (latent tokens over "
+        "the sp axis; pair with ATTN_IMPL=ring or ulysses); 0 = off",
+    )
+    parser.add_argument(
         "--log-level",
         default="INFO",
         choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
@@ -614,6 +626,7 @@ def main(argv=None):
         controlnet=args.controlnet,
         multipeer=args.multipeer,
         tp=args.tp,
+        sp=args.sp,
     )
     web.run_app(app, host="0.0.0.0", port=args.port)
 

@@ -467,6 +467,20 @@ class StreamEngine:
             params = jax.device_put(params, SH.param_shardings(mesh, params))
         self.params = params
         step = make_step_fn(models, cfg)
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            # sequence-parallel serving: activate the sp attention context
+            # around the step so ATTN_IMPL=ring/ulysses models route their
+            # token axis over the mesh (layers.sp_attention_mesh); the
+            # wrapper costs a list push/pop per call — only trace time
+            # matters
+            from ..models.layers import sp_attention_mesh
+
+            inner = step
+
+            def step(params, state, frame_u8, _inner=inner):
+                with sp_attention_mesh(self.mesh, axis="sp"):
+                    return _inner(params, state, frame_u8)
+
         if jit_compile:
             self._step = jax.jit(step, donate_argnums=(1,) if donate else ())
         else:

@@ -230,3 +230,33 @@ def test_tp_sharded_stream_engine_matches_single():
         o1, o2 = eng1(f), eng2(f)
         # same math modulo reduction order: uint8 outputs within 2 LSB
         assert np.abs(o1.astype(int) - o2.astype(int)).max() <= 2
+
+
+def test_sp_sharded_stream_engine_matches_single(monkeypatch):
+    """Sequence-parallel single-stream serving (--sp N + ATTN_IMPL=ring):
+    the sp=2 engine routes UNet attention through ring attention
+    (parallel/ring_attention) and must match the single-device stream."""
+    from ai_rtc_agent_tpu.parallel import mesh as M
+
+    cfg = registry.default_stream_config("tiny-test")
+    bundle_xla = registry.load_model_bundle("tiny-test")
+    eng1 = StreamEngine(
+        models=bundle_xla.stream_models,
+        params=bundle_xla.params,
+        cfg=cfg,
+        encode_prompt=bundle_xla.encode_prompt,
+    ).prepare("sp parity", seed=5)
+
+    monkeypatch.setenv("ATTN_IMPL", "ring")
+    bundle_ring = registry.load_model_bundle("tiny-test")
+    eng2 = StreamEngine(
+        models=bundle_ring.stream_models,
+        params=bundle_ring.params,
+        cfg=cfg,
+        encode_prompt=bundle_ring.encode_prompt,
+        mesh=M.make_mesh(sp=2),
+    ).prepare("sp parity", seed=5)
+
+    for f in _frames(3, seed=11):
+        o1, o2 = eng1(f), eng2(f)
+        assert np.abs(o1.astype(int) - o2.astype(int)).max() <= 2
