@@ -435,6 +435,13 @@ class _TimedPipeline:
         if hasattr(pipeline, "submit"):
             self.submit = self._submit
             self.fetch = self._fetch
+        if hasattr(pipeline, "submit_batch"):
+            self.submit_batch = self._submit_batch
+            self.fetch_batch = self._fetch_batch
+
+    @property
+    def frame_buffer_size(self) -> int:
+        return int(getattr(self._pipeline, "frame_buffer_size", 1) or 1)
 
     def __call__(self, frame):
         with self._stats.timed():
@@ -448,6 +455,17 @@ class _TimedPipeline:
         out = self._pipeline.fetch(inner, src_frame)
         self._stats.record(time.monotonic() - t_sub)
         return out
+
+    def _submit_batch(self, frames):
+        return self._pipeline.submit_batch(frames), time.monotonic()
+
+    def _fetch_batch(self, handle, src_frames=None):
+        inner, t_sub = handle
+        outs = self._pipeline.fetch_batch(inner, src_frames)
+        dt = time.monotonic() - t_sub
+        for _ in outs:
+            self._stats.record(dt)
+        return outs
 
 
 # ---------------------------------------------------------------------------

@@ -61,7 +61,15 @@ class VideoStreamTrack:
         if h:
             h()
 
+    @property
+    def _fbs(self) -> int:
+        return int(getattr(self.pipeline, "frame_buffer_size", 1) or 1)
+
     async def recv(self):
+        fbs = self._fbs
+        if fbs > 1 and hasattr(self.pipeline, "submit_batch"):
+            return await self._recv_batched(fbs)
+
         while self.warmup_frame_idx < self.warmup_frames:
             logger.info("dropping warmup frames %d", self.warmup_frame_idx)
             frame = await self.track.recv()
@@ -84,3 +92,35 @@ class VideoStreamTrack:
             self._pending.append((frame, handle))
         src, handle = self._pending.popleft()
         return await asyncio.to_thread(self.pipeline.fetch, handle, src)
+
+    async def _recv_batched(self, fbs: int):
+        """frame_buffer_size>1 serving: fbs consecutive frames ride ONE
+        device step (the reference's fbs amortization, lib/wrapper.py:159-163,
+        brought to the live track); outputs drain one per recv()."""
+        if not hasattr(self, "_outbuf"):
+            self._outbuf = deque()
+
+        async def pull_batch():
+            return [await self.track.recv() for _ in range(fbs)]
+
+        while self.warmup_frame_idx < self.warmup_frames:
+            logger.info("dropping warmup frame batch @%d", self.warmup_frame_idx)
+            srcs = await pull_batch()
+            h = await asyncio.to_thread(self.pipeline.submit_batch, srcs)
+            await asyncio.to_thread(self.pipeline.fetch_batch, h, srcs)
+            self.warmup_frame_idx += fbs
+
+        # keep `pipeline_depth` BATCHES in flight (dispatch/compute/readback
+        # overlap across batches, same as the single-frame pipelined path)
+        while not self._outbuf:
+            for _ in range(self.drop_frames):
+                await self.track.recv()
+            srcs = await pull_batch()
+            self._pending.append(
+                (srcs, await asyncio.to_thread(self.pipeline.submit_batch, srcs))
+            )
+            if len(self._pending) >= max(1, self.pipeline_depth):
+                srcs0, h0 = self._pending.popleft()
+                outs = await asyncio.to_thread(self.pipeline.fetch_batch, h0, srcs0)
+                self._outbuf.extend(outs)
+        return self._outbuf.popleft()

@@ -145,6 +145,34 @@ class StreamDiffusionPipeline:
         pre = self.preprocess(frame)
         return self.engine.submit(pre)
 
+    # -- frame_buffer_size > 1: batched amortization in SERVING -------------
+    # (the reference pins fbs at engine-build time, lib/wrapper.py:159-163;
+    # here the track layer batches fbs consecutive frames per device step)
+
+    @property
+    def frame_buffer_size(self) -> int:
+        return self.config.frame_buffer_size
+
+    def submit_batch(self, frames):
+        """frames: list of fbs duck-typed frames -> one in-flight handle."""
+        pre = np.stack([self.preprocess(f) for f in frames])
+        return self.engine.submit(pre)
+
+    def fetch_batch(self, handle, src_frames=None):
+        """Resolve a submit_batch handle -> list of fbs output frames (pts
+        metadata attached per source like fetch)."""
+        out = self.engine.fetch(handle)  # [fbs, H, W, 3]
+        if self.safety_checker is not None:
+            out = self.safety_checker(out)
+        results = []
+        for i in range(out.shape[0]):
+            src = src_frames[i] if src_frames else None
+            if src is not None and hasattr(src, "pts") and not env.hw_encode():
+                results.append(self.postprocess(out[i], src))
+            else:
+                results.append(out[i])
+        return results
+
     def fetch(self, handle, src_frame=None):
         """Resolve a submit() handle; attaches pts metadata like __call__."""
         out = self.engine.fetch(handle)

@@ -52,3 +52,45 @@ def test_update_prompt_and_t_index(pipe):
     assert pipe.t_index_list == [12, 22, 32, 42]
     with pytest.raises(ValueError):
         pipe.update_t_index_list([1, 2, 3])
+
+
+def test_fbs2_serving_through_track(monkeypatch):
+    """frame_buffer_size=2 in the LIVE serving path: the track batches 2
+    consecutive frames per device step and drains outputs one per recv()
+    in order (the reference's fbs amortization, lib/wrapper.py:159-163,
+    previously bench-only)."""
+    import asyncio
+
+    from ai_rtc_agent_tpu.server.tracks import VideoStreamTrack
+    from ai_rtc_agent_tpu.stream.pipeline import StreamDiffusionPipeline
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamConfig
+
+    monkeypatch.setenv("WARMUP_FRAMES", "2")
+    cfg = registry.default_stream_config("tiny-test", frame_buffer_size=2)
+    pipe = StreamDiffusionPipeline("tiny-test", config=cfg)
+    assert pipe.frame_buffer_size == 2
+
+    class Source:
+        def __init__(self):
+            self.n = 0
+
+        async def recv(self):
+            self.n += 1
+            return np.full((64, 64, 3), (self.n * 9) % 256, np.uint8)
+
+    src = Source()
+    track = VideoStreamTrack(src, pipe, pipeline_depth=2)
+
+    async def go():
+        outs = [await track.recv() for _ in range(6)]
+        return outs
+
+    outs = asyncio.run(go())
+    assert len(outs) == 6
+    for o in outs:
+        arr = o if isinstance(o, np.ndarray) else o.to_ndarray()
+        assert arr.shape == (64, 64, 3) and arr.dtype == np.uint8
+    # warmup consumed 2 frames; 6 outputs need 3 more batches (2 each) with
+    # depth-2 batch pipelining keeping one extra batch in flight
+    assert src.n >= 2 + 6
