@@ -51,8 +51,28 @@ def get_twilio_token(http_post=None):
 
 
 def get_ice_servers(http_post=None) -> list[dict]:
-    """TURN-only server list (reference filters to turn: URLs,
-    agent.py:94-109)."""
+    """TURN server list.
+
+    Two sources, in precedence order:
+    1. ``ICE_SERVERS`` env — a JSON list of RTCIceServer-shaped dicts
+       (``[{"urls": ["turn:..."], "username": "...", "credential": "..."}]``)
+       for arbitrary TURN/STUN providers (the reference supports only
+       Twilio and documents the gap, docs/run.md).
+    2. Twilio ephemeral credentials (reference filters to turn: URLs,
+       agent.py:94-109).
+    """
+    import json
+
+    raw = env.get_str("ICE_SERVERS")
+    if raw:
+        try:
+            servers = json.loads(raw)
+            if isinstance(servers, list):
+                return servers
+            logger.error("ICE_SERVERS must be a JSON list, got %s", type(servers))
+        except ValueError as e:
+            logger.error("ICE_SERVERS is not valid JSON: %s", e)
+        return []
     token = get_twilio_token(http_post)
     if token is None:
         return []

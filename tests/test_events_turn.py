@@ -124,3 +124,23 @@ def test_udp_port_pinning(monkeypatch):
         return port
 
     assert asyncio.run(go()) == 19999
+
+
+def test_ice_servers_env_override(monkeypatch):
+    """ICE_SERVERS env supplies arbitrary TURN/STUN servers (the reference
+    supports only Twilio and documents the gap, docs/run.md)."""
+    from ai_rtc_agent_tpu.server import turn
+
+    servers = [
+        {"urls": ["turn:turn.example.com:3478"], "username": "u", "credential": "c"}
+    ]
+    import json
+
+    monkeypatch.setenv("ICE_SERVERS", json.dumps(servers))
+    assert turn.get_ice_servers() == servers
+
+    monkeypatch.setenv("ICE_SERVERS", "not json")
+    assert turn.get_ice_servers() == []
+
+    monkeypatch.setenv("ICE_SERVERS", '{"urls": "x"}')  # not a list
+    assert turn.get_ice_servers() == []
