@@ -32,7 +32,7 @@ from . import native
 from .codec import H264Decoder, H264Encoder, NullCodec
 from .frames import VideoFrame
 from .ring import FrameRing
-from .rtp import RtpDepacketizer, RtpPacketizer
+from .rtp import RtpDepacketizer, RtpPacketizer, RtpReorderBuffer
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +64,7 @@ class H264RingSource:
         self._dec = H264Decoder() if self.use_h264 else None
         self._ring = FrameRing((height, width, 3), n_slots=ring_slots)
         self._depkt = RtpDepacketizer() if native.load() else None
+        self._reorder = RtpReorderBuffer()
         self._meta: dict = {}  # pts -> wall_ts at decode completion
         self._ended = False
         self._handlers: dict = {}
@@ -74,26 +75,39 @@ class H264RingSource:
 
     # -- network side (any thread) ------------------------------------------
 
-    def depacketize(self, packet: bytes):
-        """One RTP packet -> completed (AU bytes, ts) or None.  Microseconds
-        of work — safe to call inline on the receive path; only the AU
-        decode (feed_au) needs a worker thread."""
+    def depacketize(self, packet: bytes) -> list:
+        """One RTP packet -> list of completed (AU bytes, ts).  Runs the
+        reorder buffer first (UDP reorders; FU-A assembly needs order), so
+        one packet may release several buffered ones and complete multiple
+        AUs.  Microseconds of work — safe inline on the receive path; only
+        the AU decode (feed_au) needs a worker thread."""
         if self._depkt is None:
             raise RuntimeError("native RTP runtime unavailable")
-        return self._depkt.push(packet)
+        aus = []
+        for pkt in self._reorder.push(packet):
+            got = self._depkt.push(pkt)
+            if got is not None:
+                aus.append(got)
+        return aus
 
     def feed_packet(self, packet: bytes):
-        """One RTP packet; completes an AU -> decode -> ring."""
-        got = self.depacketize(packet)
-        if got is not None:
-            au, ts = got
+        """One RTP packet; completed AUs -> decode -> ring."""
+        for au, ts in self.depacketize(packet):
             self.feed_au(au, ts)
 
     def feed_au(self, au: bytes, pts: int = 0):
-        """One encoded access unit -> decoded frame into the ring."""
+        """One encoded access unit -> decoded frame into the ring.
+
+        A corrupt AU (packet loss past the reorder window, mid-stream join
+        before the first keyframe) drops THAT frame and keeps the stream
+        alive — the decoder resynchronizes at the next IDR."""
         t0 = time.monotonic()
         if self.use_h264:
-            got = self._dec.decode(au, pts)
+            try:
+                got = self._dec.decode(au, pts)
+            except RuntimeError as e:
+                logger.warning("dropping undecodable AU (%s)", e)
+                return
             if got is None:
                 return
             frame, out_pts = got

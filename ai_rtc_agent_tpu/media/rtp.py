@@ -56,6 +56,50 @@ class RtpPacketizer:
             pass
 
 
+def _seq_lt(a: int, b: int) -> bool:
+    """RFC 1889 sequence-number comparison with 16-bit wraparound."""
+    return ((a - b) & 0xFFFF) > 0x8000
+
+
+class RtpReorderBuffer:
+    """Minimal jitter/reorder stage ahead of the depacketizer.
+
+    Real UDP reorders packets; FU-A reassembly (native/rtp.cpp) assumes
+    in-order delivery.  This buffer releases packets in sequence order,
+    drops late duplicates, and on a gap older than ``window`` buffered
+    packets declares the missing packet lost and resumes from the earliest
+    buffered one (real-time: never stall waiting for a retransmit that
+    will not come).  The aiortc-fork analog is its jitter buffer (SURVEY.md
+    L3).
+    """
+
+    def __init__(self, window: int = 32):
+        self.window = window
+        self._buf: dict[int, bytes] = {}
+        self._next: int | None = None
+
+    def push(self, packet: bytes) -> list[bytes]:
+        if len(packet) < 4:
+            return []
+        seq = (packet[2] << 8) | packet[3]
+        if self._next is None:
+            self._next = seq
+        if _seq_lt(seq, self._next):
+            return []  # late duplicate / already-released
+        self._buf[seq] = packet
+        out = []
+        while self._next in self._buf:
+            out.append(self._buf.pop(self._next))
+            self._next = (self._next + 1) & 0xFFFF
+        if len(self._buf) > self.window:
+            # declare the gap lost: resume from the earliest buffered seq
+            self._next = min(self._buf, key=lambda s: (s - self._next) & 0xFFFF)
+            while self._next in self._buf:
+                out.append(self._buf.pop(self._next))
+                self._next = (self._next + 1) & 0xFFFF
+        return out
+
+
 class RtpDepacketizer:
     def __init__(self):
         self._lib = native.load()

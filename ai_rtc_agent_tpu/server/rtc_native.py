@@ -59,18 +59,17 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
     def datagram_received(self, data, addr):
         try:
-            # depacketize inline (microseconds); queue only COMPLETED access
-            # units so the worker hop is paid per frame, not per packet
-            got = self.source.depacketize(data)
+            # reorder + depacketize inline (microseconds); queue only
+            # COMPLETED access units so the worker hop is per frame
+            aus = self.source.depacketize(data)
         except Exception:
             logger.exception("RTP depacketize error")
             return
-        if got is None:
-            return
-        try:
-            self._q.put_nowait(got)
-        except asyncio.QueueFull:
-            pass  # real-time: drop rather than queue latency
+        for got in aus:
+            try:
+                self._q.put_nowait(got)
+            except asyncio.QueueFull:
+                pass  # real-time: drop rather than queue latency
 
     async def _decode_loop(self):
         while True:
