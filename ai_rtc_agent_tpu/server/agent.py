@@ -278,6 +278,11 @@ async def whep(request):
     pcs.add(pc)
     app["state"].setdefault("whep_pcs", {})[session_id] = pc
 
+    # each viewer gets its own relayed view of the processed stream — never
+    # concurrent recv() on the shared track (reference MediaRelay parity)
+    relay = app["state"].get("source_relay")
+    viewer_track = relay.subscribe() if relay is not None else source_track
+
     @pc.on("iceconnectionstatechange")
     async def on_iceconnectionstatechange():
         logger.info("ICE connection state is %s", pc.iceConnectionState)
@@ -292,8 +297,10 @@ async def whep(request):
             await pc.close()
             pcs.discard(pc)
             app["state"].get("whep_pcs", {}).pop(session_id, None)
+            if relay is not None:
+                viewer_track.stop()
 
-    sender = pc.addTrack(source_track)
+    sender = pc.addTrack(viewer_track)
     provider.force_codec(pc, sender, "video/H264")
 
     await pc.setRemoteDescription(offer_sdp)
@@ -365,6 +372,12 @@ async def whip(request):
                 vt = VideoStreamTrack(track, _TimedPipeline(pipeline, stats))
                 app["state"].setdefault("whip_tracks", {})[session_id] = vt
                 app["state"]["source_track"] = vt  # latest publisher wins
+                # one relay per publisher: N WHEP viewers share the stream
+                # without concurrent recv() on one track (the reference's
+                # MediaRelay, agent.py:424-430)
+                from .relay import TrackRelay
+
+                app["state"]["source_relay"] = TrackRelay(vt)
 
             @track.on("ended")
             async def on_ended():

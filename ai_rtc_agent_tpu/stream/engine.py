@@ -28,6 +28,7 @@ Stream-batch semantics (reference batch law lib/wrapper.py:159-163):
 from __future__ import annotations
 
 import math
+import threading
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Callable
@@ -490,6 +491,11 @@ class StreamEngine:
         self._last_out = None
         self._last_submitted = None
         self._prev_frame_small = None
+        # submit() is a read-modify-write of self.state; concurrent tracks
+        # (several connections sharing one pipeline, each stepping on a
+        # worker thread) must serialize it.  The reference gets this for
+        # free by blocking its event loop (lib/tracks.py:24) — we don't.
+        self._submit_lock = threading.Lock()
 
     # -- state construction -------------------------------------------------
 
@@ -625,34 +631,38 @@ class StreamEngine:
         engine state advances on-device immediately, so several frames can
         be in flight — the dispatch pipeline stays full (the reference
         blocks its event loop per frame, lib/tracks.py:24; we must not:
-        SURVEY.md section 7 "hard parts").
+        SURVEY.md section 7 "hard parts").  Thread-safe: dispatches from
+        concurrent tracks serialize on a lock (the dispatch is async — the
+        lock covers microseconds of host work, not device time).
         """
         if self.state is None:
             raise RuntimeError("call prepare() first")
-        if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
-            # skip the device step entirely: the handle DUPLICATES the most
-            # recently submitted output buffer, so resolution order stays
-            # correct even when fetches run concurrently on pool threads
-            # (resolving against host-side _last_out would race the
-            # in-flight frames and step the stream backwards)
-            if self._last_submitted is not None:
-                return ("dup",) + self._last_submitted
-            return None, frame_u8.ndim == 3
-        squeeze = frame_u8.ndim == 3
-        if isinstance(frame_u8, np.ndarray):
-            # async host->HBM staging BEFORE dispatch (the DeviceFeeder
-            # pattern from media/ring.py, inlined): device_put returns
-            # immediately and the transfer rides under in-flight compute; a
-            # numpy arg would block the dispatch on a synchronous copy
-            # (reference NVDEC zero-copy analog, README.md:11-15)
-            frame_u8 = jax.device_put(frame_u8)
-        self.state, out = self._step(self.params, self.state, frame_u8)
-        try:  # overlap device->host copy with subsequent compute
-            out.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass
-        self._last_submitted = (out, squeeze)
-        return out, squeeze
+        with self._submit_lock:
+            if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
+                # skip the device step entirely: the handle DUPLICATES the
+                # most recently submitted output buffer, so resolution order
+                # stays correct even when fetches run concurrently on pool
+                # threads (resolving against host-side _last_out would race
+                # the in-flight frames and step the stream backwards)
+                if self._last_submitted is not None:
+                    return ("dup",) + self._last_submitted
+                return None, frame_u8.ndim == 3
+            squeeze = frame_u8.ndim == 3
+            if isinstance(frame_u8, np.ndarray):
+                # async host->HBM staging BEFORE dispatch (the DeviceFeeder
+                # pattern from media/ring.py, inlined): device_put returns
+                # immediately and the transfer rides under in-flight
+                # compute; a numpy arg would block the dispatch on a
+                # synchronous copy (reference NVDEC zero-copy analog,
+                # README.md:11-15)
+                frame_u8 = jax.device_put(frame_u8)
+            self.state, out = self._step(self.params, self.state, frame_u8)
+            try:  # overlap device->host copy with subsequent compute
+                out.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            self._last_submitted = (out, squeeze)
+            return out, squeeze
 
     def fetch(self, pending) -> np.ndarray:
         """Resolve a handle from :meth:`submit` to a host uint8 array."""

@@ -133,6 +133,13 @@ def test_whip_then_whep_loopback_end_to_end(monkeypatch):
             )
             assert r.status == 201
 
+            # the viewer gets a RELAYED view of the processed stream (the
+            # reference's MediaRelay fan-out, agent.py:424-430) — never the
+            # raw shared track
+            whep_pc = next(pc for pc in app["pcs"] if pc.out_tracks)
+            viewer = whep_pc.out_tracks[0]
+            assert viewer is not source
+
             # find the publisher pc and push frames into its inbound track
             pub_pc = next(pc for pc in app["pcs"] if pc.in_track is not None)
             frames = [
@@ -141,9 +148,10 @@ def test_whip_then_whep_loopback_end_to_end(monkeypatch):
             for f in frames:
                 await pub_pc.in_track.push(f)
 
-            out = await source.recv()  # drops 2 warmup frames, returns 3rd
-            np.testing.assert_array_equal(out, 255 - frames[2])
-            assert pipe.calls == 3  # 2 warmups + 1 real
+            out = await viewer.recv()  # 2 warmups dropped by the track
+            expected = [255 - f for f in frames[2:]]
+            assert any(np.array_equal(out, e) for e in expected)
+            assert pipe.calls >= 3  # 2 warmups + >=1 real
 
             # datachannel config reaches the pipeline
             await pub_pc.datachannel.deliver(json.dumps({"prompt": "via dc"}))
@@ -254,6 +262,52 @@ def test_whep_session_scoped_delete(monkeypatch):
             assert r.status == 200
             assert app["state"]["source_track"] is None
             assert not app["state"]["whip_pcs"]
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_whep_two_viewers_both_get_frames(monkeypatch):
+    """Relay fan-out: TWO WHEP viewers each receive the processed stream
+    (without a relay each frame went to exactly one viewer and concurrent
+    recv() corrupted the shared track's state)."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    pipe = FakePipeline()
+
+    async def go():
+        app, client = await _client(pipe)
+        try:
+            r = await client.post(
+                "/whip",
+                data=make_loopback_offer(),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            viewers = []
+            for _ in range(2):
+                r = await client.post(
+                    "/whep",
+                    data=make_loopback_offer(video=False, datachannel=False),
+                    headers={"Content-Type": "application/sdp"},
+                )
+                assert r.status == 201
+            for pc in app["pcs"]:
+                if pc.out_tracks:
+                    viewers.append(pc.out_tracks[0])
+            assert len(viewers) == 2
+
+            pub_pc = next(pc for pc in app["pcs"] if pc.in_track is not None)
+            frames = [
+                np.full((8, 8, 3), 30 + i * 40, dtype=np.uint8) for i in range(3)
+            ]
+            for f in frames:
+                await pub_pc.in_track.push(f)
+
+            outs = [await v.recv() for v in viewers]
+            expected = [255 - f for f in frames]
+            for out in outs:
+                assert any(np.array_equal(out, e) for e in expected)
         finally:
             await client.close()
 
