@@ -178,3 +178,112 @@ def test_agent_multipeer_offer_claims_and_503(monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_multipeer_native_rtp_two_udp_clients(monkeypatch):
+    """--multipeer over the native RTP transport: two UDP clients each claim
+    a slot and each gets its own processed stream back (BASELINE configs[4]
+    end-to-end on a real wire)."""
+    from ai_rtc_agent_tpu.media import native
+
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    from ai_rtc_agent_tpu.media.frames import VideoFrame
+    from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
+    from ai_rtc_agent_tpu.server.multipeer_serving import MultiPeerPipeline
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+
+    use_h264 = native.h264_available()
+
+    async def go():
+        mp = MultiPeerPipeline("tiny-test", max_peers=2)
+        provider = NativeRtpProvider(use_h264=use_h264)
+        app = build_app(multipeer=2, multipeer_pipeline=mp, provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        loop = asyncio.get_event_loop()
+        w, h = mp.width, mp.height
+        try:
+            clients = []
+            for n in range(2):
+                q: asyncio.Queue = asyncio.Queue()
+
+                class _Recv(asyncio.DatagramProtocol):
+                    def __init__(self, q=q):
+                        self.q = q
+
+                    def datagram_received(self, data, addr):
+                        self.q.put_nowait(data)
+
+                tr, _ = await loop.create_datagram_endpoint(
+                    _Recv, local_addr=("127.0.0.1", 0)
+                )
+                port = tr.get_extra_info("sockname")[1]
+                offer = json.dumps(
+                    {
+                        "native_rtp": True,
+                        "video": True,
+                        "client_addr": ["127.0.0.1", port],
+                        "width": w,
+                        "height": h,
+                    }
+                )
+                r = await client.post(
+                    "/offer",
+                    json={"room_id": f"rtp{n}", "offer": {"sdp": offer, "type": "offer"}},
+                )
+                assert r.status == 200, await r.text()
+                server_port = json.loads((await r.json())["sdp"])["server_port"]
+                send, _ = await loop.create_datagram_endpoint(
+                    asyncio.DatagramProtocol,
+                    remote_addr=("127.0.0.1", server_port),
+                )
+                clients.append(
+                    dict(
+                        q=q, recv_tr=tr, send=send,
+                        sink=H264Sink(w, h, use_h264=use_h264, ssrc=0x100 + n),
+                        back=H264RingSource(w, h, use_h264=use_h264),
+                        decoded=[],
+                    )
+                )
+            assert mp.free_slots == 0
+
+            rng = np.random.default_rng(1)
+            import time as _time
+
+            deadline = _time.monotonic() + 300  # first step jit-compiles
+            i = 0
+            while _time.monotonic() < deadline:
+                i += 1
+                for c in clients:
+                    f = VideoFrame.from_ndarray(
+                        rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+                    )
+                    f.pts = i * 3000
+                    for pkt in c["sink"].consume(f):
+                        c["send"].sendto(pkt)
+                await asyncio.sleep(0.05)
+                for c in clients:
+                    try:
+                        while True:
+                            c["back"].feed_packet(c["q"].get_nowait())
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := c["back"]._ring.pop()) is not None:
+                        c["decoded"].append(item[0])
+                if all(c["decoded"] for c in clients):
+                    break
+            for n, c in enumerate(clients):
+                assert c["decoded"], f"client {n} got no frames back"
+                assert c["decoded"][0].shape == (h, w, 3)
+        finally:
+            for c in clients:
+                c["sink"].close()
+                c["back"].close()
+                c["recv_tr"].close()
+                c["send"].close()
+            await client.close()
+            mp.close()
+
+    run(go())

@@ -260,3 +260,28 @@ def test_sp_sharded_stream_engine_matches_single(monkeypatch):
     for f in _frames(3, seed=11):
         o1, o2 = eng1(f), eng2(f)
         assert np.abs(o1.astype(int) - o2.astype(int)).max() <= 2
+
+
+def test_concurrent_submits_from_two_threads():
+    """Two tracks sharing one engine dispatch from worker threads (single-
+    pipeline serving with multiple connections): the submit lock must keep
+    every handle resolvable and outputs well-formed."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    eng, cfg = _engine()
+    eng.prepare("two tracks", seed=2)
+    frames = _frames(16, seed=3)
+
+    def worker(fs):
+        outs = []
+        for f in fs:
+            outs.append(eng.fetch(eng.submit(f)))
+        return outs
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        r1 = pool.submit(worker, frames[:8])
+        r2 = pool.submit(worker, frames[8:])
+        outs = r1.result() + r2.result()
+    assert len(outs) == 16
+    for o in outs:
+        assert o.shape == (cfg.height, cfg.width, 3) and o.dtype == np.uint8
