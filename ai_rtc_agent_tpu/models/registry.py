@@ -68,13 +68,31 @@ def family_of(model_id: str) -> str:
 def default_stream_config(model_id: str, **overrides) -> StreamConfig:
     """Per-family serving defaults mirroring BASELINE.json's tracked configs."""
     fam = family_of(model_id)
-    if fam == "sd21" or "turbo" in model_id.lower() and fam != "sdxl":
+    m = model_id.lower()
+    if "turbo" in m and fam != "sdxl":
         base = dict(
             t_index_list=(0,),
             num_inference_steps=1,
             timestep_spacing="trailing",
             scheduler="turbo",
             cfg_type="none",
+        )
+    elif fam == "sd21":
+        # UNDISTILLED SD2.x: stream-batch LCM serving like SD1.5 (a 1-step
+        # turbo schedule on a non-distilled checkpoint produces noise).
+        # stable-diffusion-2-1 (no "-base") is the 768px v-prediction model;
+        # the -base variants are 512px epsilon.
+        v768 = m.rstrip("/").endswith("2-1") or "768" in m
+        base = dict(
+            t_index_list=(18, 26, 35, 45),
+            num_inference_steps=50,
+            scheduler="lcm",
+            cfg_type="self",
+            **(
+                dict(height=768, width=768, prediction_type="v_prediction")
+                if v768
+                else {}
+            ),
         )
     elif fam == "sdxl":
         base = dict(

@@ -132,3 +132,46 @@ def test_clip_skip_penultimate():
     h0 = np.asarray(C.apply_clip_text(params, ids, cfg0)["hidden"])
     h1 = np.asarray(C.apply_clip_text(params, ids, cfg1)["hidden"])
     assert not np.allclose(h0, h1)
+
+
+def test_default_stream_config_families():
+    """Config routing: turbo ids get the 1-step turbo schedule; UNDISTILLED
+    SD2.x gets the stream-batch LCM schedule (a 1-step schedule on a
+    non-distilled checkpoint produces noise), with 768/v-prediction for
+    stable-diffusion-2-1 and 512/epsilon for -base."""
+    from ai_rtc_agent_tpu.models import registry
+
+    turbo = registry.default_stream_config("stabilityai/sd-turbo")
+    assert turbo.scheduler == "turbo" and turbo.t_index_list == (0,)
+
+    sd21 = registry.default_stream_config("stabilityai/stable-diffusion-2-1")
+    assert sd21.scheduler == "lcm" and len(sd21.t_index_list) == 4
+    assert sd21.prediction_type == "v_prediction"
+    assert sd21.height == 768
+
+    sd21b = registry.default_stream_config("stabilityai/stable-diffusion-2-1-base")
+    assert sd21b.prediction_type == "epsilon" and sd21b.height == 512
+
+    xl = registry.default_stream_config("stabilityai/sdxl-turbo")
+    assert xl.height == 1024 and xl.use_added_cond
+
+    sd15 = registry.default_stream_config("lykon/dreamshaper-8")
+    assert sd15.scheduler == "lcm" and sd15.cfg_type == "self"
+
+
+def test_v_prediction_stream_end_to_end(rng):
+    """The v-prediction path (SD2.1-768 family) streams end to end."""
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config(
+        "tiny-test", prediction_type="v_prediction"
+    )
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    ).prepare("v-pred stream", seed=4)
+    frame = rng.integers(0, 256, (cfg.height, cfg.width, 3), dtype=np.uint8)
+    for _ in range(3):
+        out = eng(frame)
+    assert out.shape == frame.shape and out.dtype == np.uint8
