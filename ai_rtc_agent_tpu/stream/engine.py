@@ -700,12 +700,17 @@ class StreamEngine:
     # -- control plane (no recompiles) -------------------------------------
 
     def update_prompt(self, prompt: str):
-        """Embedding swap (reference lib/pipeline.py:44-45)."""
+        """Embedding swap (reference lib/pipeline.py:44-45).  The encode
+        runs un-locked (heavy); only the state writes take the submit lock
+        so they can't interleave with a concurrent dispatch."""
         cond, uncond, extras = self._encode(prompt)
-        self.state["cond"] = jnp.asarray(cond, self.cfg.jdtype)
-        self.state["uncond"] = jnp.asarray(uncond, self.cfg.jdtype)
-        if self.cfg.use_added_cond and "pooled" in extras:
-            self.state["added_text"] = jnp.asarray(extras["pooled"], self.cfg.jdtype)
+        with self._submit_lock:
+            self.state["cond"] = jnp.asarray(cond, self.cfg.jdtype)
+            self.state["uncond"] = jnp.asarray(uncond, self.cfg.jdtype)
+            if self.cfg.use_added_cond and "pooled" in extras:
+                self.state["added_text"] = jnp.asarray(
+                    extras["pooled"], self.cfg.jdtype
+                )
 
     def _encode(self, prompt: str):
         res = self.encode_prompt(prompt)
@@ -725,17 +730,21 @@ class StreamEngine:
                 f"(compiled batch size); rebuild the engine to change depth"
             )
         self._t_index_list = t_index_list
-        self.state["coeffs"] = _coeff_state(self.cfg, self.schedule, t_index_list)
+        coeffs = _coeff_state(self.cfg, self.schedule, t_index_list)
+        with self._submit_lock:
+            self.state["coeffs"] = coeffs
 
     def update_guidance(self, guidance_scale=None, delta=None):
-        if guidance_scale is not None:
-            self.state["guidance"] = jnp.asarray(guidance_scale, jnp.float32)
-        if delta is not None:
-            self.state["delta"] = jnp.asarray(delta, jnp.float32)
+        with self._submit_lock:
+            if guidance_scale is not None:
+                self.state["guidance"] = jnp.asarray(guidance_scale, jnp.float32)
+            if delta is not None:
+                self.state["delta"] = jnp.asarray(delta, jnp.float32)
 
     def update_controlnet_scale(self, scale: float):
         """Runtime conditioning-strength swap (no recompile) — analog of the
         reference's fixed conditioning scale (lib/wrapper.py:870-877)."""
         if not self.cfg.use_controlnet:
             raise RuntimeError("engine built without use_controlnet")
-        self.state["cnet_scale"] = jnp.asarray(scale, jnp.float32)
+        with self._submit_lock:
+            self.state["cnet_scale"] = jnp.asarray(scale, jnp.float32)
