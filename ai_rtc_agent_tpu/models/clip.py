@@ -70,6 +70,21 @@ class CLIPTextConfig:
     def tiny() -> "CLIPTextConfig":
         return CLIPTextConfig(vocab_size=256, max_length=16, width=32, layers=2, heads=4)
 
+    @staticmethod
+    def tiny_dual() -> "CLIPTextConfig":
+        """First tower of the hermetic SDXL-style tiny family (widths
+        halve so the two towers concatenate to tiny_xl's cross dim)."""
+        return CLIPTextConfig(vocab_size=256, max_length=16, width=16, layers=2, heads=2)
+
+    @staticmethod
+    def tiny_g() -> "CLIPTextConfig":
+        """Second (projected) tower of the tiny SDXL-style family — the
+        OpenCLIP-G analog providing hidden states + pooled projection."""
+        return CLIPTextConfig(
+            vocab_size=256, max_length=16, width=16, layers=2, heads=2,
+            use_text_projection=True, projection_dim=16,
+        )
+
 
 def init_clip_text(key, cfg: CLIPTextConfig):
     keys = jax.random.split(key, 4 + cfg.layers)

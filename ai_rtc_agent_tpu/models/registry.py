@@ -56,6 +56,8 @@ class ModelBundle:
 
 def family_of(model_id: str) -> str:
     m = model_id.lower()
+    if ("tiny" in m or "test" in m) and "xl" in m:
+        return "tinyxl"
     if "tiny" in m or "test" in m:
         return "tiny"
     if "sdxl" in m:
@@ -105,8 +107,10 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
             cfg_type="none",
             use_added_cond=True,
         )
-    elif fam == "tiny":
+    elif fam in ("tiny", "tinyxl"):
         base = dict(height=64, width=64, latent_scale=4)
+        if fam == "tinyxl":
+            base["use_added_cond"] = True
     else:  # sd15 stream-batch LCM (the reference's default mode)
         base = dict(
             t_index_list=(18, 26, 35, 45),
@@ -131,6 +135,13 @@ def _model_configs(fam: str):
         return (
             U.UNetConfig.tiny(),
             C.CLIPTextConfig.tiny(),
+            T.TAESDConfig(width=8, num_stages=2, blocks_per_stage=1),
+        )
+    if fam == "tinyxl":
+        # hermetic SDXL-style family: dual text towers + text_time addition
+        return (
+            U.UNetConfig.tiny_xl(),
+            C.CLIPTextConfig.tiny_dual(),
             T.TAESDConfig(width=8, num_stages=2, blocks_per_stage=1),
         )
     raise ValueError(fam)
@@ -181,11 +192,15 @@ def load_model_bundle(
         "clip": C.init_clip_text(kc, clip_cfg),
         "taesd": T.init_taesd(kt, taesd_cfg),
     }
-    if fam == "sdxl":
-        params["clip2"] = C.init_clip_text(
-            jax.random.fold_in(kc, 1), C.CLIPTextConfig.sdxl_g()
-        )
-    if fam == "tiny":
+    dual_tower = fam in ("sdxl", "tinyxl")
+    clip2_cfg = (
+        C.CLIPTextConfig.sdxl_g()
+        if fam == "sdxl"
+        else C.CLIPTextConfig.tiny_g() if fam == "tinyxl" else None
+    )
+    if dual_tower:
+        params["clip2"] = C.init_clip_text(jax.random.fold_in(kc, 1), clip2_cfg)
+    if fam in ("tiny", "tinyxl"):
         latent_scale = 4
     cnet_num_down = {8: 3, 4: 2, 2: 1}.get(latent_scale)
     if controlnet is not None and cnet_num_down is None:
@@ -245,7 +260,7 @@ def load_model_bundle(
             logger.info("fused LoRA %s (scale %s): %d modules", path, scale, n)
 
     tok = TK.find_clip_tokenizer(snap or "", max_length=clip_cfg.max_length)
-    if fam == "tiny":
+    if fam in ("tiny", "tinyxl"):
         tok = TK.HashTokenizer(
             vocab_size=clip_cfg.vocab_size, max_length=clip_cfg.max_length
         )
@@ -300,9 +315,8 @@ def load_model_bundle(
         return T.decode(p["taesd"]["decoder"], z, taesd_cfg)
 
     clip_jit = jax.jit(partial(C.apply_clip_text, cfg=clip_cfg))
-    clip2_cfg = C.CLIPTextConfig.sdxl_g() if fam == "sdxl" else None
     clip2_jit = (
-        jax.jit(partial(C.apply_clip_text, cfg=clip2_cfg)) if fam == "sdxl" else None
+        jax.jit(partial(C.apply_clip_text, cfg=clip2_cfg)) if dual_tower else None
     )
 
     def encode_prompt(prompt: str):
@@ -310,7 +324,7 @@ def load_model_bundle(
         ids_neg = np.asarray([tok("")], np.int32)
         out_c = clip_jit(params["clip"], jnp.asarray(ids))
         out_u = clip_jit(params["clip"], jnp.asarray(ids_neg))
-        if fam != "sdxl":
+        if not dual_tower:
             return np.asarray(out_c["hidden"]), np.asarray(out_u["hidden"])
         g_c = clip2_jit(params["clip2"], jnp.asarray(ids))
         g_u = clip2_jit(params["clip2"], jnp.asarray(ids_neg))

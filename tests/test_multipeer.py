@@ -102,3 +102,23 @@ def test_multipeer_aot_cache_roundtrip(bundle, tmp_path):
     assert not mp4.use_aot_cache(
         "tiny-test", cache_dir=str(tmp_path), build_on_miss=True
     )
+
+
+def test_multipeer_sdxl_extras_swap_on_prompt_update(rng):
+    """Round-1 defect regression: per-slot prompt updates on an SDXL-style
+    engine must swap the POOLED embeds (added_text), not just cond/uncond."""
+    bundle = registry.load_model_bundle("tiny-xl-test")
+    cfg = registry.default_stream_config("tiny-xl-test")
+    mp = MultiPeerEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_peers=2,
+    ).start("base prompt")
+    before = np.asarray(mp.states["added_text"])
+    mp.update_prompt(1, "a different sdxl prompt")
+    after = np.asarray(mp.states["added_text"])
+    assert np.array_equal(before[0], after[0])  # slot 0 untouched
+    assert not np.array_equal(before[1], after[1])  # slot 1 swapped
+
+    frames = rng.integers(0, 256, (2, cfg.height, cfg.width, 3), dtype=np.uint8)
+    out = mp.step_all(frames)
+    assert out.shape == (2, cfg.height, cfg.width, 3)

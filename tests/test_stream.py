@@ -285,3 +285,26 @@ def test_concurrent_submits_from_two_threads():
     assert len(outs) == 16
     for o in outs:
         assert o.shape == (cfg.height, cfg.width, 3) and o.dtype == np.uint8
+
+
+def test_tinyxl_added_cond_stream_and_prompt_swap():
+    """The hermetic SDXL-style family (dual text towers + text_time
+    addition embeds) streams end to end, and a prompt update swaps the
+    POOLED embeds too (reference SDXL conditioning surface)."""
+    bundle = registry.load_model_bundle("tiny-xl-test")
+    cfg = registry.default_stream_config("tiny-xl-test")
+    assert cfg.use_added_cond
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    ).prepare("an sdxl-style prompt", seed=6)
+    assert "added_text" in eng.state
+
+    frame = _frames(1, seed=13)[0]
+    outs_a = [eng(frame) for _ in range(5)]
+    pooled_before = np.asarray(eng.state["added_text"])
+    eng.update_prompt("a totally different style")
+    pooled_after = np.asarray(eng.state["added_text"])
+    assert not np.array_equal(pooled_before, pooled_after)
+    out_b = eng(frame)
+    assert out_b.shape == frame.shape
+    assert not np.array_equal(outs_a[-1], out_b)
