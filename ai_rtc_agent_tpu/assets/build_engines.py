@@ -40,6 +40,9 @@ def build(
     cfg = registry.default_stream_config(
         model_id, **({"use_controlnet": True} if controlnet else {})
     )
+    # params dtype is part of the engine signature — must match serving
+    # (StreamDiffusionPipeline casts identically)
+    bundle.params = registry.cast_params(bundle.params, cfg.dtype)
     engine = StreamEngine(
         bundle.stream_models,
         bundle.params,

@@ -121,6 +121,12 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
     base.update(overrides)
     # fused Pallas epilogue on real TPUs (interpret-mode is slow on CPU)
     base.setdefault("use_fused_epilogue", jax.default_backend() == "tpu")
+    # bf16 compute on real TPUs (fp32 elsewhere): the SERVING default must
+    # match what the bench measures — fp32 serving on TPU would halve MXU
+    # throughput and double HBM traffic
+    base.setdefault(
+        "dtype", "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    )
     return StreamConfig(**base)
 
 

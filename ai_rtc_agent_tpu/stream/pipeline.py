@@ -71,6 +71,7 @@ class StreamDiffusionPipeline:
             model_id, lora_dict=lora_dict, controlnet=controlnet,
             latent_scale=cfg.latent_scale,
         )
+        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
         self.t_index_list = list(cfg.t_index_list)
         self.engine = StreamEngine(
             models=bundle.stream_models,
