@@ -161,7 +161,8 @@ async def offer(request):
             sdp=offer_params["sdp"], type=offer_params["type"]
         )
 
-        ice_servers = turn.get_ice_servers()
+        # blocking HTTP to Twilio (up to 10 s) — never on the event loop
+        ice_servers = await asyncio.to_thread(turn.get_ice_servers)
         pc = provider.peer_connection(ice_servers if ice_servers else None)
         pcs.add(pc)
 
