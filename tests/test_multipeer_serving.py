@@ -287,3 +287,22 @@ def test_multipeer_native_rtp_two_udp_clients(monkeypatch):
             mp.close()
 
     run(go())
+
+
+def test_multipeer_with_controlnet(rng):
+    """--multipeer + --controlnet combine (round-2 review fix: the flag was
+    silently dropped): the batched engine carries the conditioned branch and
+    per-peer streams step with in-graph canny annotation."""
+    from ai_rtc_agent_tpu.server.multipeer_serving import MultiPeerPipeline
+
+    mp = MultiPeerPipeline(
+        "tiny-test", max_peers=2, controlnet="tiny-cnet-random"
+    )
+    try:
+        assert mp.config.use_controlnet
+        p1 = mp.claim("conditioned stream")
+        frame = rng.integers(0, 256, (mp.height, mp.width, 3), dtype=np.uint8)
+        out = p1(frame)
+        assert out.shape == frame.shape and out.dtype == np.uint8
+    finally:
+        mp.close()

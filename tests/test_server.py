@@ -312,3 +312,40 @@ def test_whep_two_viewers_both_get_frames(monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_relay_slow_viewer_drops_not_blocks():
+    """Latest-wins fan-out: a stalled viewer must not block the pump or the
+    healthy viewer, and catches up to a RECENT frame when it resumes."""
+    from ai_rtc_agent_tpu.server.relay import TrackRelay
+
+    class Source:
+        def __init__(self):
+            self.q = asyncio.Queue()
+
+        async def recv(self):
+            return await self.q.get()
+
+    async def go():
+        src = Source()
+        relay = TrackRelay(src)
+        fast = relay.subscribe(maxsize=2)
+        slow = relay.subscribe(maxsize=2)
+
+        for i in range(8):
+            await src.q.put(np.full((4, 4, 3), i, np.uint8))
+
+        fast_frames = [await fast.recv() for _ in range(2)]
+        assert all(f.shape == (4, 4, 3) for f in fast_frames)
+        # slow viewer never polled while 8 frames flowed: its queue kept only
+        # the freshest maxsize frames
+        got = await slow.recv()
+        assert int(got[0, 0, 0]) >= 4, "stalled viewer should skip stale frames"
+
+        slow.stop()
+        await src.q.put(np.full((4, 4, 3), 99, np.uint8))
+        out = await fast.recv()
+        assert out is not None
+        relay.stop()
+
+    run(go())
