@@ -336,3 +336,93 @@ def test_source_survives_shuffled_packets(native_lib):
     assert got >= 3, f"only {got} frames decoded from shuffled packets"
     sink.close()
     src.close()
+
+
+def test_whip_whep_over_native_rtp(native_lib, monkeypatch):
+    """Publisher (WHIP) and viewer (WHEP) over the native RTP wire: OBS-style
+    ingest -> pipeline -> relay fan-out -> RTP back out to the subscriber."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    use_h264 = _h264()
+    w = h = 64
+
+    async def go():
+        provider = NativeRtpProvider(use_h264=use_h264)
+        app = build_app(pipeline=InvertPipeline(), provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        loop = asyncio.get_event_loop()
+        recv_q: asyncio.Queue = asyncio.Queue()
+
+        class _ViewerRecv(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                recv_q.put_nowait(data)
+
+        viewer_tr, _ = await loop.create_datagram_endpoint(
+            _ViewerRecv, local_addr=("127.0.0.1", 0)
+        )
+        viewer_port = viewer_tr.get_extra_info("sockname")[1]
+        try:
+            # publish: WHIP with a video ingest leg only
+            whip_offer = json.dumps(
+                {"native_rtp": True, "video": True, "width": w, "height": h}
+            )
+            r = await client.post(
+                "/whip", data=whip_offer,
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            ingest_port = json.loads(await r.text())["server_port"]
+            assert app["state"]["source_track"] is not None
+
+            # subscribe: WHEP, media flows OUT to the viewer's UDP port
+            whep_offer = json.dumps(
+                {
+                    "native_rtp": True,
+                    "video": False,
+                    "client_addr": ["127.0.0.1", viewer_port],
+                    "width": w,
+                    "height": h,
+                }
+            )
+            r = await client.post(
+                "/whep", data=whep_offer,
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+
+            pub_sink = H264Sink(w, h, use_h264=use_h264)
+            back_src = H264RingSource(w, h, use_h264=use_h264)
+            pub_tr, _ = await loop.create_datagram_endpoint(
+                asyncio.DatagramProtocol,
+                remote_addr=("127.0.0.1", ingest_port),
+            )
+            try:
+                val = 180
+                decoded = []
+                for i in range(60):
+                    f = VideoFrame.from_ndarray(np.full((h, w, 3), val, np.uint8))
+                    f.pts = i * 3000
+                    for pkt in pub_sink.consume(f):
+                        pub_tr.sendto(pkt)
+                    await asyncio.sleep(0.05)
+                    try:
+                        while True:
+                            back_src.feed_packet(recv_q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := back_src._ring.pop()) is not None:
+                        decoded.append(item[0])
+                    if decoded:
+                        break
+                assert decoded, "viewer got no frames over WHIP->WHEP native RTP"
+                mean = float(decoded[-1].astype(np.float32).mean())
+                assert abs(mean - (255 - val)) < 20, mean
+            finally:
+                pub_sink.close()
+                back_src.close()
+                pub_tr.close()
+        finally:
+            viewer_tr.close()
+            await client.close()
+
+    asyncio.run(go())
