@@ -547,6 +547,9 @@ async def on_shutdown(app):
     pcs = app["pcs"]
     await asyncio.gather(*[pc.close() for pc in pcs])
     pcs.clear()
+    relay = app["state"].get("source_relay") if "state" in app else None
+    if relay is not None:
+        relay.stop()
     mp = app.get("multipeer_pipeline")
     if mp is not None:
         mp.close()
