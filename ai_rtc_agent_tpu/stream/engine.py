@@ -592,6 +592,11 @@ class StreamEngine:
         """
         from ..aot.cache import EngineCache
 
+        if self.mesh is not None and any(n > 1 for n in self.mesh.shape.values()):
+            # serialized executables are per-topology; the tp/sp serving
+            # meshes keep the plain jit path (same policy as
+            # MultiPeerEngine.use_aot_cache)
+            return False
         if self.state is None:
             raise RuntimeError("call prepare() first (state defines the signature)")
         cache = EngineCache(cache_dir)
