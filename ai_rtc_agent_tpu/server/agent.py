@@ -525,8 +525,20 @@ async def on_startup(app):
             mesh = M.make_mesh(
                 tp=max(1, app.get("tp", 0)), sp=max(1, app.get("sp", 0))
             )
+        config = None
+        if app.get("fbs", 0) > 1:
+            from ..models import registry as _registry
+
+            config = _registry.default_stream_config(
+                app["model_id"],
+                frame_buffer_size=app["fbs"],
+                **({"use_controlnet": True} if app.get("controlnet") else {}),
+            )
         app["pipeline"] = StreamDiffusionPipeline(
-            app["model_id"], controlnet=app.get("controlnet"), mesh=mesh
+            app["model_id"],
+            config=config,
+            controlnet=app.get("controlnet"),
+            mesh=mesh,
         )
     app["pcs"] = set()
     app["stream_event_handler"] = StreamEventHandler()
@@ -565,6 +577,7 @@ def build_app(
     multipeer_pipeline=None,
     tp: int = 0,
     sp: int = 0,
+    fbs: int = 0,
 ) -> web.Application:
     app = web.Application(middlewares=[cors_middleware])
     app["udp_ports"] = udp_ports
@@ -575,6 +588,7 @@ def build_app(
     app["multipeer_pipeline"] = multipeer_pipeline  # injectable for tests
     app["tp"] = tp
     app["sp"] = sp
+    app["fbs"] = fbs
     app["provider"] = provider or get_provider()
 
     app.on_startup.append(on_startup)
@@ -634,6 +648,14 @@ def main(argv=None):
         "the sp axis; pair with ATTN_IMPL=ring or ulysses); 0 = off",
     )
     parser.add_argument(
+        "--fbs",
+        default=0,
+        type=int,
+        metavar="N",
+        help="frame_buffer_size: batch N consecutive frames per device "
+        "step (throughput up, +N frames latency); 0 = per-frame",
+    )
+    parser.add_argument(
         "--log-level",
         default="INFO",
         choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
@@ -662,6 +684,7 @@ def main(argv=None):
         multipeer=args.multipeer,
         tp=args.tp,
         sp=args.sp,
+        fbs=args.fbs,
     )
     web.run_app(app, host="0.0.0.0", port=args.port)
 
