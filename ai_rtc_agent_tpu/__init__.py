@@ -18,7 +18,7 @@ Package layout (mirrors SURVEY.md section 7's build order):
              and the pipeline facade (parity with reference lib/pipeline.py).
   aot/       AOT compile + serialized-executable cache (parity with the
              reference's TensorRT engine cache, lib/wrapper.py:732-746).
-  parallel/  device mesh, collectives, ring attention, tensor-parallel
+  parallel/  device mesh, ring attention, tensor-parallel
              sharding rules, multi-peer batching, sharded trainer.
   media/     frames, codecs (native libavcodec via ctypes, null fallback),
              RTP, host<->HBM ring.

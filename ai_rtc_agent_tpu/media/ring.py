@@ -14,8 +14,9 @@ per direction cheap and fully overlapped:
 * the stream step donates its state, so the latent ring buffer never leaves
   HBM (stream/engine.py).
 
-``DeviceFeeder`` wraps the pattern; the loopback/e2e tests measure that the
-device never waits for a frame that was pushed in time.
+The staging half of the pattern (async ``device_put`` before dispatch) is
+inlined at the single consumer, ``StreamEngine.submit`` — a wrapper class
+here would only re-state it.
 """
 
 from __future__ import annotations
@@ -105,27 +106,3 @@ class FrameRing:
             pass
 
 
-class DeviceFeeder:
-    """Double-buffered host->HBM staging: device_put the NEXT frame while the
-    CURRENT one computes (async dispatch overlap)."""
-
-    def __init__(self, device=None):
-        import jax
-
-        self._device = device or jax.devices()[0]
-        self._inflight = None
-        self._inflight_meta = None
-
-    def stage(self, frame: np.ndarray, meta=None):
-        """Start the host->HBM transfer (non-blocking)."""
-        import jax
-
-        self._inflight = jax.device_put(frame, self._device)
-        self._inflight_meta = meta
-
-    def take(self):
-        """-> (device_array, meta) of the staged frame (transfer may still be
-        in flight — jax dispatch orders it before any consumer op)."""
-        x, m = self._inflight, self._inflight_meta
-        self._inflight = None
-        return x, m

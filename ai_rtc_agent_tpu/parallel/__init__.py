@@ -1,1 +1,1 @@
-from . import mesh, collectives, ring_attention, sharding, multipeer, trainer  # noqa: F401
+from . import mesh, ring_attention, sharding, multipeer, trainer  # noqa: F401

@@ -7,7 +7,6 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ai_rtc_agent_tpu.parallel import collectives as CL
 from ai_rtc_agent_tpu.parallel import mesh as M
 from ai_rtc_agent_tpu.parallel import ring_attention as RA
 from ai_rtc_agent_tpu.parallel import sharding as SH
@@ -34,7 +33,7 @@ def test_collectives_in_shard_map(rng):
     x = jnp.arange(8.0)
 
     f = shard_map(
-        lambda v: CL.psum(v, "dp"),
+        lambda v: jax.lax.psum(v, axis_name="dp"),
         mesh=m,
         in_specs=P("dp"),
         out_specs=P("dp"),
@@ -42,8 +41,14 @@ def test_collectives_in_shard_map(rng):
     out = np.asarray(f(x))
     np.testing.assert_allclose(out, np.full(8, x.sum()))
 
+    def ring_shift(v):
+        n = jax.lax.axis_size("dp")
+        return jax.lax.ppermute(
+            v, axis_name="dp", perm=[(i, (i + 1) % n) for i in range(n)]
+        )
+
     g = shard_map(
-        lambda v: CL.ppermute_ring(v, "dp", 1),
+        ring_shift,
         mesh=m,
         in_specs=P("dp"),
         out_specs=P("dp"),
