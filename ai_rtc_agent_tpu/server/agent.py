@@ -526,12 +526,17 @@ async def on_startup(app):
                 tp=max(1, app.get("tp", 0)), sp=max(1, app.get("sp", 0))
             )
         config = None
+        overrides = {}
         if app.get("fbs", 0) > 1:
+            overrides["frame_buffer_size"] = app["fbs"]
+        if app.get("mode") and app["mode"] != "img2img":
+            overrides["mode"] = app["mode"]
+        if overrides:
             from ..models import registry as _registry
 
             config = _registry.default_stream_config(
                 app["model_id"],
-                frame_buffer_size=app["fbs"],
+                **overrides,
                 **({"use_controlnet": True} if app.get("controlnet") else {}),
             )
         app["pipeline"] = StreamDiffusionPipeline(
@@ -578,6 +583,7 @@ def build_app(
     tp: int = 0,
     sp: int = 0,
     fbs: int = 0,
+    mode: str = "img2img",
 ) -> web.Application:
     app = web.Application(middlewares=[cors_middleware])
     app["udp_ports"] = udp_ports
@@ -589,6 +595,7 @@ def build_app(
     app["tp"] = tp
     app["sp"] = sp
     app["fbs"] = fbs
+    app["mode"] = mode
     app["provider"] = provider or get_provider()
 
     app.on_startup.append(on_startup)
@@ -656,6 +663,14 @@ def main(argv=None):
         "step (throughput up, +N frames latency); 0 = per-frame",
     )
     parser.add_argument(
+        "--mode",
+        default="img2img",
+        choices=["img2img", "txt2img"],
+        help="txt2img ignores incoming pixels and generates from the "
+        "prompt each tick (reference txt2img dispatch, "
+        "lib/wrapper.py:236-260)",
+    )
+    parser.add_argument(
         "--log-level",
         default="INFO",
         choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
@@ -685,6 +700,7 @@ def main(argv=None):
         tp=args.tp,
         sp=args.sp,
         fbs=args.fbs,
+        mode=args.mode,
     )
     web.run_app(app, host="0.0.0.0", port=args.port)
 
