@@ -1,0 +1,8 @@
+#!/bin/sh
+# Launch the agent and the serverless worker side by side — the analog of
+# the reference's runpod/start.sh (two processes, worker polls the agent's
+# health endpoint and publishes connection info).
+python -m ai_rtc_agent_tpu.server.agent "$@" &
+AGENT_PID=$!
+python -m ai_rtc_agent_tpu.server.worker
+kill "$AGENT_PID" 2>/dev/null
