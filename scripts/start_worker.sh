@@ -2,7 +2,32 @@
 # Launch the agent and the serverless worker side by side — the analog of
 # the reference's runpod/start.sh (two processes, worker polls the agent's
 # health endpoint and publishes connection info).
+#
+# All args go to the agent; the worker is pointed at the same --port so a
+# non-default port keeps the health poll aligned.  The script's exit code
+# is the WORKER's (nonzero tells the orchestrator to recycle the pod), and
+# SIGTERM/SIGINT are forwarded to the agent so its graceful shutdown
+# (closing every peer connection) runs under `docker stop`.
+
+PORT=8888
+prev=""
+for arg in "$@"; do
+  if [ "$prev" = "--port" ]; then PORT="$arg"; fi
+  prev="$arg"
+done
+
 python -m ai_rtc_agent_tpu.server.agent "$@" &
 AGENT_PID=$!
-python -m ai_rtc_agent_tpu.server.worker
+
+forward() {
+  kill "$AGENT_PID" 2>/dev/null
+  wait "$AGENT_PID" 2>/dev/null
+  exit 143
+}
+trap forward TERM INT
+
+python -m ai_rtc_agent_tpu.server.worker --agent-port "$PORT"
+RC=$?
 kill "$AGENT_PID" 2>/dev/null
+wait "$AGENT_PID" 2>/dev/null
+exit "$RC"
