@@ -246,16 +246,23 @@ async def _close_sessions(app, pcs_key: str, session: str | None) -> bool:
 
 
 def _refresh_source_track(app):
-    """Point source_track at the most recent still-connected publisher's
-    track (or None) — keeps WHEP viewers off a closed publisher's track."""
+    """Point source_track AND source_relay at the most recent
+    still-connected publisher (or None) — keeps WHEP viewers off a closed
+    publisher's track, and stops/discards relays of dead sessions."""
     live = app["state"].get("whip_pcs", {})
     tracks = app["state"].get("whip_tracks", {})
+    relays = app["state"].get("whip_relays", {})
     for sid in reversed(list(tracks)):
         if sid in live:
             app["state"]["source_track"] = tracks[sid]
+            app["state"]["source_relay"] = relays.get(sid)
             return
         tracks.pop(sid, None)
+        dead = relays.pop(sid, None)
+        if dead is not None:
+            dead.stop()
     app["state"]["source_track"] = None
+    app["state"]["source_relay"] = None
 
 
 async def whep(request):
@@ -373,12 +380,16 @@ async def whip(request):
                 vt = VideoStreamTrack(track, _TimedPipeline(pipeline, stats))
                 app["state"].setdefault("whip_tracks", {})[session_id] = vt
                 app["state"]["source_track"] = vt  # latest publisher wins
-                # one relay per publisher: N WHEP viewers share the stream
-                # without concurrent recv() on one track (the reference's
-                # MediaRelay, agent.py:424-430)
+                # one relay per publisher SESSION: N WHEP viewers share the
+                # stream without concurrent recv() on one track (the
+                # reference's MediaRelay, agent.py:424-430); earlier
+                # publishers keep their relays and become active again if
+                # the newest disconnects (_refresh_source_track)
                 from .relay import TrackRelay
 
-                app["state"]["source_relay"] = TrackRelay(vt)
+                relay = TrackRelay(vt)
+                app["state"].setdefault("whip_relays", {})[session_id] = relay
+                app["state"]["source_relay"] = relay
 
             @track.on("ended")
             async def on_ended():
@@ -506,12 +517,36 @@ async def on_startup(app):
     if app["udp_ports"]:
         patch_loop_datagram(app["udp_ports"])
 
+    # config overrides shared by both serving modes (no silent flag drops)
+    overrides = {}
+    if app.get("fbs", 0) > 1:
+        overrides["frame_buffer_size"] = app["fbs"]
+    if app.get("mode") and app["mode"] != "img2img":
+        overrides["mode"] = app["mode"]
+
+    def _build_config():
+        if not overrides:
+            return None
+        from ..models import registry as _registry
+
+        return _registry.default_stream_config(
+            app["model_id"],
+            **overrides,
+            **({"use_controlnet": True} if app.get("controlnet") else {}),
+        )
+
     if app.get("multipeer", 0) and app.get("multipeer_pipeline") is None:
         from .multipeer_serving import MultiPeerPipeline
 
+        if app.get("fbs", 0) > 1:
+            raise ValueError(
+                "--fbs is not supported with --multipeer (peers are already "
+                "the batch dimension)"
+            )
         app["multipeer_pipeline"] = MultiPeerPipeline(
             app["model_id"],
             max_peers=app["multipeer"],
+            config=_build_config(),
             controlnet=app.get("controlnet"),
         )
         app["pipeline"] = None
@@ -525,23 +560,9 @@ async def on_startup(app):
             mesh = M.make_mesh(
                 tp=max(1, app.get("tp", 0)), sp=max(1, app.get("sp", 0))
             )
-        config = None
-        overrides = {}
-        if app.get("fbs", 0) > 1:
-            overrides["frame_buffer_size"] = app["fbs"]
-        if app.get("mode") and app["mode"] != "img2img":
-            overrides["mode"] = app["mode"]
-        if overrides:
-            from ..models import registry as _registry
-
-            config = _registry.default_stream_config(
-                app["model_id"],
-                **overrides,
-                **({"use_controlnet": True} if app.get("controlnet") else {}),
-            )
         app["pipeline"] = StreamDiffusionPipeline(
             app["model_id"],
-            config=config,
+            config=_build_config(),
             controlnet=app.get("controlnet"),
             mesh=mesh,
         )
@@ -549,8 +570,10 @@ async def on_startup(app):
     app["stream_event_handler"] = StreamEventHandler()
     app["state"] = {
         "source_track": None,
+        "source_relay": None,
         "whip_pcs": {},
         "whip_tracks": {},
+        "whip_relays": {},
         "whep_pcs": {},
     }
     app["stats"] = FrameStats()
@@ -564,9 +587,9 @@ async def on_shutdown(app):
     pcs = app["pcs"]
     await asyncio.gather(*[pc.close() for pc in pcs])
     pcs.clear()
-    relay = app["state"].get("source_relay") if "state" in app else None
-    if relay is not None:
-        relay.stop()
+    if "state" in app:
+        for relay in app["state"].get("whip_relays", {}).values():
+            relay.stop()
     mp = app.get("multipeer_pipeline")
     if mp is not None:
         mp.close()

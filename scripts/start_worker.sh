@@ -5,28 +5,35 @@
 #
 # All args go to the agent; the worker is pointed at the same --port so a
 # non-default port keeps the health poll aligned.  The script's exit code
-# is the WORKER's (nonzero tells the orchestrator to recycle the pod), and
-# SIGTERM/SIGINT are forwarded to the agent so its graceful shutdown
-# (closing every peer connection) runs under `docker stop`.
+# is the WORKER's (nonzero tells the orchestrator to recycle the pod).
+# Both children run in the background with a trap + interruptible `wait`,
+# so SIGTERM/SIGINT (e.g. `docker stop` with this as PID 1) reach the
+# agent's graceful shutdown path instead of being deferred by sh until the
+# foreground child exits.
 
 PORT=8888
 prev=""
 for arg in "$@"; do
-  if [ "$prev" = "--port" ]; then PORT="$arg"; fi
+  case "$arg" in
+    --port=*) PORT="${arg#--port=}" ;;
+    *) if [ "$prev" = "--port" ]; then PORT="$arg"; fi ;;
+  esac
   prev="$arg"
 done
 
 python -m ai_rtc_agent_tpu.server.agent "$@" &
 AGENT_PID=$!
+python -m ai_rtc_agent_tpu.server.worker --agent-port "$PORT" &
+WORKER_PID=$!
 
-forward() {
-  kill "$AGENT_PID" 2>/dev/null
-  wait "$AGENT_PID" 2>/dev/null
+shutdown() {
+  kill "$WORKER_PID" "$AGENT_PID" 2>/dev/null
+  wait "$WORKER_PID" "$AGENT_PID" 2>/dev/null
   exit 143
 }
-trap forward TERM INT
+trap shutdown TERM INT
 
-python -m ai_rtc_agent_tpu.server.worker --agent-port "$PORT"
+wait "$WORKER_PID"
 RC=$?
 kill "$AGENT_PID" 2>/dev/null
 wait "$AGENT_PID" 2>/dev/null
