@@ -349,3 +349,51 @@ def test_relay_slow_viewer_drops_not_blocks():
         relay.stop()
 
     run(go())
+
+
+def test_whip_publisher_failover(monkeypatch):
+    """Two publishers: viewers follow the newest; when it leaves, NEW
+    viewers land on the previous still-live publisher's relay."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    pipe = FakePipeline()
+
+    async def go():
+        app, client = await _client(pipe)
+        try:
+            locs = []
+            for _ in range(2):
+                r = await client.post(
+                    "/whip",
+                    data=make_loopback_offer(),
+                    headers={"Content-Type": "application/sdp"},
+                )
+                assert r.status == 201
+                locs.append(r.headers["Location"])
+            sids = [loc.rsplit("/", 1)[1] for loc in locs]
+            # active source is publisher B (latest wins)
+            assert app["state"]["source_relay"] is app["state"]["whip_relays"][sids[1]]
+
+            # B leaves -> A's relay becomes the source again
+            r = await client.delete(locs[1])
+            assert r.status == 200
+            assert app["state"]["source_track"] is app["state"]["whip_tracks"][sids[0]]
+            assert app["state"]["source_relay"] is app["state"]["whip_relays"][sids[0]]
+            assert sids[1] not in app["state"]["whip_relays"]
+
+            # a new viewer now gets frames from publisher A
+            r = await client.post(
+                "/whep",
+                data=make_loopback_offer(video=False, datachannel=False),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            viewer = next(pc for pc in app["pcs"] if pc.out_tracks).out_tracks[0]
+            pub_a = app["state"]["whip_pcs"][sids[0]]
+            frame = np.full((8, 8, 3), 77, np.uint8)
+            await pub_a.in_track.push(frame)
+            out = await viewer.recv()
+            np.testing.assert_array_equal(out, 255 - frame)
+        finally:
+            await client.close()
+
+    run(go())
