@@ -397,3 +397,42 @@ def test_whip_publisher_failover(monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_udp_port_pinning_patch():
+    """patch_loop_datagram: unbound datagram endpoints land on an
+    operator-pinned port (reference agent.py:32-69 — firewall/serverless
+    deployments); explicit ports and local_addr=None bypass the patch."""
+    from ai_rtc_agent_tpu.server.agent import patch_loop_datagram
+
+    async def go():
+        loop = asyncio.get_event_loop()
+        patch_loop_datagram(["39551", "39552"])
+
+        tr1, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=("127.0.0.1", 0)
+        )
+        port1 = tr1.get_extra_info("sockname")[1]
+        assert port1 in (39551, 39552)
+
+        tr2, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=("127.0.0.1", 0)
+        )
+        port2 = tr2.get_extra_info("sockname")[1]
+        assert port2 in (39551, 39552) and port2 != port1
+
+        # both pinned ports busy -> OSError, not an ephemeral fallback
+        with pytest.raises(OSError):
+            await loop.create_datagram_endpoint(
+                asyncio.DatagramProtocol, local_addr=("127.0.0.1", 0)
+            )
+
+        # explicit port bypasses the pin list
+        tr3, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=("127.0.0.1", 39600)
+        )
+        assert tr3.get_extra_info("sockname")[1] == 39600
+        for tr in (tr1, tr2, tr3):
+            tr.close()
+
+    run(go())
