@@ -124,9 +124,11 @@ def preprocess_clip(img01_nhwc, cfg: CLIPVisionConfig):
 def apply_clip_vision(p, img_nhwc, cfg: CLIPVisionConfig):
     """Preprocessed [N,S,S,3] -> dict(hidden [N,L,width], pooled [N,width])."""
     n = img_nhwc.shape[0]
+    from .layers import _kernel
+
     patches = jax.lax.conv_general_dilated(
         img_nhwc,
-        p["patch_embedding"]["kernel"].astype(img_nhwc.dtype),
+        _kernel(p["patch_embedding"], img_nhwc.dtype),
         window_strides=(cfg.patch_size, cfg.patch_size),
         padding="VALID",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),

@@ -61,8 +61,17 @@ def zeros_like_params(params):
 # primitives
 # --------------------------------------------------------------------------
 
+def _kernel(p, dtype):
+    """Dense or w8-quantized kernel (models/quant.py): the dequant multiply
+    fuses into the consuming matmul/conv, so int8 storage halves weight HBM
+    reads with bf16 MXU compute."""
+    if "kernel_q" in p:
+        return p["kernel_q"].astype(dtype) * p["scale"].astype(dtype)
+    return p["kernel"].astype(dtype)
+
+
 def linear(p, x):
-    w = p["kernel"].astype(x.dtype)
+    w = _kernel(p, x.dtype)
     y = x @ w
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
@@ -71,7 +80,7 @@ def linear(p, x):
 
 def conv2d(p, x, stride: int = 1, padding="SAME"):
     """NHWC conv, HWIO kernel."""
-    w = p["kernel"].astype(x.dtype)
+    w = _kernel(p, x.dtype)
     y = jax.lax.conv_general_dilated(
         x,
         w,

@@ -1,0 +1,84 @@
+"""Int8 weight-only quantization (w8a16) for the serving params.
+
+Small-batch diffusion serving on TPU is weight-bandwidth bound: at B=1-4
+the UNet re-reads every kernel from HBM each step while the MXU idles.
+Storing kernels as int8 + a per-output-channel scale halves that traffic
+(vs bf16); the dequant (one multiply) fuses into the consuming matmul/conv,
+so compute stays bf16 on the MXU.  The reference's analog is TensorRT's
+int8/fp8 engine modes — here it is a pure pytree transform + a dequant
+branch in the two primitive ops (models/layers.linear / conv2d).
+
+Enable with QUANT_WEIGHTS=w8 (utils/env) or registry.cast_params(...,
+quant="w8").  Per-channel symmetric max-abs scaling; tensors smaller than
+``min_size`` stay dense (norms, biases, embeddings keep full precision).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: leaves bigger than this (elements) are quantized; small tensors stay dense
+MIN_SIZE = 1 << 14
+
+
+def quantize_tensor(w, axis: int = -1):
+    """float kernel -> (int8 kernel, per-channel fp scale along ``axis``)."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=tuple(i for i in range(w.ndim) if i != axis % w.ndim),
+                  keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, 1.0, scale)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize(p, dtype):
+    """Inverse for a {kernel_q, scale} dict — used by the layer primitives."""
+    return p["kernel_q"].astype(dtype) * p["scale"].astype(dtype)
+
+
+def quantize_params(params, min_size: int = MIN_SIZE):
+    """Replace large float 'kernel' leaves with {kernel_q, scale} pairs.
+
+    Works on any model pytree in this repo (UNet/CLIP/TAESD/ControlNet):
+    the layer primitives check for 'kernel_q' before 'kernel'.  Returns a
+    NEW tree; biases/norms/embeddings pass through untouched.
+    """
+    n_quantized = 0
+
+    def walk(node):
+        nonlocal n_quantized
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if (
+                    k == "kernel"
+                    and hasattr(v, "ndim")
+                    and v.ndim >= 2
+                    and v.size >= min_size
+                    and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+                ):
+                    q, s = quantize_tensor(v, axis=-1)
+                    out["kernel_q"] = jnp.asarray(q)
+                    out["scale"] = jnp.asarray(s)
+                    n_quantized += 1
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    out = walk(params)
+    return out, n_quantized
+
+
+def quantized_bytes_saved(params) -> int:
+    """Rough HBM savings vs bf16 storage (for logs/PERF accounting)."""
+    saved = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if path and getattr(path[-1], "key", None) == "kernel_q":
+            saved += leaf.size  # bf16(2B) -> int8(1B): 1 byte per element
+    return saved

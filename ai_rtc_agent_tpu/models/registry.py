@@ -155,12 +155,25 @@ def _model_configs(fam: str):
 
 def cast_params(params, dtype: str):
     """Cast fp32 param leaves to the serving compute dtype (bf16 on TPU);
-    non-fp32 leaves (ints, embeddings tables already cast) pass through."""
-    if dtype != "bfloat16":
-        return params
-    return jax.tree.map(
-        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a, params
-    )
+    non-fp32 leaves (ints, embeddings tables already cast) pass through.
+
+    QUANT_WEIGHTS=w8 additionally stores large kernels as int8 + per-channel
+    scale (models/quant.py) — weight HBM reads halve vs bf16, dequant fuses
+    into the consuming matmul/conv.  (TP sharding rules key on 'kernel'
+    names, so quantized trees serve replicated — use one or the other.)
+    """
+    if dtype == "bfloat16":
+        params = jax.tree.map(
+            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+            params,
+        )
+    if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
+        from . import quant
+
+        min_size = int(os.getenv("QUANT_MIN_SIZE") or quant.MIN_SIZE)
+        params, n = quant.quantize_params(params, min_size=min_size)
+        logger.info("quantized %d kernels to int8 (w8a16)", n)
+    return params
 
 
 def resolve_snapshot_dir(model_id: str) -> str | None:
