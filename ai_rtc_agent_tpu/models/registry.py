@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..stream.engine import StreamConfig, StreamModels
+from ..stream.engine import StreamConfig, StreamModels, current_attn_impl
+from ..utils import env
 from . import clip as C
 from . import controlnet as CN
 from . import loader as LD
@@ -119,8 +120,15 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
             cfg_type="self",
         )
     base.update(overrides)
-    # fused Pallas epilogue on real TPUs (interpret-mode is slow on CPU)
-    base.setdefault("use_fused_epilogue", jax.default_backend() == "tpu")
+    # fused Pallas epilogue on real TPUs (interpret-mode is slow on CPU).
+    # FUSED_EPILOGUE=0 is the operator kill-switch: if the kernel miscompiles
+    # at a new geometry the agent can be relaunched on the composed-XLA path
+    # without a code change (the serving pipeline also auto-falls-back at
+    # build time — stream/pipeline._probe_pallas_fallback).
+    base.setdefault(
+        "use_fused_epilogue",
+        env.get_bool("FUSED_EPILOGUE", jax.default_backend() == "tpu"),
+    )
     # bf16 compute on real TPUs (fp32 elsewhere): the SERVING default must
     # match what the bench measures — fp32 serving on TPU would halve MXU
     # throughput and double HBM traffic
@@ -196,6 +204,7 @@ def load_model_bundle(
     seed: int = 0,
     controlnet: str | None = None,
     latent_scale: int = 8,
+    attn_impl: str | None = None,
 ) -> ModelBundle:
     """``controlnet``: ControlNet model id / local path (e.g.
     "lllyasviel/control_v11p_sd15_canny") — attaches a conditioned branch
@@ -290,9 +299,7 @@ def load_model_bundle(
     # plain XLA attention elsewhere (pallas interpret mode is slow on CPU).
     # ATTN_IMPL env overrides (xla | pallas | ring | ulysses — the sp modes
     # route through parallel/ring_attention under an sp_attention_mesh).
-    attn_impl = os.getenv("ATTN_IMPL") or (
-        "pallas" if jax.default_backend() == "tpu" else "xla"
-    )
+    attn_impl = attn_impl or current_attn_impl()
     if attn_impl not in ("xla", "pallas", "ring", "ulysses"):
         # fail fast: a typo would otherwise silently fall through to the
         # dense-XLA branch and serve with the flash path disabled

@@ -28,6 +28,7 @@ Stream-batch semantics (reference batch law lib/wrapper.py:159-163):
 from __future__ import annotations
 
 import math
+import os
 import threading
 from dataclasses import dataclass, field
 from functools import partial
@@ -80,6 +81,12 @@ class StreamConfig:
     # Supported for epsilon-prediction + cfg_type none/self/initialize in
     # denoising-batch mode; other combos fall back to composed XLA ops.
     use_fused_epilogue: bool = False
+    # Attention implementation baked into the traced graph ("" = resolve
+    # from ATTN_IMPL env / backend via current_attn_impl()).  Carried in the
+    # config so the AOT cache key, the bundle builder and the serving
+    # fallback agree WITHOUT mutating process-global env (a fallback on one
+    # pipeline must not silently disable Pallas for pipelines built later).
+    attn_impl: str = ""
 
     @property
     def n_stages(self) -> int:
@@ -394,6 +401,16 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
     return step
 
 
+def current_attn_impl() -> str:
+    """Resolved ATTN_IMPL default — THE single definition shared by the
+    bundle builder (models/registry), the serving build probe
+    (stream/pipeline) and the AOT cache key below, so they cannot disagree
+    (empty-string env counts as unset everywhere)."""
+    return os.getenv("ATTN_IMPL") or (
+        "pallas" if jax.default_backend() == "tpu" else "xla"
+    )
+
+
 def stream_engine_key(model_id: str, cfg: StreamConfig) -> str:
     """Canonical engine-cache key for a (model, stream config) pair — shared
     by the build CLI and the serving fast path (reference cache-key
@@ -412,6 +429,11 @@ def stream_engine_key(model_id: str, cfg: StreamConfig) -> str:
         # of the key or different graphs collide on one cache entry
         cnet=f"{int(cfg.use_controlnet)}{cfg.annotator if cfg.use_controlnet else ''}",
         fused=int(cfg.use_fused_epilogue),
+        # the attention impl is baked into the traced graph at bundle build
+        # time; without it in the key a Pallas-attention executable could be
+        # adopted by a serving process that just fell back to XLA (and vice
+        # versa a fallback engine would poison the Pallas cache slot)
+        attn=cfg.attn_impl or current_attn_impl(),
     )
 
 

