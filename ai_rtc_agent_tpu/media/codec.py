@@ -70,6 +70,14 @@ class H264Encoder:
             raise RuntimeError(f"encode failed: {n}")
         return bytes(self._buf[:n])
 
+    def force_keyframe(self):
+        """Encode the NEXT frame as an IDR (RTCP-PLI recovery: a viewer that
+        dropped an undecodable AU resynchronizes in one frame instead of
+        waiting out the gop — the aiortc/WebRTC PLI machinery the reference
+        inherits, SURVEY L3)."""
+        if self._enc and hasattr(self._lib, "tr_h264_force_keyframe"):
+            self._lib.tr_h264_force_keyframe(self._enc)
+
     def flush(self) -> bytes:
         key = ctypes.c_int(0)
         n = self._lib.tr_h264_encode(

@@ -27,7 +27,18 @@ def load() -> ctypes.CDLL | None:
     if _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH):
+    stale = False
+    if os.path.exists(_LIB_PATH):
+        # rebuild when any source is newer than the library (a stale .so
+        # missing newly added symbols would poison every native consumer)
+        so_mtime = os.path.getmtime(_LIB_PATH)
+        for f in os.listdir(_NATIVE_DIR):
+            if f.endswith((".cpp", ".h")) and os.path.getmtime(
+                os.path.join(_NATIVE_DIR, f)
+            ) > so_mtime:
+                stale = True
+                break
+    if not os.path.exists(_LIB_PATH) or stale:
         try:
             subprocess.run(
                 ["make", "-C", os.path.abspath(_NATIVE_DIR)],
@@ -36,8 +47,15 @@ def load() -> ctypes.CDLL | None:
                 timeout=120,
             )
         except (subprocess.SubprocessError, FileNotFoundError) as e:
-            logger.warning("native build failed (%s); using python fallbacks", e)
-            return None
+            if not os.path.exists(_LIB_PATH):
+                logger.warning("native build failed (%s); using python fallbacks", e)
+                return None
+            # stale-but-present: prefer the committed .so over nothing —
+            # git checkouts randomize mtimes, so "stale" is often noise on
+            # boxes without a toolchain (code-review r3)
+            logger.warning(
+                "native rebuild failed (%s); loading the existing library", e
+            )
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError as e:
@@ -90,6 +108,8 @@ def _declare(lib: ctypes.CDLL):
         c.c_void_p, u8p, c.c_int64, u8p, c.c_int64, c.POINTER(c.c_int),
     ]
     lib.tr_h264_encoder_destroy.argtypes = [c.c_void_p]
+    if hasattr(lib, "tr_h264_force_keyframe"):  # absent in pre-r3 builds
+        lib.tr_h264_force_keyframe.argtypes = [c.c_void_p]
     lib.tr_h264_decoder_create.restype = c.c_void_p
     lib.tr_h264_decode.restype = c.c_int64
     lib.tr_h264_decode.argtypes = [

@@ -138,3 +138,33 @@ class RtpDepacketizer:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# RTCP PLI (Picture Loss Indication, RFC 4585 section 6.3.1)
+# ---------------------------------------------------------------------------
+# The keyframe-recovery control message: a receiver that dropped an
+# undecodable AU asks the sender for an immediate IDR.  12 bytes:
+# V=2|P=0|FMT=1, PT=206 (PSFB), length=2, sender SSRC, media SSRC.
+
+PLI_PT = 206
+
+
+def make_pli(sender_ssrc: int = 0, media_ssrc: int = 0) -> bytes:
+    import struct
+
+    return struct.pack("!BBH", 0x81, PLI_PT, 2) + struct.pack(
+        "!II", sender_ssrc & 0xFFFFFFFF, media_ssrc & 0xFFFFFFFF
+    )
+
+
+def is_pli(data: bytes) -> bool:
+    """True for an RTCP PSFB/PLI packet (cheap disambiguation from RTP:
+    the payload-type byte 206 can never appear there because RTP with
+    marker bit would read 206 only for PT=78, and we only send PT 96-127)."""
+    return (
+        len(data) >= 12
+        and (data[0] >> 6) == 2  # RTCP version
+        and (data[0] & 0x1F) == 1  # FMT 1 = PLI
+        and data[1] == PLI_PT
+    )

@@ -155,6 +155,7 @@ async def offer(request):
         return web.Response(status=503, text="all peer slots in use")
     # everything between the claim and the connection handlers taking over
     # must release the slot on failure — a leaked slot is permanent 503s
+    pc = None
     try:
         stream_id = str(uuid.uuid4())
         offer_sdp = provider.session_description(
@@ -209,11 +210,13 @@ async def offer(request):
         await pc.setRemoteDescription(offer_sdp)
         answer = await pc.createAnswer()
         await pc.setLocalDescription(answer)
-    except KeyError as e:
+    except (KeyError, ValueError) as e:
         release_pipeline()
+        await _discard_pc(pc, pcs)
         return web.Response(status=400, text=f"invalid offer request: {e}")
     except Exception:
         release_pipeline()
+        await _discard_pc(pc, pcs)
         raise
 
     return web.Response(
@@ -222,6 +225,19 @@ async def offer(request):
             {"sdp": pc.localDescription.sdp, "type": pc.localDescription.type}
         ),
     )
+
+
+async def _discard_pc(pc, pcs: set):
+    """Close + drop a half-built peer connection on a failed /offer so its
+    transport (e.g. a bound native-rtp UDP socket) doesn't linger until
+    server shutdown (ADVICE r2)."""
+    if pc is None:
+        return
+    try:
+        await pc.close()
+    except Exception:
+        logger.exception("closing half-built pc failed")
+    pcs.discard(pc)
 
 
 async def _close_sessions(app, pcs_key: str, session: str | None) -> bool:
@@ -252,15 +268,18 @@ def _refresh_source_track(app):
     live = app["state"].get("whip_pcs", {})
     tracks = app["state"].get("whip_tracks", {})
     relays = app["state"].get("whip_relays", {})
-    for sid in reversed(list(tracks)):
-        if sid in live:
-            app["state"]["source_track"] = tracks[sid]
-            app["state"]["source_relay"] = relays.get(sid)
-            return
+    # sweep EVERY dead session first: an older publisher disconnecting while
+    # a newer one stays live must not leave entries behind forever
+    # (unbounded growth under publisher churn — ADVICE r2)
+    for sid in [s for s in tracks if s not in live]:
         tracks.pop(sid, None)
         dead = relays.pop(sid, None)
         if dead is not None:
             dead.stop()
+    for sid in reversed(list(tracks)):
+        app["state"]["source_track"] = tracks[sid]
+        app["state"]["source_relay"] = relays.get(sid)
+        return
     app["state"]["source_track"] = None
     app["state"]["source_relay"] = None
 
@@ -291,6 +310,12 @@ async def whep(request):
     relay = app["state"].get("source_relay")
     viewer_track = relay.subscribe() if relay is not None else source_track
 
+    async def _fail_cleanup():
+        await _discard_pc(pc, pcs)
+        app["state"].get("whep_pcs", {}).pop(session_id, None)
+        if relay is not None:
+            viewer_track.stop()
+
     @pc.on("iceconnectionstatechange")
     async def on_iceconnectionstatechange():
         logger.info("ICE connection state is %s", pc.iceConnectionState)
@@ -308,15 +333,22 @@ async def whep(request):
             if relay is not None:
                 viewer_track.stop()
 
-    sender = pc.addTrack(viewer_track)
-    provider.force_codec(pc, sender, "video/H264")
+    try:
+        sender = pc.addTrack(viewer_track)
+        provider.force_codec(pc, sender, "video/H264")
 
-    await pc.setRemoteDescription(offer_sdp)
-    # OBS WHIP: gather ALL ICE candidates before answering (reference
-    # agent.py:256-263 — OBS does not trickle)
-    await pc._RTCPeerConnection__gather()
-    answer = await pc.createAnswer()
-    await pc.setLocalDescription(answer)
+        await pc.setRemoteDescription(offer_sdp)
+        # OBS WHIP: gather ALL ICE candidates before answering (reference
+        # agent.py:256-263 — OBS does not trickle)
+        await pc._RTCPeerConnection__gather()
+        answer = await pc.createAnswer()
+        await pc.setLocalDescription(answer)
+    except ValueError as e:
+        await _fail_cleanup()
+        return web.Response(status=400, text=f"invalid offer: {e}")
+    except Exception:
+        await _fail_cleanup()
+        raise
 
     return web.Response(
         status=201,
@@ -345,6 +377,15 @@ async def whip(request):
     pipeline, release_pipeline = await _claim_pipeline(app)
     if pipeline is None:
         return web.Response(status=503, text="all peer slots in use")
+
+    pc = None
+    session_id = None
+
+    def _cleanup_failed():
+        release_pipeline()
+        if session_id is not None:
+            app["state"].get("whip_pcs", {}).pop(session_id, None)
+            _refresh_source_track(app)
 
     try:
         offer_sdp = provider.session_description(
@@ -409,8 +450,15 @@ async def whip(request):
         await pc._RTCPeerConnection__gather()
         answer = await pc.createAnswer()
         await pc.setLocalDescription(answer)
+    except ValueError as e:
+        # bad client SDP (e.g. no video m= section) is a 400, and the
+        # half-built pc + session entries must not leak (code-review r3)
+        await _discard_pc(pc, pcs)
+        _cleanup_failed()
+        return web.Response(status=400, text=f"invalid offer: {e}")
     except Exception:
-        release_pipeline()
+        await _discard_pc(pc, pcs)
+        _cleanup_failed()
         raise
 
     return web.Response(
@@ -523,6 +571,20 @@ async def on_startup(app):
         overrides["frame_buffer_size"] = app["fbs"]
     if app.get("mode") and app["mode"] != "img2img":
         overrides["mode"] = app["mode"]
+    if app.get("sp", 0) > 1:
+        # --sp allocates an sp>1 mesh, but the token axis only actually
+        # shards when the attention impl is ring/ulysses — any other impl
+        # would make the flag a silent no-op computing single-chip on an
+        # N-chip mesh (ADVICE r2).  Default to ring and say so.
+        from ..stream.engine import current_attn_impl
+
+        if current_attn_impl() not in ("ring", "ulysses"):
+            overrides["attn_impl"] = "ring"
+            logger.warning(
+                "--sp %d: attention impl defaulted to 'ring' so the "
+                "sequence axis shards over the sp mesh (set ATTN_IMPL="
+                "ring|ulysses to choose explicitly)", app["sp"],
+            )
 
     def _build_config():
         if not overrides:

@@ -34,6 +34,7 @@ import numpy as np
 from ..models import registry
 from ..parallel.multipeer import CapacityError, MultiPeerEngine
 from ..stream.pipeline import DEFAULT_PROMPT, coerce_frame, maybe_load_safety_checker
+from ..utils import env
 
 logger = logging.getLogger(__name__)
 
@@ -58,7 +59,11 @@ class PeerPipeline:
         out = handle.result(timeout=self._owner.fetch_timeout)
         if self._owner.safety_checker is not None:
             out = self._owner.safety_checker(out)
-        if src_frame is not None and hasattr(src_frame, "pts"):
+        # same output-type contract as the single-peer pipeline fetch
+        # (stream/pipeline.py): HW_ENCODE serving hands the track layer bare
+        # ndarrays in BOTH modes (ADVICE r2 — identical config must not
+        # yield different frame types across serving modes)
+        if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
             from ..media.frames import wrap_processed
 
             return wrap_processed(out, src_frame)

@@ -401,6 +401,15 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
     return step
 
 
+def _has_quantized_kernels(tree) -> bool:
+    """True when any {kernel_q, scale} pair (models/quant.py) is present."""
+    if isinstance(tree, dict):
+        return any(
+            k == "kernel_q" or _has_quantized_kernels(v) for k, v in tree.items()
+        )
+    return False
+
+
 def current_attn_impl() -> str:
     """Resolved ATTN_IMPL default — THE single definition shared by the
     bundle builder (models/registry), the serving build probe
@@ -487,6 +496,15 @@ class StreamEngine:
         if mesh is not None and mesh.shape.get("tp", 1) > 1:
             from ..parallel import sharding as SH
 
+            if _has_quantized_kernels(params):
+                # sharding rules key on '.../kernel' leaf names; quantized
+                # {kernel_q, scale} pairs would serve fully REPLICATED —
+                # an N-chip mesh silently computing single-chip (ADVICE r2)
+                raise ValueError(
+                    "QUANT_WEIGHTS int8 kernels are incompatible with "
+                    "tensor-parallel serving (tp>1): quantized leaves have "
+                    "no sharding rules and would replicate. Disable one."
+                )
             params = jax.device_put(params, SH.param_shardings(mesh, params))
         self.params = params
         step = make_step_fn(models, cfg)

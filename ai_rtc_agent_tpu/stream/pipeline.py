@@ -67,13 +67,15 @@ class StreamDiffusionPipeline:
                 "StreamConfig.use_controlnet=True requires a controlnet model "
                 "id (pass controlnet=... to StreamDiffusionPipeline)"
             )
-        def build(cfg_):
-            bundle = registry.load_model_bundle(
-                model_id, lora_dict=lora_dict, controlnet=controlnet,
-                latent_scale=cfg_.latent_scale,
-                attn_impl=cfg_.attn_impl or None,
-            )
-            bundle.params = registry.cast_params(bundle.params, cfg_.dtype)
+        def build(cfg_, bundle=None):
+            if bundle is None:
+                bundle = registry.load_model_bundle(
+                    model_id, lora_dict=lora_dict, controlnet=controlnet,
+                    latent_scale=cfg_.latent_scale,
+                    attn_impl=cfg_.attn_impl or None,
+                )
+                bundle.params = registry.cast_params(bundle.params, cfg_.dtype)
+            self._bundle = bundle
             eng = StreamEngine(
                 models=bundle.stream_models,
                 params=bundle.params,
@@ -142,11 +144,14 @@ class StreamDiffusionPipeline:
                 cfg.use_fused_epilogue, attn,
             )
         if cfg.use_fused_epilogue:
-            # stage 1: drop only the fused epilogue (flash attention kept)
+            # stage 1: drop only the fused epilogue.  The attention impl is
+            # unchanged, so the already-loaded bundle (weights read + LoRA
+            # fuse + cast — minutes of IO at SD scale) is reused verbatim.
             safe_cfg = replace(cfg, use_fused_epilogue=False)
-            self.engine = None  # release the failed engine's device arrays
+            bundle = self._bundle
+            self.engine = None  # release the failed engine
             try:
-                self.engine = build(safe_cfg)
+                self.engine = build(safe_cfg, bundle=bundle)
                 self.engine(probe)
                 return safe_cfg
             except Exception:
@@ -161,6 +166,7 @@ class StreamDiffusionPipeline:
         # process keep their own attention choice.
         safe_cfg = replace(cfg, use_fused_epilogue=False, attn_impl="xla")
         self.engine = None
+        self._bundle = None  # xla closures need a fresh bundle; free the old
         self.engine = build(safe_cfg)
         self.engine(probe)  # a failure here is structural: let it raise
         return safe_cfg

@@ -180,6 +180,7 @@ struct Encoder {
     void *sws = nullptr;  // rgb24 -> yuv420p
     int w, h;
     int64_t frame_index = 0;
+    int force_key = 0;  // next frame encodes as IDR (PLI recovery)
 };
 
 struct Decoder {
@@ -261,6 +262,13 @@ int64_t tr_h264_encode(Encoder *e, const uint8_t *rgb, int64_t pts,
         L->sws_scale(e->sws, src, src_stride, 0, e->h, e->frame->data,
                      e->frame->linesize);
         e->frame->pts = pts >= 0 ? pts : e->frame_index;
+        // PLI recovery: AV_PICTURE_TYPE_I (1) forces the encoder to emit an
+        // IDR now instead of waiting out the gop (media/plane.py feed_au
+        // drops corrupt AUs until the next keyframe — without this a loss
+        // burst freezes the viewer for up to gop/fps seconds)
+        e->frame->pict_type = e->force_key ? 1 : 0;  // 1 = I, 0 = NONE
+        e->frame->key_frame = e->force_key ? 1 : 0;
+        e->force_key = 0;
         e->frame_index++;
         ret = L->avcodec_send_frame(e->ctx, e->frame);
     } else {
@@ -284,6 +292,9 @@ int64_t tr_h264_encode(Encoder *e, const uint8_t *rgb, int64_t pts,
     }
     return written;
 }
+
+// Request that the NEXT encoded frame be an IDR (RTCP-PLI analog).
+void tr_h264_force_keyframe(Encoder *e) { e->force_key = 1; }
 
 void tr_h264_encoder_destroy(Encoder *e) {
     if (!e) return;

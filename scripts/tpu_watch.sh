@@ -15,6 +15,9 @@
 cd /root/repo || exit 1
 LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch_r3.log}
 STOP=/tmp/tpu_watch_stop
+echo $$ > /tmp/tpu_watch.pid  # stop with: kill -TERM $(cat /tmp/tpu_watch.pid)
+# or touch $STOP for a clean between-items exit (never pkill -f: the pattern
+# matches unrelated shells quoting this path)
 
 note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
 

@@ -306,3 +306,27 @@ def test_multipeer_with_controlnet(rng):
         assert out.shape == frame.shape and out.dtype == np.uint8
     finally:
         mp.close()
+
+
+def test_fetch_output_type_matches_single_peer_under_hw_encode(monkeypatch, rng):
+    """HW_ENCODE serving must hand the track layer bare ndarrays in BOTH
+    serving modes (ADVICE r2: multipeer used to wrap VideoFrames while the
+    single-peer pipeline returned arrays under identical config)."""
+    from ai_rtc_agent_tpu.media.frames import VideoFrame
+    from ai_rtc_agent_tpu.server.multipeer_serving import MultiPeerPipeline
+
+    monkeypatch.setenv("HW_ENCODE", "true")
+    mp = MultiPeerPipeline("tiny-test", max_peers=1)
+    try:
+        peer = mp.claim("style")
+        arr = rng.integers(0, 256, (mp.height, mp.width, 3), dtype=np.uint8)
+        src = VideoFrame.from_ndarray(arr)
+        src.pts = 3000
+        out = peer.fetch(peer.submit(src), src_frame=src)
+        assert isinstance(out, np.ndarray)  # no VideoFrame wrap in hw path
+
+        monkeypatch.delenv("HW_ENCODE")
+        out2 = peer.fetch(peer.submit(src), src_frame=src)
+        assert hasattr(out2, "pts")  # sw path: metadata-carrying frame
+    finally:
+        mp.close()

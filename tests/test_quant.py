@@ -87,3 +87,24 @@ def test_quantized_stream_end_to_end(rng, monkeypatch):
     assert oq.shape == od.shape and oq.dtype == np.uint8
     # int8 weight noise moves pixels a little, not wholesale
     assert np.abs(od.astype(int) - oq.astype(int)).mean() < 24
+
+
+def test_quantized_params_refuse_tp_mesh(monkeypatch):
+    """QUANT_WEIGHTS + --tp would silently serve REPLICATED (sharding rules
+    key on 'kernel' names, not 'kernel_q') — must fail loudly (ADVICE r2)."""
+    import pytest
+
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.parallel import mesh as M
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    monkeypatch.setenv("QUANT_WEIGHTS", "w8")
+    monkeypatch.setenv("QUANT_MIN_SIZE", "16")  # tiny kernels quantize too
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test")
+    params = registry.cast_params(bundle.params, cfg.dtype)
+    with pytest.raises(ValueError, match="tensor-parallel"):
+        StreamEngine(
+            bundle.stream_models, params, cfg, bundle.encode_prompt,
+            mesh=M.make_mesh(tp=2),
+        )

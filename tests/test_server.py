@@ -436,3 +436,83 @@ def test_udp_port_pinning_patch():
             tr.close()
 
     run(go())
+
+
+def test_whip_publisher_churn_sweeps_old_dead_sessions(monkeypatch):
+    """An OLDER publisher leaving while a newer one stays live must have its
+    track/relay swept immediately (ADVICE r2: the pre-fix code stopped at
+    the first live session, leaking entries forever under churn)."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    pipe = FakePipeline()
+
+    async def go():
+        app, client = await _client(pipe)
+        try:
+            locs = []
+            for _ in range(2):
+                r = await client.post(
+                    "/whip",
+                    data=make_loopback_offer(),
+                    headers={"Content-Type": "application/sdp"},
+                )
+                assert r.status == 201
+                locs.append(r.headers["Location"])
+            sids = [loc.rsplit("/", 1)[1] for loc in locs]
+
+            # A (older) leaves; B stays live and stays the source
+            r = await client.delete(locs[0])
+            assert r.status == 200
+            assert sids[0] not in app["state"]["whip_tracks"]
+            assert sids[0] not in app["state"]["whip_relays"]
+            assert app["state"]["source_relay"] is app["state"]["whip_relays"][sids[1]]
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_offer_failure_closes_half_built_pc(monkeypatch):
+    """A failure after the pc exists (e.g. SDP answer generation) must close
+    and discard it — with native-rtp providers a bound UDP socket would
+    otherwise linger until shutdown (ADVICE r2)."""
+    from ai_rtc_agent_tpu.server.signaling import LoopbackPeerConnection
+
+    async def boom(self):
+        raise RuntimeError("synthetic createAnswer failure")
+
+    monkeypatch.setattr(LoopbackPeerConnection, "createAnswer", boom)
+
+    async def go():
+        app, client = await _client(FakePipeline())
+        try:
+            r = await client.post(
+                "/offer",
+                json={
+                    "room_id": "r1",
+                    "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+                },
+            )
+            assert r.status == 500
+            assert app["pcs"] == set()  # nothing half-built left behind
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_sp_flag_defaults_attention_to_ring(monkeypatch):
+    """--sp N with a non-sp attention impl must not be a silent no-op
+    (ADVICE r2 medium): startup defaults the impl to ring so the sequence
+    axis actually shards over the allocated mesh."""
+    monkeypatch.delenv("ATTN_IMPL", raising=False)
+
+    async def go():
+        app = build_app(model_id="tiny-test", provider=LoopbackProvider(), sp=2)
+        client = TestClient(TestServer(app))
+        await client.start_server()  # runs on_startup: builds the pipeline
+        try:
+            assert app["pipeline"].config.attn_impl == "ring"
+        finally:
+            await client.close()
+
+    run(go())
