@@ -131,6 +131,10 @@ class MultiPeerPipeline:
                 logger.info("multipeer serving from AOT engine cache")
         except Exception as e:  # cache trouble must never block serving
             logger.warning("multipeer AOT adoption failed (%s); using jit", e)
+        if env.get_bool("MULTIPEER_PREWARM_BUCKETS", False):
+            # compile the active-count bucket variants up front so occupancy
+            # transitions never stall live peers on a lazy compile
+            self.engine.prewarm_buckets()
 
         self._lock = threading.Lock()  # guards engine state + queues
         self._has_work = threading.Condition(self._lock)

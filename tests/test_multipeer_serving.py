@@ -330,3 +330,19 @@ def test_fetch_output_type_matches_single_peer_under_hw_encode(monkeypatch, rng)
         assert hasattr(out2, "pts")  # sw path: metadata-carrying frame
     finally:
         mp.close()
+
+
+def test_coordinator_below_capacity_uses_bucket_path(rng):
+    """1 claimed slot of 3: the coordinator's all-peers tick routes through
+    the active-count bucket step and still resolves the peer's future."""
+    from ai_rtc_agent_tpu.server.multipeer_serving import MultiPeerPipeline
+
+    mp = MultiPeerPipeline("tiny-test", max_peers=3)
+    try:
+        peer = mp.claim("solo style")
+        frame = rng.integers(0, 256, (mp.height, mp.width, 3), dtype=np.uint8)
+        out = peer(frame)
+        assert out.shape == frame.shape and out.dtype == np.uint8
+        assert 1 in mp.engine._bucket_steps  # the k=1 variant actually ran
+    finally:
+        mp.close()
