@@ -205,6 +205,7 @@ def load_model_bundle(
     controlnet: str | None = None,
     latent_scale: int = 8,
     attn_impl: str | None = None,
+    annotator: str | None = None,
 ) -> ModelBundle:
     """``controlnet``: ControlNet model id / local path (e.g.
     "lllyasviel/control_v11p_sd15_canny") — attaches a conditioned branch
@@ -240,6 +241,26 @@ def load_model_bundle(
         params["controlnet"] = CN.init_controlnet(
             jax.random.fold_in(ku, 7), unet_cfg, num_down=cnet_num_down
         )
+    if controlnet is not None and annotator == "hed":
+        # the reference's sole conditioning processor (lib/wrapper.py:617-643)
+        # as an in-graph conv net; weights from a local ControlNetHED.pth
+        # when present, random otherwise (same degrade policy as above)
+        from . import hed as HED
+
+        stages = HED.TINY_STAGES if fam in ("tiny", "tinyxl") else HED.FULL_STAGES
+        params["hed"] = HED.init_hed(jax.random.fold_in(ku, 11), stages=stages)
+        ckpt = HED.find_hed_checkpoint()
+        if ckpt and stages is HED.FULL_STAGES:
+            try:
+                params["hed"], n_hed = HED.load_hed_from_torch(params["hed"], ckpt)
+                logger.info("loaded %d HED tensors from %s", n_hed, ckpt)
+            except Exception as e:
+                logger.warning("HED checkpoint load failed (%s); random init", e)
+        elif stages is HED.FULL_STAGES:
+            logger.warning(
+                "no local HED checkpoint (lllyasviel/Annotators) — random "
+                "edge detector; download on a connected host"
+            )
 
     snap = resolve_snapshot_dir(model_id)
     loaded = False

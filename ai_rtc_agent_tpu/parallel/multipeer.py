@@ -116,6 +116,7 @@ class MultiPeerEngine:
             "MULTIPEER_BUCKETS", True
         )
         self._aot_adopted = False
+        self._prewarmed = False
 
     def _fresh_state(self, prompt: str, seed: int):
         with self._heavy_lock:
@@ -273,12 +274,16 @@ class MultiPeerEngine:
     def _bucket_for(self, n_active: int):
         """Smallest bucket covering ``n_active``, or None for the full step.
 
-        Buckets are bypassed once an AOT executable is adopted: the
+        Once an AOT executable is adopted, buckets only run if they were
+        PREWARMED (prewarm_buckets, MULTIPEER_PREWARM_BUCKETS=1): the
         serialized full-batch step is the cold-start guarantee, and a lazy
-        bucket jit-compile at serve time would stall it (code-review r3).
-        MULTIPEER_PREWARM_BUCKETS=1 compiles the variants up front instead.
+        bucket compile at serve time would stall it — but prewarmed
+        variants keep the idle-slot FLOPs saving on the AOT path too
+        (code-review r3).
         """
-        if not self._use_buckets or n_active == 0 or self._aot_adopted:
+        if not self._use_buckets or n_active == 0:
+            return None
+        if self._aot_adopted and not self._prewarmed:
             return None
         for b in self._bucket_sizes:
             if b >= n_active:
@@ -306,17 +311,38 @@ class MultiPeerEngine:
             step = jax.jit(bucket, donate_argnums=(1,))
             self._bucket_steps[k] = step
             logger.info(
-                "compiled multipeer bucket step for %d/%d active slots",
+                "multipeer bucket step for %d/%d active slots registered "
+                "(compiles on first use unless prewarmed)",
                 k, self.max_peers,
             )
         return step
 
     def prewarm_buckets(self):
-        """Compile every bucket variant now (MULTIPEER_PREWARM_BUCKETS=1):
-        trades a longer cold start for zero lazy-compile stalls when
-        occupancy first reaches each bucket size."""
-        for k in self._bucket_sizes if self._use_buckets else []:
-            self._bucket_step(k)
+        """ACTUALLY compile every bucket variant now (jax.jit alone is lazy
+        — code-review r3): lower against the live state/param specs and swap
+        the compiled executables in.  Trades a longer cold start for zero
+        lazy-compile stalls when occupancy first reaches each bucket size;
+        also re-enables buckets on the AOT-adopted path."""
+        if not self._use_buckets:
+            return
+        if self.states is None:
+            raise RuntimeError("call start() first (states define the specs)")
+        spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        params_s = jax.tree.map(spec, self.params)
+        states_s = jax.tree.map(spec, self.states)
+        for k in self._bucket_sizes:
+            frames_s = jax.ShapeDtypeStruct(
+                (k, self.cfg.height, self.cfg.width, 3), jnp.uint8
+            )
+            idx_s = jax.ShapeDtypeStruct((k,), jnp.int32)
+            compiled = (
+                self._bucket_step(k)
+                .lower(params_s, states_s, frames_s, idx_s)
+                .compile()
+            )
+            self._bucket_steps[k] = compiled
+            logger.info("prewarmed bucket step %d/%d", k, self.max_peers)
+        self._prewarmed = True
 
     # -- hot path -----------------------------------------------------------
 

@@ -571,6 +571,10 @@ async def on_startup(app):
         overrides["frame_buffer_size"] = app["fbs"]
     if app.get("mode") and app["mode"] != "img2img":
         overrides["mode"] = app["mode"]
+    if app.get("annotator"):
+        if not app.get("controlnet"):
+            raise ValueError("--annotator requires --controlnet")
+        overrides["annotator"] = app["annotator"]
     if app.get("sp", 0) > 1:
         # --sp allocates an sp>1 mesh, but the token axis only actually
         # shards when the attention impl is ring/ulysses — any other impl
@@ -663,6 +667,7 @@ def build_app(
     pipeline=None,
     provider=None,
     controlnet: str | None = None,
+    annotator: str | None = None,
     multipeer: int = 0,
     multipeer_pipeline=None,
     tp: int = 0,
@@ -674,6 +679,7 @@ def build_app(
     app["udp_ports"] = udp_ports
     app["model_id"] = model_id
     app["controlnet"] = controlnet
+    app["annotator"] = annotator
     app["pipeline"] = pipeline  # injectable for tests; built on startup if None
     app["multipeer"] = multipeer
     app["multipeer_pipeline"] = multipeer_pipeline  # injectable for tests
@@ -714,6 +720,13 @@ def main(argv=None):
         "--controlnet",
         default=None,
         help="optional ControlNet model id (enables canny-conditioned stream)",
+    )
+    parser.add_argument(
+        "--annotator",
+        default=None,
+        choices=["canny", "hed", "identity"],
+        help="ControlNet conditioning processor (default canny; hed = the "
+        "reference's detector, in-graph, weights from lllyasviel/Annotators)",
     )
     parser.add_argument(
         "--multipeer",
@@ -781,6 +794,7 @@ def main(argv=None):
         model_id=args.model_id,
         udp_ports=args.udp_ports.split(",") if args.udp_ports else None,
         controlnet=args.controlnet,
+        annotator=args.annotator,
         multipeer=args.multipeer,
         tp=args.tp,
         sp=args.sp,

@@ -106,7 +106,8 @@ class MultiPeerPipeline:
             model_id, **({"use_controlnet": True} if controlnet else {})
         )
         bundle = registry.load_model_bundle(
-            model_id, controlnet=controlnet, latent_scale=cfg.latent_scale
+            model_id, controlnet=controlnet, latent_scale=cfg.latent_scale,
+            annotator=cfg.annotator if cfg.use_controlnet else None,
         )
         bundle.params = registry.cast_params(bundle.params, cfg.dtype)
         self.engine = MultiPeerEngine(

@@ -73,7 +73,7 @@ class StreamConfig:
     # the annotator runs IN-GRAPH on the incoming frame; conditioning images
     # ride a ring buffer in state aligned with the latent ring.
     use_controlnet: bool = False
-    annotator: str = "canny"  # canny | identity
+    annotator: str = "canny"  # canny | hed | identity
     # Fuse the whole post-UNet scheduler chain (R-CFG combine -> LCM blend ->
     # ring renoise -> stock update) into ONE Pallas kernel: a single HBM
     # read/write of the latent slabs instead of 6+ elementwise passes
@@ -268,7 +268,7 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
         new_cnet_ring = None
         if cfg.use_controlnet:
             src = I.preprocess_uint8(frame_u8, dtype=dt)
-            cond_new = _annotate(src, cfg)  # [fbs,H,W,3]
+            cond_new = _annotate(src, cfg, params)  # [fbs,H,W,3]
             # state["cnet_cond"] is [B-fbs,H,W,3] (possibly empty), aligned
             # with x_buf; rotation mirrors the latent ring exactly
             cond_full = jnp.concatenate(
@@ -446,17 +446,30 @@ def stream_engine_key(model_id: str, cfg: StreamConfig) -> str:
     )
 
 
-def _annotate(img01_nhwc, cfg: StreamConfig):
-    """In-graph conditioning annotator (replaces the reference's external
-    CUDA HED detector, lib/wrapper.py:39-40, with the canny conditioning
-    BASELINE.json tracks)."""
+def _annotate(img01_nhwc, cfg: StreamConfig, params=None):
+    """In-graph conditioning annotator.
+
+    canny: the soft-Canny conditioning BASELINE.json tracks.  hed: the
+    reference's sole supported processor (lib/wrapper.py:39-40, 617-643),
+    as an in-graph conv net whose weights stream from the public
+    ControlNetHED checkpoint (models/hed.py) — fused into the step instead
+    of the reference's separate CUDA detector pass."""
     if cfg.annotator == "canny":
         from ..models.controlnet import canny_soft
 
         return canny_soft(img01_nhwc)
+    if cfg.annotator == "hed":
+        if params is None or "hed" not in params:
+            raise ValueError(
+                "annotator='hed' needs HED params in the bundle — load with "
+                "registry.load_model_bundle(..., annotator='hed')"
+            )
+        from ..models.hed import apply_hed
+
+        return apply_hed(params["hed"], img01_nhwc)
     if cfg.annotator == "identity":
         return img01_nhwc
-    raise ValueError(f"unknown annotator {cfg.annotator!r} (canny|identity)")
+    raise ValueError(f"unknown annotator {cfg.annotator!r} (canny|hed|identity)")
 
 
 class StreamEngine:

@@ -73,6 +73,7 @@ class StreamDiffusionPipeline:
                     model_id, lora_dict=lora_dict, controlnet=controlnet,
                     latent_scale=cfg_.latent_scale,
                     attn_impl=cfg_.attn_impl or None,
+                    annotator=cfg_.annotator if cfg_.use_controlnet else None,
                 )
                 bundle.params = registry.cast_params(bundle.params, cfg_.dtype)
             self._bundle = bundle

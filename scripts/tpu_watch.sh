@@ -95,6 +95,8 @@ while true; do
   # 5. AOT cache on hardware: build+serve, then fresh-process reload
   run_item "aot_build" 3600 python -u scripts/aot_tpu_check.py --build
   run_item "aot_reload" 1800 python -u scripts/aot_tpu_check.py
+  # golden fingerprint (only produces a result on weights-bearing hosts)
+  run_item "golden" 2400 python -u scripts/golden_capture.py
   # 6. batching + quantization + the rest of the tracked configs
   run_item "turbo512_fbs2" 2400 python -u bench.py --config turbo512 --frames 60 --fbs 2
   run_item "turbo512_fbs4" 2400 python -u bench.py --config turbo512 --frames 120 --fbs 4

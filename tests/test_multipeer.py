@@ -201,3 +201,20 @@ def test_bucket_step_flops_scale_with_occupancy(bundle):
     # gather/scatter overhead is tiny; 1-of-4 occupancy must cost well
     # under half the full batch
     assert f1 < 0.5 * ffull, (f1, ffull)
+
+
+def test_prewarm_buckets_compiles_and_survives_aot(bundle, tmp_path):
+    """prewarm_buckets must produce READY executables (jax.jit alone is
+    lazy) and re-enable buckets on the AOT-adopted path."""
+    mp = _mp(bundle, max_peers=4)
+    assert mp.use_aot_cache("tiny-test", cache_dir=str(tmp_path), build_on_miss=True)
+    mp.connect("solo")
+    assert mp._bucket_for(1) is None  # adopted, not prewarmed -> full batch
+    mp.prewarm_buckets()
+    assert mp._prewarmed
+    assert mp._bucket_for(1) == 1  # prewarmed buckets win again
+    # the prewarmed object is a compiled executable, not a lazy jit wrapper
+    assert not hasattr(mp._bucket_steps[1], "lower")
+    frames = np.zeros((4, 64, 64, 3), np.uint8)
+    out = mp.step_all(frames)
+    assert out.shape == (4, 64, 64, 3)
