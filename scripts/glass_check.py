@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Glass-to-glass evidence: native RTP e2e against the REAL engine.
+
+VERDICT r2 next-round #9: run the full wire path — H.264 bytes -> UDP ->
+depacketize -> decode -> jitted diffusion step -> encode -> UDP -> H.264
+bytes — against the flagship model and persist the codec-inclusive
+/metrics stages (decode/encode/glass p50) as ONE JSON line.  The TPU
+watcher (scripts/tpu_watch.sh) commits it to PERF_LOG.jsonl; the
+BASELINE.md target is p50 glass-to-glass < 100 ms.
+
+Frames are paced at --fps (default 30) like a live camera; the client
+keeps draining returned packets so encoder/decoder pipelines stay busy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+async def run(model_id: str, frames: int, fps: int, result: dict):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_rtc_agent_tpu.media.frames import VideoFrame
+    from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+
+    provider = NativeRtpProvider()
+    app = build_app(model_id=model_id, provider=provider)
+    client = TestClient(TestServer(app))
+    await client.start_server()  # builds the pipeline (compile happens here)
+    cfg = app["pipeline"].config
+    w, h = cfg.width, cfg.height
+    loop = asyncio.get_event_loop()
+    recv_q: asyncio.Queue = asyncio.Queue()
+
+    class _ClientRecv(asyncio.DatagramProtocol):
+        def datagram_received(self, data, addr):
+            recv_q.put_nowait(data)
+
+    client_tr, _ = await loop.create_datagram_endpoint(
+        _ClientRecv, local_addr=("127.0.0.1", 0)
+    )
+    client_port = client_tr.get_extra_info("sockname")[1]
+    try:
+        offer = json.dumps(
+            {
+                "native_rtp": True, "video": True,
+                "client_addr": ["127.0.0.1", client_port],
+                "width": w, "height": h,
+            }
+        )
+        r = await client.post(
+            "/offer",
+            json={"room_id": "glass", "offer": {"sdp": offer, "type": "offer"}},
+        )
+        assert r.status == 200, await r.text()
+        server_port = json.loads((await r.json())["sdp"])["server_port"]
+
+        sink = H264Sink(w, h, fps=fps)
+        back = H264RingSource(w, h)
+        send_tr, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, remote_addr=("127.0.0.1", server_port)
+        )
+        returned = 0
+        t_first = None
+        try:
+            tick = 1.0 / fps
+            rng = np.random.default_rng(0)
+            base = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            t_start = time.monotonic()
+            for i in range(frames):
+                arr = np.roll(base, i * 4, axis=1)  # moving content
+                f = VideoFrame.from_ndarray(np.ascontiguousarray(arr))
+                f.pts = i * (90000 // fps)
+                for pkt in sink.consume(f):
+                    send_tr.sendto(pkt)
+                try:
+                    while True:
+                        back.feed_packet(recv_q.get_nowait())
+                except asyncio.QueueEmpty:
+                    pass
+                while back._ring.pop() is not None:
+                    returned += 1
+                    if t_first is None:
+                        t_first = time.monotonic()
+                next_t = t_start + (i + 1) * tick
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+            # drain stragglers
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and returned < frames // 2:
+                await asyncio.sleep(0.05)
+                try:
+                    while True:
+                        back.feed_packet(recv_q.get_nowait())
+                except asyncio.QueueEmpty:
+                    pass
+                while back._ring.pop() is not None:
+                    returned += 1
+        finally:
+            sink.close()
+            back.close()
+            send_tr.close()
+
+        m = await client.get("/metrics")
+        snap = await m.json()
+        result.update(
+            frames_sent=frames,
+            frames_returned=returned,
+            metrics={
+                k: snap.get(k)
+                for k in (
+                    "fps", "frames_total", "latency_p50_ms", "latency_p90_ms",
+                    "decode_p50_ms", "encode_p50_ms", "glass_p50_ms",
+                    "glass_p90_ms",
+                )
+                if snap.get(k) is not None
+            },
+        )
+        glass = snap.get("glass_p50_ms")
+        result["ok"] = bool(returned > 0)
+        if glass is not None:
+            result["glass_p50_ms"] = glass
+            result["meets_100ms_target"] = bool(glass < 100.0)
+    finally:
+        client_tr.close()
+        await client.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-id", default="stabilityai/sd-turbo")
+    ap.add_argument("--frames", type=int, default=120)
+    ap.add_argument("--fps", type=int, default=30)
+    args = ap.parse_args()
+
+    # a measurement run should spend its frames measuring, not warming
+    # (the build probe already compiled the step); operators can override
+    os.environ.setdefault("WARMUP_FRAMES", "2")
+    result = {"check": "glass_e2e", "ok": False, "backend": "unknown",
+              "model_id": args.model_id}
+    try:
+        from ai_rtc_agent_tpu.media import native
+
+        if not native.h264_available():
+            raise RuntimeError("libavcodec unavailable — no codec-inclusive path")
+        import jax
+
+        result["backend"] = jax.default_backend()
+        asyncio.run(run(args.model_id, args.frames, args.fps, result))
+    except BaseException as e:  # noqa: BLE001 — one line on any exit
+        result["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(result))
+        sys.stdout.flush()
+    sys.exit(0 if result.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()
