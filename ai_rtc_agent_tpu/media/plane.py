@@ -75,6 +75,11 @@ class H264RingSource:
 
     # -- network side (any thread) ------------------------------------------
 
+    def poll(self):
+        """Non-blocking pop of the newest decoded frame: (frame, pts) or
+        None — the sync-consumer counterpart of the async recv()."""
+        return self._ring.pop()
+
     def depacketize(self, packet: bytes) -> list:
         """One RTP packet -> list of completed (AU bytes, ts).  Runs the
         reorder buffer first (UDP reorders; FU-A assembly needs order), so

@@ -1,0 +1,104 @@
+"""Reusable native-RTP client: the peer-side loop of the media plane.
+
+Shared by the live example (examples/native_rtp_client.py) and the
+glass-to-glass measurement (scripts/glass_check.py) so the offer envelope,
+socket plumbing and the feed/poll drain discipline exist exactly once.
+
+The drain interleaves ``feed_packet`` with ``poll``: the receive ring is a
+4-slot latest-wins buffer, so feeding a whole burst before popping would
+evict all but the newest few frames and undercount a perfectly healthy
+stream (code-review r3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+
+from .frames import VideoFrame
+from .plane import H264RingSource, H264Sink
+
+
+class NativeRtpClient:
+    """Encode/send + receive/decode endpoints against a native-rtp agent."""
+
+    def __init__(self, width: int, height: int, fps: int = 30,
+                 use_h264: bool | None = None):
+        self.width, self.height, self.fps = width, height, fps
+        self._use_h264 = use_h264
+        self._recv_q: asyncio.Queue = asyncio.Queue()
+        self._recv_tr = None
+        self._send_tr = None
+        self.sink: H264Sink | None = None
+        self.back: H264RingSource | None = None
+
+    async def open(self) -> "NativeRtpClient":
+        loop = asyncio.get_event_loop()
+        q = self._recv_q
+
+        class _Recv(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                q.put_nowait(data)
+
+        self._recv_tr, _ = await loop.create_datagram_endpoint(
+            _Recv, local_addr=("0.0.0.0", 0)
+        )
+        self.back = H264RingSource(
+            self.width, self.height, use_h264=self._use_h264
+        )
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._recv_tr.get_extra_info("sockname")[1]
+
+    def offer_envelope(self) -> str:
+        """The JSON-envelope offer body for this client's geometry/port."""
+        return json.dumps(
+            {
+                "native_rtp": True, "video": True,
+                "width": self.width, "height": self.height,
+                "client_addr": ["127.0.0.1", self.port],
+            }
+        )
+
+    async def connect(self, server_port: int, host: str = "127.0.0.1"):
+        loop = asyncio.get_event_loop()
+        self._send_tr, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, remote_addr=(host, server_port)
+        )
+        self.sink = H264Sink(
+            self.width, self.height, fps=self.fps, use_h264=self._use_h264
+        )
+
+    def send(self, arr_u8: np.ndarray, index: int):
+        frame = VideoFrame.from_ndarray(np.ascontiguousarray(arr_u8))
+        frame.pts = index * (90_000 // self.fps)
+        for pkt in self.sink.consume(frame):
+            self._send_tr.sendto(pkt)
+
+    def drain(self) -> int:
+        """Feed every queued packet, polling decoded frames AFTER EACH feed
+        (latest-wins ring: batch-feeding would evict).  -> frames received."""
+        got = 0
+        while True:
+            try:
+                data = self._recv_q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            self.back.feed_packet(data)
+            while self.back.poll() is not None:
+                got += 1
+        while self.back.poll() is not None:
+            got += 1
+        return got
+
+    def close(self):
+        for c in (self.sink, self.back):
+            if c is not None:
+                c.close()
+        for t in (self._send_tr, self._recv_tr):
+            if t is not None:
+                t.close()

@@ -3,7 +3,9 @@
 
 Sends a synthetic (or camera, if OpenCV is around) video stream to the agent
 over raw RTP/UDP, receives the diffused stream back, and prints live fps.
-Everything rides this repo's own media stack — no aiortc, no browser.
+Everything rides this repo's own media stack — no aiortc, no browser; the
+socket/offer/drain plumbing lives in media/rtp_client.NativeRtpClient
+(shared with scripts/glass_check.py).
 
   # terminal 1
   WEBRTC_PROVIDER=native-rtp python -m ai_rtc_agent_tpu.server.agent \
@@ -23,8 +25,7 @@ import urllib.request
 
 import numpy as np
 
-from ai_rtc_agent_tpu.media.frames import VideoFrame
-from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
+from ai_rtc_agent_tpu.media.rtp_client import NativeRtpClient
 
 
 def _post(url: str, body: bytes, ctype: str) -> bytes:
@@ -41,35 +42,23 @@ async def main():
     ap.add_argument("--fps", type=int, default=30)
     ap.add_argument("--prompt", default=None)
     args = ap.parse_args()
-    w, h = args.width, args.height
 
-    loop = asyncio.get_event_loop()
-    recv_q: asyncio.Queue = asyncio.Queue()
-
-    class _Recv(asyncio.DatagramProtocol):
-        def datagram_received(self, data, addr):
-            recv_q.put_nowait(data)
-
-    recv_tr, _ = await loop.create_datagram_endpoint(
-        _Recv, local_addr=("0.0.0.0", 0)
-    )
-    my_port = recv_tr.get_extra_info("sockname")[1]
-
-    offer = {
-        "native_rtp": True, "video": True, "width": w, "height": h,
-        "client_addr": ["127.0.0.1", my_port],
-    }
+    rtp = await NativeRtpClient(args.width, args.height, fps=args.fps).open()
     answer = json.loads(
         _post(
             f"{args.agent}/offer",
             json.dumps(
-                {"room_id": "example", "offer": {"sdp": json.dumps(offer), "type": "offer"}}
+                {
+                    "room_id": "example",
+                    "offer": {"sdp": rtp.offer_envelope(), "type": "offer"},
+                }
             ).encode(),
             "application/json",
         )
     )
     server_port = json.loads(answer["sdp"])["server_port"]
-    print(f"connected: sending RTP to :{server_port}, receiving on :{my_port}")
+    await rtp.connect(server_port)
+    print(f"connected: sending RTP to :{server_port}, receiving on :{rtp.port}")
 
     if args.prompt:
         _post(
@@ -78,39 +67,21 @@ async def main():
             "application/json",
         )
 
-    send_tr, _ = await loop.create_datagram_endpoint(
-        asyncio.DatagramProtocol, remote_addr=("127.0.0.1", server_port)
-    )
-    sink = H264Sink(w, h, fps=args.fps)
-    back = H264RingSource(w, h)
-
     rng = np.random.default_rng(0)
-    base = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    base = rng.integers(0, 256, (args.height, args.width, 3), dtype=np.uint8)
     got, t0, i = 0, time.monotonic(), 0
     try:
         while True:
             i += 1
             # synthetic moving pattern (swap in a camera frame here)
-            frame = VideoFrame.from_ndarray(np.roll(base, i * 4, axis=1))
-            frame.pts = i * (90_000 // args.fps)
-            for pkt in sink.consume(frame):
-                send_tr.sendto(pkt)
-            try:
-                while True:
-                    back.feed_packet(recv_q.get_nowait())
-            except asyncio.QueueEmpty:
-                pass
-            while back._ring.pop() is not None:
-                got += 1
+            rtp.send(np.roll(base, i * 4, axis=1), i)
+            got += rtp.drain()
             if i % args.fps == 0:
                 dt = time.monotonic() - t0
                 print(f"sent {i} frames, received {got} ({got / dt:.1f} fps)")
             await asyncio.sleep(1 / args.fps)
     finally:
-        sink.close()
-        back.close()
-        send_tr.close()
-        recv_tr.close()
+        rtp.close()
 
 
 if __name__ == "__main__":

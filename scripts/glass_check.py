@@ -26,11 +26,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-async def run(model_id: str, frames: int, fps: int, result: dict):
+async def run(model_id: str, frames: int, fps: int, min_return_frac: float,
+              result: dict):
     from aiohttp.test_utils import TestClient, TestServer
 
-    from ai_rtc_agent_tpu.media.frames import VideoFrame
-    from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
+    from ai_rtc_agent_tpu.media.rtp_client import NativeRtpClient
     from ai_rtc_agent_tpu.server.agent import build_app
     from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
 
@@ -39,85 +39,42 @@ async def run(model_id: str, frames: int, fps: int, result: dict):
     client = TestClient(TestServer(app))
     await client.start_server()  # builds the pipeline (compile happens here)
     cfg = app["pipeline"].config
-    w, h = cfg.width, cfg.height
-    loop = asyncio.get_event_loop()
-    recv_q: asyncio.Queue = asyncio.Queue()
-
-    class _ClientRecv(asyncio.DatagramProtocol):
-        def datagram_received(self, data, addr):
-            recv_q.put_nowait(data)
-
-    client_tr, _ = await loop.create_datagram_endpoint(
-        _ClientRecv, local_addr=("127.0.0.1", 0)
-    )
-    client_port = client_tr.get_extra_info("sockname")[1]
+    rtp = await NativeRtpClient(cfg.width, cfg.height, fps=fps).open()
     try:
-        offer = json.dumps(
-            {
-                "native_rtp": True, "video": True,
-                "client_addr": ["127.0.0.1", client_port],
-                "width": w, "height": h,
-            }
-        )
         r = await client.post(
             "/offer",
-            json={"room_id": "glass", "offer": {"sdp": offer, "type": "offer"}},
+            json={
+                "room_id": "glass",
+                "offer": {"sdp": rtp.offer_envelope(), "type": "offer"},
+            },
         )
         assert r.status == 200, await r.text()
         server_port = json.loads((await r.json())["sdp"])["server_port"]
+        await rtp.connect(server_port)
 
-        sink = H264Sink(w, h, fps=fps)
-        back = H264RingSource(w, h)
-        send_tr, _ = await loop.create_datagram_endpoint(
-            asyncio.DatagramProtocol, remote_addr=("127.0.0.1", server_port)
-        )
         returned = 0
-        t_first = None
-        try:
-            tick = 1.0 / fps
-            rng = np.random.default_rng(0)
-            base = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
-            t_start = time.monotonic()
-            for i in range(frames):
-                arr = np.roll(base, i * 4, axis=1)  # moving content
-                f = VideoFrame.from_ndarray(np.ascontiguousarray(arr))
-                f.pts = i * (90000 // fps)
-                for pkt in sink.consume(f):
-                    send_tr.sendto(pkt)
-                try:
-                    while True:
-                        back.feed_packet(recv_q.get_nowait())
-                except asyncio.QueueEmpty:
-                    pass
-                while back._ring.pop() is not None:
-                    returned += 1
-                    if t_first is None:
-                        t_first = time.monotonic()
-                next_t = t_start + (i + 1) * tick
-                delay = next_t - time.monotonic()
-                if delay > 0:
-                    await asyncio.sleep(delay)
-            # drain stragglers
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline and returned < frames // 2:
-                await asyncio.sleep(0.05)
-                try:
-                    while True:
-                        back.feed_packet(recv_q.get_nowait())
-                except asyncio.QueueEmpty:
-                    pass
-                while back._ring.pop() is not None:
-                    returned += 1
-        finally:
-            sink.close()
-            back.close()
-            send_tr.close()
+        tick = 1.0 / fps
+        rng = np.random.default_rng(0)
+        base = rng.integers(0, 256, (cfg.height, cfg.width, 3), dtype=np.uint8)
+        t_start = time.monotonic()
+        for i in range(frames):
+            rtp.send(np.roll(base, i * 4, axis=1), i)  # moving content
+            returned += rtp.drain()
+            # ALWAYS yield: the agent runs in this same event loop — a
+            # behind-schedule client must not starve the server it measures
+            delay = t_start + (i + 1) * tick - time.monotonic()
+            await asyncio.sleep(max(0.0, delay))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and returned < frames * min_return_frac:
+            await asyncio.sleep(0.05)
+            returned += rtp.drain()
 
         m = await client.get("/metrics")
         snap = await m.json()
         result.update(
             frames_sent=frames,
             frames_returned=returned,
+            ring_dropped=int(rtp.back.dropped),
             metrics={
                 k: snap.get(k)
                 for k in (
@@ -129,12 +86,14 @@ async def run(model_id: str, frames: int, fps: int, result: dict):
             },
         )
         glass = snap.get("glass_p50_ms")
-        result["ok"] = bool(returned > 0)
+        # a healthy pipeline returns most of what was sent: a trickle must
+        # not be committed to PERF_LOG as a passing glass measurement
+        result["ok"] = bool(returned >= frames * min_return_frac)
         if glass is not None:
             result["glass_p50_ms"] = glass
             result["meets_100ms_target"] = bool(glass < 100.0)
     finally:
-        client_tr.close()
+        rtp.close()
         await client.close()
 
 
@@ -143,6 +102,9 @@ def main():
     ap.add_argument("--model-id", default="stabilityai/sd-turbo")
     ap.add_argument("--frames", type=int, default=120)
     ap.add_argument("--fps", type=int, default=30)
+    ap.add_argument("--min-return-frac", type=float, default=0.5,
+                    help="ok requires this fraction of sent frames back "
+                         "(lower it for slow-backend smoke tests)")
     args = ap.parse_args()
 
     # a measurement run should spend its frames measuring, not warming
@@ -158,7 +120,10 @@ def main():
         import jax
 
         result["backend"] = jax.default_backend()
-        asyncio.run(run(args.model_id, args.frames, args.fps, result))
+        asyncio.run(
+            run(args.model_id, args.frames, args.fps, args.min_return_frac,
+                result)
+        )
     except BaseException as e:  # noqa: BLE001 — one line on any exit
         result["error"] = f"{type(e).__name__}: {e}"
     finally:
