@@ -241,18 +241,13 @@ class MultiPeerEngine:
             return False
         if self.states is None:
             raise RuntimeError("call start() first (states define the signature)")
-        from ..aot.cache import EngineCache, engine_key
+        from ..aot.cache import EngineCache
+        from ..stream.engine import stream_engine_key
 
-        key = engine_key(
-            model_id,
-            self.cfg.mode,
-            batch=self.cfg.batch_size,
-            hw=f"{self.cfg.height}x{self.cfg.width}",
-            dtype=self.cfg.dtype,
-            cfgtype=self.cfg.cfg_type,
-            sched=self.cfg.scheduler,
-            peers=self.max_peers,
-        )
+        # the single-peer key recipe (incl. cnet/fused/attn graph flags)
+        # plus the peer dimension — one recipe, no drift between the two
+        # serving modes' cache slots
+        key = stream_engine_key(model_id, self.cfg, peers=self.max_peers)
         cache = EngineCache(cache_dir)
         frame_spec = jax.ShapeDtypeStruct(
             (self.max_peers, self.cfg.height, self.cfg.width, 3), jnp.uint8

@@ -420,10 +420,11 @@ def current_attn_impl() -> str:
     )
 
 
-def stream_engine_key(model_id: str, cfg: StreamConfig) -> str:
+def stream_engine_key(model_id: str, cfg: StreamConfig, **extra) -> str:
     """Canonical engine-cache key for a (model, stream config) pair — shared
-    by the build CLI and the serving fast path (reference cache-key
-    discipline: lib/wrapper.py:732-746)."""
+    by the build CLI, the serving fast path AND the multipeer engine (which
+    adds ``peers=N``), so every graph-changing flag lives in exactly one
+    key recipe (reference cache-key discipline: lib/wrapper.py:732-746)."""
     from ..aot.cache import engine_key
 
     return engine_key(
@@ -443,6 +444,7 @@ def stream_engine_key(model_id: str, cfg: StreamConfig) -> str:
         # adopted by a serving process that just fell back to XLA (and vice
         # versa a fallback engine would poison the Pallas cache slot)
         attn=cfg.attn_impl or current_attn_impl(),
+        **extra,
     )
 
 
@@ -544,6 +546,7 @@ class StreamEngine:
         self._last_out = None
         self._last_submitted = None
         self._prev_frame_small = None
+        self._skip_rng = np.random.default_rng(0)  # similarity-filter draws
         # submit() is a read-modify-write of self.state; concurrent tracks
         # (several connections sharing one pipeline, each stepping on a
         # worker thread) must serialize it.  The reference gets this for
@@ -737,16 +740,35 @@ class StreamEngine:
         return out
 
     def _maybe_skip(self, frame_u8) -> bool:
-        """Host-side similar-image filter: skips the device call entirely
-        (the real saving — an in-graph select would still burn the FLOPs).
-        Parity with the fork's stochastic similarity filter (reference
-        lib/wrapper.py:192-195)."""
+        """Host-side STOCHASTIC similar-image filter — the fork's
+        SimilarImageFilter semantics (reference lib/wrapper.py:192-195):
+        cosine similarity between consecutive (subsampled) frames; the skip
+        probability ramps linearly from 0 at the threshold to 1 at sim=1,
+        sampled per frame, with a max-skip guard so a static scene still
+        refreshes.  An identical frame (sim=1) always skips; anything at or
+        below the threshold never does — the stochastic band between keeps
+        slow pans alive instead of hard-freezing them at a cliff.
+        Skipping avoids the device call entirely (the real saving — an
+        in-graph select would still burn the FLOPs)."""
         small = np.asarray(frame_u8, dtype=np.float32)[..., ::16, ::16, :]
         if self._prev_frame_small is not None and self._last_out is not None:
-            diff = np.abs(small - self._prev_frame_small).mean() / 255.0
-            sim = 1.0 - min(diff * 4.0, 1.0)
+            a = small.ravel()
+            b = self._prev_frame_small.ravel()
+            na, nb = float(np.linalg.norm(a)), float(np.linalg.norm(b))
+            if na > 0.0 and nb > 0.0:
+                sim = float(a @ b) / (na * nb)
+            else:
+                # an all-black frame is only "similar" to another all-black
+                # frame — never to arbitrary content (a fade to black must
+                # not freeze the stream on stale frames)
+                sim = 1.0 if na == nb else 0.0
+            thr = self.cfg.similar_image_threshold
+            prob = (
+                0.0 if thr >= 1.0
+                else max(0.0, 1.0 - (1.0 - sim) / (1.0 - thr))
+            )
             if (
-                sim > self.cfg.similar_image_threshold
+                self._skip_rng.random() < prob
                 and self._skip_count < self.cfg.similar_image_max_skip
             ):
                 self._skip_count += 1
