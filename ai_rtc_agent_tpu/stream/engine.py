@@ -750,7 +750,10 @@ class StreamEngine:
         slow pans alive instead of hard-freezing them at a cliff.
         Skipping avoids the device call entirely (the real saving — an
         in-graph select would still burn the FLOPs)."""
-        small = np.asarray(frame_u8, dtype=np.float32)[..., ::16, ::16, :]
+        # subsample BEFORE the float cast: touch ~1/256 of the pixels, not a
+        # full-frame float32 copy per submitted frame (hot path, under the
+        # submit lock)
+        small = np.asarray(frame_u8)[..., ::16, ::16, :].astype(np.float32)
         if self._prev_frame_small is not None and self._last_out is not None:
             a = small.ravel()
             b = self._prev_frame_small.ravel()
