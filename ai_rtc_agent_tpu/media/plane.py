@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from fractions import Fraction
 
@@ -68,6 +69,11 @@ class H264RingSource:
         self._meta: dict = {}  # pts -> wall_ts at decode completion
         self._ended = False
         self._handlers: dict = {}
+        # decode runs on an executor thread while close() runs on the event
+        # loop: freeing the native decoder mid-decode is a segfault, so the
+        # two serialize here and post-close feeds become no-ops
+        self._io_lock = threading.Lock()
+        self._closed = False
         # frame-arrival signal: recv() sleeps on this instead of busy-polling
         # the ring; the decode thread sets it via call_soon_threadsafe
         self._loop = None
@@ -88,6 +94,8 @@ class H264RingSource:
         the AU decode (feed_au) needs a worker thread."""
         if self._depkt is None:
             raise RuntimeError("native RTP runtime unavailable")
+        if self._closed:
+            return []
         aus = []
         for pkt in self._reorder.push(packet):
             got = self._depkt.push(pkt)
@@ -110,30 +118,33 @@ class H264RingSource:
         IDR within a frame instead of the viewer freezing for up to a gop
         (VERDICT r2 weak #6)."""
         t0 = time.monotonic()
-        if self.use_h264:
-            try:
-                got = self._dec.decode(au, pts)
-            except RuntimeError as e:
-                logger.warning("dropping undecodable AU (%s)", e)
-                cb = self._handlers.get("decode_error")
-                if cb is not None:
-                    try:
-                        cb()
-                    except Exception:
-                        logger.exception("decode_error handler failed")
-                return
-            if got is None:
-                return
-            frame, out_pts = got
-        else:
-            frame, out_pts = NullCodec.decode(au)
-        now = time.monotonic()
-        self.stats.record_stage("decode", now - t0)
-        self._meta[int(out_pts)] = now
-        if len(self._meta) > 64:  # bound the pts->wall map
-            for k in sorted(self._meta)[:-64]:
-                self._meta.pop(k, None)
-        self._ring.push_latest(frame, meta=int(out_pts))
+        with self._io_lock:
+            if self._closed:
+                return  # connection torn down while this AU sat on a worker
+            if self.use_h264:
+                try:
+                    got = self._dec.decode(au, pts)
+                except RuntimeError as e:
+                    logger.warning("dropping undecodable AU (%s)", e)
+                    cb = self._handlers.get("decode_error")
+                    if cb is not None:
+                        try:
+                            cb()
+                        except Exception:
+                            logger.exception("decode_error handler failed")
+                    return
+                if got is None:
+                    return
+                frame, out_pts = got
+            else:
+                frame, out_pts = NullCodec.decode(au)
+            now = time.monotonic()
+            self.stats.record_stage("decode", now - t0)
+            self._meta[int(out_pts)] = now
+            if len(self._meta) > 64:  # bound the pts->wall map
+                for k in sorted(self._meta)[:-64]:
+                    self._meta.pop(k, None)
+            self._ring.push_latest(frame, meta=int(out_pts))
         if self._loop is not None and self._frame_event is not None:
             try:
                 self._loop.call_soon_threadsafe(self._frame_event.set)
@@ -183,11 +194,13 @@ class H264RingSource:
         return self._ring.dropped
 
     def close(self):
-        self._ring.close()
-        if self._dec:
-            self._dec.close()
-        if self._depkt:
-            self._depkt.close()
+        with self._io_lock:  # never free the decoder under an active decode
+            self._closed = True
+            self._ring.close()
+            if self._dec:
+                self._dec.close()
+            if self._depkt:
+                self._depkt.close()
 
 
 class H264Sink:

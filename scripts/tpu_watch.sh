@@ -71,8 +71,15 @@ sys.exit(0 if ok else 1)' 2>/dev/null; then
   return 1
 }
 
+START_EPOCH=$(date +%s)
+TTL_S=${TPU_WATCH_TTL_S:-86400}  # don't poll into the next round forever
+
 while true; do
   [ -e "$STOP" ] && { note "stop file present — exiting"; exit 0; }
+  if [ $(( $(date +%s) - START_EPOCH )) -gt "$TTL_S" ]; then
+    note "TTL expired — exiting"
+    exit 0
+  fi
   B=$(timeout -s TERM 240 python -c "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
   if [ "$B" != "tpu" ]; then
     note "tunnel still down ($B)"
