@@ -13,6 +13,10 @@
 # timeout(1) sends SIGTERM, which is safe.  Touch /tmp/tpu_watch_stop to
 # halt cleanly between queue items.
 cd /root/repo || exit 1
+# share compiled executables across queue items: every bench/check is a
+# fresh process, and without this each one re-pays the full (remote,
+# minutes-long under the tunnel) compile of the same serving step
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_persist_cache}
 LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch_r3.log}
 STOP=/tmp/tpu_watch_stop
 echo $$ > /tmp/tpu_watch.pid  # stop with: kill -TERM $(cat /tmp/tpu_watch.pid)
