@@ -148,8 +148,8 @@ async def offer(request):
         params = await request.json()
         room_id = params["room_id"]
         offer_params = params["offer"]
-    except (ValueError, KeyError) as e:
-        return web.Response(status=400, text=f"invalid offer request: {e}")
+    except (ValueError, LookupError) as e:  # LookupError covers KeyError +
+        return web.Response(status=400, text=f"invalid offer request: {e}")  # unknown charset=
     pipeline, release_pipeline = await _claim_pipeline(app)
     if pipeline is None:
         return web.Response(status=503, text="all peer slots in use")
@@ -299,7 +299,13 @@ async def whep(request):
     provider = app["provider"]
     pcs = app["pcs"]
 
-    offer_sdp = provider.session_description(sdp=await request.text(), type="offer")
+    try:
+        body = await request.text()
+    except (ValueError, LookupError) as e:
+        # undecodable body (ValueError covers UnicodeDecodeError) or an
+        # unknown charset= parameter (LookupError) -> client error
+        return web.Response(status=400, text=f"invalid offer body: {e}")
+    offer_sdp = provider.session_description(sdp=body, type="offer")
     pc = provider.peer_connection()
     session_id = str(uuid.uuid4())
     pcs.add(pc)
@@ -450,9 +456,10 @@ async def whip(request):
         await pc._RTCPeerConnection__gather()
         answer = await pc.createAnswer()
         await pc.setLocalDescription(answer)
-    except ValueError as e:
-        # bad client SDP (e.g. no video m= section) is a 400, and the
-        # half-built pc + session entries must not leak (code-review r3)
+    except (ValueError, LookupError) as e:
+        # bad client SDP (e.g. no video m= section), an undecodable body or
+        # an unknown charset= is a 400, and the half-built pc + session
+        # entries must not leak (code-review r3)
         await _discard_pc(pc, pcs)
         _cleanup_failed()
         return web.Response(status=400, text=f"invalid offer: {e}")
@@ -476,7 +483,7 @@ async def whip(request):
 async def update_config(request):
     try:
         config = await request.json()
-    except ValueError:
+    except (ValueError, LookupError):
         return web.Response(status=400, text="invalid JSON body")
     logger.info("received config: %s", config)
     target = request.app.get("multipeer_pipeline") or request.app["pipeline"]

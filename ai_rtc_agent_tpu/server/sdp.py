@@ -105,8 +105,12 @@ def parse(text: str) -> SdpOffer:
             )
             media.append(cur)
         elif key == "c":
-            # "IN IP4 203.0.113.9"
-            addr = val.split()[-1].split("/")[0]
+            # "IN IP4 203.0.113.9"; a bare/malformed c= is ignored rather
+            # than crashing the parse (hostile bodies must map to 4xx)
+            parts = val.split()
+            if not parts:
+                continue
+            addr = parts[-1].split("/")[0]
             if cur is None:
                 session_conn = addr
             else:

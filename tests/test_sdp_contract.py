@@ -205,3 +205,58 @@ def test_videoless_whip_is_400_and_leaks_nothing(monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_whip_whep_fuzz_never_500(monkeypatch):
+    """Hostile signaling bodies (garbage SDP, binary, truncated m= lines,
+    empty) must map to 4xx — never a 500 and never a leaked pc."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    bodies = [
+        b"v=0\r\nm=video garbage line\r\n",
+        b"v=0",
+        b"",
+        b"\xff\xfe\x00binary\x9c",
+        b"m=video 1 RTP/AVP",  # m= before v=, too few fields
+        ("v=0\r\n" + "a=x:" + "A" * 5000 + "\r\n").encode(),
+        b"not sdp and not json",
+    ]
+
+    async def go():
+        app, client = await _client()
+        try:
+            # publisher so whep reaches its parse path (else 401 short-circuit)
+            r = await client.post(
+                "/whip",
+                data='{"native_rtp": true, "video": true}',
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            baseline_pcs = len(app["pcs"])
+            for ep in ("/whip", "/whep"):
+                for body in bodies:
+                    r = await client.post(
+                        ep, data=body,
+                        headers={"Content-Type": "application/sdp"},
+                    )
+                    assert 400 <= r.status < 500, (ep, body[:30], r.status)
+                # unknown charset= parameter passes the content-type gate
+                # but must still be a client error (was a 500)
+                r = await client.post(
+                    ep, data=b"v=0",
+                    headers={"Content-Type": "application/sdp; charset=bogus"},
+                )
+                assert 400 <= r.status < 500, (ep, "charset", r.status)
+                # bare c= was an IndexError 500; the lenient parse now
+                # ACCEPTS the (otherwise valid) video offer
+                r = await client.post(
+                    ep, data=b"v=0\r\nm=video 1 RTP/AVP 96\r\nc=\r\n",
+                    headers={"Content-Type": "application/sdp"},
+                )
+                assert r.status in (201, 400), (ep, "bare c=", r.status)
+                if r.status == 201:  # clean the accepted session back up
+                    await client.delete(r.headers["Location"])
+            assert len(app["pcs"]) == baseline_pcs  # nothing leaked
+        finally:
+            await client.close()
+
+    run(go())
