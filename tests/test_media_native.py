@@ -174,3 +174,63 @@ def test_full_media_plane_e2e(native_lib, rng):
                 assert styled.shape == (h, w, 3) and styled.dtype == np.uint8
                 delivered += 1
     assert delivered >= 3  # codec latency may hold back a few frames
+
+
+def test_depacketizer_survives_adversarial_packets(native_lib):
+    """The RTP depacketizer parses REMOTELY-SUPPLIED bytes (the agent's
+    UDP media port): 2k seeded adversarial packets (empty, truncated
+    headers, forced FU-A indicators, random garbage) must never crash the
+    native parser (memory-safety regression gate; a 20k-packet run of the
+    same corpus passed during round 3)."""
+    from ai_rtc_agent_tpu.media.rtp import RtpDepacketizer, RtpReorderBuffer
+
+    rng = np.random.default_rng(0)
+    d = RtpDepacketizer()
+    rb = RtpReorderBuffer()
+    cases = [b"", b"\x80", b"\x80\x60", b"\x80" * 11, b"\xff" * 12, b"\x00" * 13]
+    # the reorder buffer filters <4-byte runts in python — hit the NATIVE
+    # parser directly with every truncated shape too
+    for c in cases:
+        d.push(c)
+    aus = 0
+    for i in range(2000):
+        if i < len(cases):
+            pkt = cases[i]
+        else:
+            ln = int(rng.integers(0, 1500))
+            pkt = rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+            if rng.random() < 0.5 and ln >= 13:
+                b = bytearray(pkt)
+                b[0] = 0x80
+                b[1] = (b[1] & 0x80) | 96
+                if rng.random() < 0.5:
+                    b[12] = (b[12] & 0xE0) | 28  # FU-A indicator
+                pkt = bytes(b)
+        for p2 in rb.push(pkt):
+            if d.push(p2) is not None:
+                aus += 1
+    d.close()
+    assert aus >= 0  # surviving is the assertion
+
+
+def test_packetizer_boundary_au_sizes(native_lib):
+    """NAL sizes straddling the single-NAL/FU-A threshold (max_payload =
+    mtu 1200 - 12-byte header = 1188) and large payloads: every emitted
+    packet respects the MTU and fragmentation kicks in exactly past the
+    threshold."""
+    from ai_rtc_agent_tpu.media.rtp import MAX_AU, RtpPacketizer
+
+    rng = np.random.default_rng(1)
+    p = RtpPacketizer()
+    max_payload = 1200 - 12
+    for nal_len in (1, 2, max_payload - 1, max_payload, max_payload + 1,
+                    max_payload + 2, 65536, MAX_AU // 2):
+        nal = bytes([0x65]) + rng.integers(0, 256, nal_len - 1, dtype=np.uint8).tobytes()
+        pkts = p.packetize(b"\x00\x00\x00\x01" + nal, 1234)
+        assert pkts, nal_len  # a start-coded NAL must produce packets
+        assert all(len(x) <= 1200 for x in pkts), nal_len
+        if nal_len <= max_payload:
+            assert len(pkts) == 1, (nal_len, len(pkts))  # single NAL packet
+        else:
+            assert len(pkts) >= 2, nal_len  # FU-A fragmentation engaged
+    p.close()
