@@ -1,0 +1,84 @@
+"""Minimal TPU liveness proof: jit a small matmul chain, time dispatch.
+
+Purpose (PERF.md round-3 discipline): the bench queue's first real item
+(turbo512) pays a full SD-Turbo compile — minutes under the tunnel, and the
+round-2/3 failure mode is a remote call that never returns.  This script is
+the cheapest possible *execute-path* evidence: a few-second compile and a
+handful of dispatches.  If THIS hangs, the tunnel's execute path is wedged
+(not our model compile); if it succeeds we have a committed artifact proving
+TPU contact plus a dispatch-RTT measurement that bounds achievable fps
+(each serving step pays at least one dispatch round-trip).
+
+Prints ONE JSON line compatible with scripts/tpu_watch.sh's filter:
+{"ok": true, "backend": "tpu", "dispatch_ms": ..., "matmul_ms": ...}.
+"""
+
+import json
+import signal
+import sys
+import time
+
+
+def main() -> int:
+    out = {"metric": "tpu_smoke", "ok": False, "backend": "unknown"}
+
+    def _on_sigterm(signum, frame):
+        # same contract as bench.py: convert the watcher's timeout TERM into
+        # an exception so the finally block still emits the JSON line
+        raise TimeoutError("SIGTERM (watcher timeout)")
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        out["backend"] = jax.default_backend()
+        dev = jax.devices()[0]
+        out["device"] = str(dev)
+
+        @jax.jit
+        def f(x):
+            # enough FLOPs to touch the MXU, small enough to compile in
+            # seconds: 8 chained 512x512 bf16 matmuls (~2.1 GFLOP)
+            for _ in range(8):
+                x = jnp.tanh(x @ x)
+            return x
+
+        x = jnp.ones((512, 512), jnp.bfloat16)
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        out["compile_plus_first_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1
+        )
+
+        # steady-state: dispatch round-trip (tiny op) and matmul-chain time
+        @jax.jit
+        def tiny(x):
+            return x + 1.0
+
+        y = jnp.zeros((8,), jnp.float32)
+        tiny(y).block_until_ready()
+        times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            tiny(y).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        out["dispatch_ms"] = round(sorted(times)[len(times) // 2] * 1e3, 2)
+
+        times = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            f(x).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        out["matmul_ms"] = round(sorted(times)[len(times) // 2] * 1e3, 2)
+        out["ok"] = out["backend"] == "tpu"
+    except Exception as e:  # noqa: BLE001 — contract line on any failure
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(out))
+        sys.stdout.flush()
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,10 +8,13 @@
 # git-committing after every entry — before trying longer configs.  A
 # mid-queue tunnel death therefore still leaves real numbers in the repo.
 #
-# Rules (hard-won): at most ONE TPU process at a time; never SIGKILL a
-# claiming process (the server-side lease leaks and claims wedge 30+ min);
-# timeout(1) sends SIGTERM, which is safe.  Touch /tmp/tpu_watch_stop to
-# halt cleanly between queue items.
+# Rules (hard-won): at most ONE TPU process at a time.  Prefer SIGTERM
+# (timeout(1) default) — a SIGKILLed claim can leak its server-side lease
+# and wedge later claims for 30+ min.  BUT a remote call blocked in C never
+# runs the Python TERM handler (observed r3: bench hung 40+ min after TERM
+# was consumed), so run_item escalates to KILL after a grace period — a
+# never-returning claim has already leaked the lease; do not remove the -k.
+# Touch /tmp/tpu_watch_stop to halt cleanly between queue items.
 cd /root/repo || exit 1
 # share compiled executables across queue items: every bench/check is a
 # fresh process, and without this each one re-pays the full (remote,
@@ -54,7 +57,12 @@ run_item() {  # $1=label  $2=timeout-seconds  rest=command
   [ -e "$STOP" ] && { note "stop file present — exiting"; exit 0; }
   note "run: $label"
   local out line
-  out=$(timeout -s TERM "$tmo" "$@" 2>>"$LOG")
+  # -k: a remote call blocked in C never lets the Python SIGTERM handler
+  # run (observed r3: bench stuck 40+ min AFTER the TERM was consumed by
+  # CPython's C-level handler) — escalate to SIGKILL after a grace period
+  # so one wedged item cannot block the whole queue.  The lease-leak risk
+  # of KILL is accepted: a never-returning claim has already leaked it.
+  out=$(timeout -k 180 -s TERM "$tmo" "$@" 2>>"$LOG")
   line=$(printf '%s\n' "$out" | tail -1)
   if printf '%s' "$line" | python -c '
 import json, sys
@@ -84,13 +92,33 @@ while true; do
     note "TTL expired — exiting"
     exit 0
   fi
-  B=$(timeout -s TERM 240 python -c "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
+  B=$(timeout -k 60 -s TERM 240 python -c "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
   if [ "$B" != "tpu" ]; then
     note "tunnel still down ($B)"
     sleep 240
     continue
   fi
   note "tunnel OK — running queue (shortest first, commit after each)"
+  # 0. cheapest execute-path proof: seconds of compile, banks a committed
+  #    TPU artifact + dispatch-RTT bound before any heavy model compile.
+  #    Not gating: a smoke failure still lets turbo512 try (and vice versa
+  #    a smoke success is real evidence even if turbo512's compile wedges).
+  #    Banked once per watcher process; failed attempts are capped at 3 and
+  #    tightly timed (it IS "seconds of compile" — 300s is already generous
+  #    under the tunnel) so a wedged execute path cannot spend each scarce
+  #    tunnel window on smoke instead of the real bench (the rounds-1/2
+  #    "windows lost to probes" failure mode).
+  if [ -z "$SMOKE_DONE" ] && [ "${SMOKE_TRIES:-0}" -lt 3 ]; then
+    SMOKE_T0=$(date +%s)
+    if run_item "smoke" 300 python -u scripts/tpu_smoke.py; then
+      SMOKE_DONE=1
+    elif [ $(( $(date +%s) - SMOKE_T0 )) -ge 30 ]; then
+      # only burn a try on a real attempt (wedged execute → 300s timeout);
+      # an instant CPU-fallback failure (tunnel flapped between probe and
+      # smoke) must not consume the cap
+      SMOKE_TRIES=$(( ${SMOKE_TRIES:-0} + 1 ))
+    fi
+  fi
   # 1. shortest useful number: ~seconds of device time after compile
   if ! run_item "turbo512_f10" 1800 python -u bench.py --config turbo512 --frames 10; then
     note "first bench produced no tpu number; re-polling"
