@@ -30,8 +30,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..stream.engine import StreamConfig, StreamModels, current_attn_impl
-from ..utils import env
+from ..stream.engine import (
+    StreamConfig,
+    StreamModels,
+    current_attn_impl,
+    current_fused_epilogue,
+)
 from . import clip as C
 from . import controlnet as CN
 from . import loader as LD
@@ -125,10 +129,7 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
     # at a new geometry the agent can be relaunched on the composed-XLA path
     # without a code change (the serving pipeline also auto-falls-back at
     # build time — stream/pipeline._probe_pallas_fallback).
-    base.setdefault(
-        "use_fused_epilogue",
-        env.get_bool("FUSED_EPILOGUE", jax.default_backend() == "tpu"),
-    )
+    base.setdefault("use_fused_epilogue", current_fused_epilogue())
     # bf16 compute on real TPUs (fp32 elsewhere): the SERVING default must
     # match what the bench measures — fp32 serving on TPU would halve MXU
     # throughput and double HBM traffic

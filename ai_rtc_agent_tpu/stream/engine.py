@@ -420,6 +420,17 @@ def current_attn_impl() -> str:
     )
 
 
+def current_fused_epilogue() -> bool:
+    """Resolved FUSED_EPILOGUE default (on for real TPUs; env kill-switch).
+
+    Single definition for the same reason as :func:`current_attn_impl`:
+    models/registry's bundle default and bench.py's PERF_LOG variant label
+    must agree on which graph actually ran."""
+    from ..utils import env as _env
+
+    return _env.get_bool("FUSED_EPILOGUE", jax.default_backend() == "tpu")
+
+
 def stream_engine_key(model_id: str, cfg: StreamConfig, **extra) -> str:
     """Canonical engine-cache key for a (model, stream config) pair — shared
     by the build CLI, the serving fast path AND the multipeer engine (which

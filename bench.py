@@ -123,9 +123,15 @@ def run_bench(config: str, frames: int, pipeline_depth: int = 4, fbs: int = 1):
     frame_flipped = frame[::-1].copy()
 
     # warm-up: compile + cache (reference drops 10 warm-up frames at connect,
-    # lib/tracks.py:21-25 — same idea)
+    # lib/tracks.py:21-25 — same idea).  The pre/post log lines bracket the
+    # one remote call that has wedged whole tunnel windows (r3: 40+ min in
+    # the first compile with zero output) so the watcher log shows WHERE a
+    # stuck bench is stuck.
     t0 = time.monotonic()
-    for _ in range(3):
+    logger.info("warm-up: first step submit (triggers the full compile)...")
+    eng(frame)
+    logger.info("warm-up: first step done in %.1fs", time.monotonic() - t0)
+    for _ in range(2):
         eng(frame)
     logger.info("warm-up (incl. compile): %.1fs", time.monotonic() - t0)
 
@@ -248,6 +254,15 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
     path = os.getenv("PERF_LOG_PATH") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "PERF_LOG.jsonl"
     )
+    # graph-variant keys: a safe-path number (attn_impl=xla, no fused
+    # epilogue) must not stand in for the TPU-default pallas config or vice
+    # versa.  Replay candidates are always backend=="tpu", so the requested
+    # variant resolves from env with the TPU defaults — no jax import (this
+    # path runs precisely when the backend is unreachable).
+    from ai_rtc_agent_tpu.utils.env import get_bool
+
+    want_attn = os.getenv("ATTN_IMPL") or "pallas"
+    want_fused = get_bool("FUSED_EPILOGUE", True)
     best = None
     try:
         with open(path) as f:
@@ -267,6 +282,11 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                     and d.get("quant") == quant
                     and d.get("peers") == peers
                     and d.get("active") == active
+                    # entries predating the variant fields match any
+                    # variant (there are no such TPU entries in this repo's
+                    # committed log; tolerated for external logs)
+                    and d.get("attn_impl", want_attn) == want_attn
+                    and d.get("fused_epilogue", want_fused) == want_fused
                 ):
                     best = d
     except OSError:
@@ -391,6 +411,20 @@ def main():
             logger.exception("backend init failed; retrying on cpu")
             jax.config.update("jax_platforms", "cpu")
             result["backend"] = jax.default_backend()
+
+        # record which graph variant this number measured: the safe-path
+        # queue items (ATTN_IMPL=xla FUSED_EPILOGUE=0) and the TPU-default
+        # pallas path produce different executables; a PERF_LOG reader (or a
+        # replay consumer) must be able to tell them apart
+        from ai_rtc_agent_tpu.stream.engine import (
+            current_attn_impl,
+            current_fused_epilogue,
+        )
+
+        result["attn_impl"] = current_attn_impl()
+        result["fused_epilogue"] = current_fused_epilogue()
+        if os.getenv("JAX_COMPILATION_CACHE_DIR"):
+            result["compilation_cache"] = True
 
         if args.config == "multipeer":
             r = run_bench_multipeer(args.frames, args.peers, active=args.active)

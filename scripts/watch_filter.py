@@ -1,0 +1,32 @@
+"""Acceptance predicate for banking a queue item's JSON line (tpu_watch.sh).
+
+Reads one line from stdin; exit 0 iff it is a LIVE TPU result worth
+committing to PERF_LOG.jsonl:
+  - backend == "tpu", and
+  - ok is true (smoke / checks), or value > 0 with live:true (bench lines).
+A replayed bench line (live:false) must never be re-logged under a new
+label.  Kept in its own file so tests/test_tpu_smoke_contract.py pins the
+EXACT predicate the watcher runs, not a transcription of it.
+"""
+
+import json
+import sys
+
+
+def accept(d: dict) -> bool:
+    return d.get("backend") == "tpu" and (
+        d.get("ok") is True
+        or (d.get("value", 0) > 0 and d.get("live") is True)
+    )
+
+
+def main() -> int:
+    try:
+        d = json.load(sys.stdin)
+    except Exception:
+        return 1
+    return 0 if accept(d) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
