@@ -415,9 +415,9 @@ def current_attn_impl() -> str:
     bundle builder (models/registry), the serving build probe
     (stream/pipeline) and the AOT cache key below, so they cannot disagree
     (empty-string env counts as unset everywhere)."""
-    return os.getenv("ATTN_IMPL") or (
-        "pallas" if jax.default_backend() == "tpu" else "xla"
-    )
+    from ..utils import env as _env
+
+    return _env.attn_impl_default(jax.default_backend())
 
 
 def current_fused_epilogue() -> bool:
@@ -428,7 +428,7 @@ def current_fused_epilogue() -> bool:
     must agree on which graph actually ran."""
     from ..utils import env as _env
 
-    return _env.get_bool("FUSED_EPILOGUE", jax.default_backend() == "tpu")
+    return _env.fused_epilogue_default(jax.default_backend())
 
 
 def stream_engine_key(model_id: str, cfg: StreamConfig, **extra) -> str:

@@ -61,6 +61,25 @@ def get_bool(name: str, default: bool = False) -> bool:
     return v.strip().lower() not in ("0", "false", "no", "off")
 
 
+# Graph-variant resolvers (jax-free) ----------------------------------------
+# THE single definitions of the serving-graph variant defaults, parameterized
+# on the backend name so they are usable where jax must not be imported (the
+# bench replay path runs precisely when the accelerator is unreachable).
+# stream/engine.current_attn_impl / current_fused_epilogue bind them to
+# jax.default_backend(); bench._replay_from_perf_log binds them to "tpu".
+
+
+def attn_impl_default(backend: str) -> str:
+    """Resolved ATTN_IMPL (xla | pallas | ring | ulysses); empty env counts
+    as unset; pallas is the default only on real TPUs."""
+    return os.getenv("ATTN_IMPL") or ("pallas" if backend == "tpu" else "xla")
+
+
+def fused_epilogue_default(backend: str) -> bool:
+    """Resolved FUSED_EPILOGUE (operator kill-switch; on for real TPUs)."""
+    return get_bool("FUSED_EPILOGUE", backend == "tpu")
+
+
 # Canonical accessors -------------------------------------------------------
 
 def warmup_frames() -> int:

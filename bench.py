@@ -257,12 +257,15 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
     # graph-variant keys: a safe-path number (attn_impl=xla, no fused
     # epilogue) must not stand in for the TPU-default pallas config or vice
     # versa.  Replay candidates are always backend=="tpu", so the requested
-    # variant resolves from env with the TPU defaults — no jax import (this
-    # path runs precisely when the backend is unreachable).
-    from ai_rtc_agent_tpu.utils.env import get_bool
+    # variant resolves via the shared jax-free resolvers bound to "tpu"
+    # (this path runs precisely when the backend is unreachable).
+    from ai_rtc_agent_tpu.utils.env import (
+        attn_impl_default,
+        fused_epilogue_default,
+    )
 
-    want_attn = os.getenv("ATTN_IMPL") or "pallas"
-    want_fused = get_bool("FUSED_EPILOGUE", True)
+    want_attn = attn_impl_default("tpu")
+    want_fused = fused_epilogue_default("tpu")
     best = None
     try:
         with open(path) as f:
@@ -365,12 +368,9 @@ def main():
     # rc=1, parsed:null).  Build the failure line first, upgrade it as the
     # bench progresses, and print from a finally block.  SIGTERM (driver
     # timeout) is converted to an exception so the finally block still runs.
-    import signal
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
 
-    def _on_sigterm(signum, frame):
-        raise TimeoutError("SIGTERM (driver timeout)")
-
-    signal.signal(signal.SIGTERM, _on_sigterm)
+    sigterm_to_exception("driver timeout")
     import os
 
     result = {

@@ -21,10 +21,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 T_START = time.monotonic()
 
@@ -82,35 +85,42 @@ def main():
     ap.add_argument("--frames", type=int, default=20)
     args = ap.parse_args()
 
-    import jax
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
 
+    sigterm_to_exception("watcher timeout")
     out = {"phase": "build" if args.build else "reload",
-           "backend": jax.default_backend()}
+           "ok": False, "backend": "unknown"}
+    try:
+        import jax
 
-    if args.build:
-        eng, cfg = build_engine(args.model_id, jit_compile=True)
-        t0 = time.monotonic()
-        ok = eng.use_aot_cache(args.model_id, build_on_miss=True)
-        out["engine_built"] = bool(ok)
-        out["build_s"] = round(time.monotonic() - t0, 1)
-        out["fps"] = round(measure_fps(eng, cfg, args.frames), 2)
-        out["donation_in_place"] = check_donation(eng, cfg)
-        out["ok"] = bool(ok)  # watcher commit criterion (scripts/tpu_watch.sh)
-    else:
-        # fast path: no jit wrapper at all — state built, engine adopted
-        eng, cfg = build_engine(args.model_id, jit_compile=False)
-        t0 = time.monotonic()
-        ok = eng.use_aot_cache(args.model_id, build_on_miss=False)
-        out["cache_hit"] = bool(ok)
-        out["adopt_s"] = round(time.monotonic() - t0, 1)
-        out["start_to_ready_s"] = round(time.monotonic() - T_START, 1)
-        if ok:
+        out["backend"] = jax.default_backend()
+        if args.build:
+            eng, cfg = build_engine(args.model_id, jit_compile=True)
+            t0 = time.monotonic()
+            ok = eng.use_aot_cache(args.model_id, build_on_miss=True)
+            out["engine_built"] = bool(ok)
+            out["build_s"] = round(time.monotonic() - t0, 1)
             out["fps"] = round(measure_fps(eng, cfg, args.frames), 2)
             out["donation_in_place"] = check_donation(eng, cfg)
-        out["ok"] = bool(ok)  # watcher commit criterion (scripts/tpu_watch.sh)
-
-    print(json.dumps(out))
-    sys.stdout.flush()
+            out["ok"] = bool(ok)  # watcher commit criterion (tpu_watch.sh)
+        else:
+            # fast path: no jit wrapper at all — state built, engine adopted
+            eng, cfg = build_engine(args.model_id, jit_compile=False)
+            t0 = time.monotonic()
+            ok = eng.use_aot_cache(args.model_id, build_on_miss=False)
+            out["cache_hit"] = bool(ok)
+            out["adopt_s"] = round(time.monotonic() - t0, 1)
+            out["start_to_ready_s"] = round(time.monotonic() - T_START, 1)
+            if ok:
+                out["fps"] = round(measure_fps(eng, cfg, args.frames), 2)
+                out["donation_in_place"] = check_donation(eng, cfg)
+            out["ok"] = bool(ok)  # watcher commit criterion (tpu_watch.sh)
+    except BaseException as e:  # noqa: BLE001 — contract line on any failure
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(out))
+        sys.stdout.flush()
+    sys.exit(0 if out.get("ok") else 1)
 
 
 if __name__ == "__main__":

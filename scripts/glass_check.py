@@ -112,6 +112,9 @@ def main():
     os.environ.setdefault("WARMUP_FRAMES", "2")
     result = {"check": "glass_e2e", "ok": False, "backend": "unknown",
               "model_id": args.model_id}
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("watcher timeout")
     try:
         from ai_rtc_agent_tpu.media import native
 

@@ -34,6 +34,9 @@ def main():
         args.model_id.replace("/", "--") + ".json",
     )
     result = {"ok": False, "check": "golden_capture", "model_id": args.model_id}
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("watcher timeout")
     try:
         cap = golden.capture(args.model_id)
         os.makedirs(os.path.dirname(out), exist_ok=True)

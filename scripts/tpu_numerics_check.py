@@ -203,6 +203,9 @@ def main():
     args = ap.parse_args()
 
     result = {"check": "tpu_numerics", "ok": False, "backend": "unknown"}
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("watcher timeout")
     try:
         import jax
 

@@ -31,16 +31,11 @@ note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
 # did the last run_item's output line come from a CPU fallback?  That means
 # the tunnel flapped between the backend probe and the item — NOT evidence
 # against the item itself (vs. an empty/partial line: timeout/KILL, a real
-# wedge).  Wall-clock is no proxy: a CPU-fallback smoke runs its full
-# measurement and can exceed any small threshold.
+# wedge).  Predicate lives in scripts/watch_filter.py (same file as the
+# banking filter) so the tests pin the exact code the watcher runs.
 last_was_cpu_fallback() {
-  printf '%s' "$RUN_ITEM_LINE" | python -c '
-import json, sys
-try:
-    d = json.load(sys.stdin)
-except Exception:
-    sys.exit(1)
-sys.exit(0 if d.get("backend") == "cpu" else 1)' 2>/dev/null
+  printf '%s' "$RUN_ITEM_LINE" \
+    | python scripts/watch_filter.py --cpu-fallback 2>/dev/null
 }
 
 append_and_commit() {  # $1=label  $2=json-line
@@ -124,14 +119,24 @@ while true; do
       # same tiny compile THROUGH the persistent cache: a failure here,
       # right after a cache-free success, isolates the cache as the wedge
       # — drop it for the rest of the queue instead of losing the window.
-      # A CPU-fallback line means the tunnel flapped, not cache evidence.
-      if ! run_item "smoke_cache" 300 python -u scripts/tpu_smoke.py; then
-        if last_was_cpu_fallback; then
+      # A CPU-fallback line means the tunnel flapped, not cache evidence;
+      # an ambiguous failure (timeout/no line — the signature a tunnel
+      # wedge shares) gets ONE retry before the cache is forfeited.
+      CACHE_VERDICT=keep
+      for attempt in 1 2; do
+        if run_item "smoke_cache" 300 python -u scripts/tpu_smoke.py; then
+          CACHE_VERDICT=keep; break
+        elif last_was_cpu_fallback; then
           note "smoke_cache fell back to cpu (tunnel flap) — cache kept"
+          CACHE_VERDICT=keep; break
         else
-          note "persistent compilation cache implicated — disabled for queue"
-          unset JAX_COMPILATION_CACHE_DIR
+          CACHE_VERDICT=implicated
+          [ "$attempt" = 1 ] && note "smoke_cache ambiguous failure — one retry"
         fi
+      done
+      if [ "$CACHE_VERDICT" = implicated ]; then
+        note "persistent compilation cache implicated — disabled for queue"
+        unset JAX_COMPILATION_CACHE_DIR
       fi
     elif ! last_was_cpu_fallback; then
       # only burn a try on a real attempt (wedged execute → timeout/KILL,

@@ -20,13 +20,22 @@ def accept(d: dict) -> bool:
     )
 
 
-def main() -> int:
+def cpu_fallback(d: dict) -> bool:
+    """Did this (failed) line come from a CPU fallback?  That means the
+    tunnel flapped between the backend probe and the item — NOT evidence
+    against the item itself.  An empty/partial line (timeout/KILL, a real
+    wedge) classifies False."""
+    return d.get("backend") == "cpu"
+
+
+def main(argv: list[str]) -> int:
     try:
         d = json.load(sys.stdin)
     except Exception:
         return 1
-    return 0 if accept(d) else 1
+    pred = cpu_fallback if "--cpu-fallback" in argv else accept
+    return 0 if pred(d) else 1
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

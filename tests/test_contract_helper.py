@@ -1,0 +1,46 @@
+"""utils/contract.py — the SIGTERM→exception contract shared by every
+measurement CLI (bench.py, scripts/*_check.py, scripts/golden_capture.py).
+
+A timeout TERM must unwind as an exception so the finally-block contract
+line still reaches stdout (the round-1 empty-artifact failure mode).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_sigterm_raises_timeout_error():
+    old = signal.getsignal(signal.SIGTERM)
+    try:
+        sigterm_to_exception("unit test")
+        with pytest.raises(TimeoutError, match="unit test"):
+            os.kill(os.getpid(), signal.SIGTERM)
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+def test_golden_capture_contract_line_on_failure():
+    """No weights for a bogus model id -> ok:false contract line, rc!=0
+    (the watcher relies on the line for attribution, the rc for banking)."""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "golden_capture.py"),
+         "--model-id", "bogus/nonexistent"],
+        env=env, capture_output=True, text=True, timeout=240, cwd=REPO,
+    )
+    assert r.returncode != 0
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    assert d["ok"] is False and "error" in d

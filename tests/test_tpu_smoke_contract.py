@@ -80,3 +80,24 @@ def test_watcher_filter_accepts_only_tpu_ok():
     assert not accept({"backend": "tpu", "value": 18.0, "live": False})
     assert not accept({"backend": "tpu", "value": 0.0, "live": True})
     assert not accept({"backend": "tpu"})  # malformed/empty-ish line
+
+
+def test_watcher_cpu_fallback_classifier():
+    """--cpu-fallback mode: flap (cpu line) vs real wedge (tpu line,
+    empty, or garbage) — drives the cache-forfeit and smoke-try-cap
+    decisions in tpu_watch.sh."""
+    filt = os.path.join(REPO, "scripts", "watch_filter.py")
+    with open(os.path.join(REPO, "scripts", "tpu_watch.sh")) as f:
+        assert "watch_filter.py --cpu-fallback" in f.read()
+
+    def is_flap(text):
+        r = subprocess.run(
+            [sys.executable, filt, "--cpu-fallback"], input=text,
+            capture_output=True, text=True, timeout=30,
+        )
+        return r.returncode == 0
+
+    assert is_flap(json.dumps({"backend": "cpu", "ok": False}))
+    assert not is_flap(json.dumps({"backend": "tpu", "ok": False}))
+    assert not is_flap("")          # timeout/KILL: no line
+    assert not is_flap('{"backe')   # partial line
