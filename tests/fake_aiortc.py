@@ -1,0 +1,269 @@
+"""In-process stand-in for the ``aiortc`` package.
+
+The real aiortc is not installable in this environment (zero egress), so
+``AiortcProvider`` (server/signaling.py) would otherwise never execute.
+This module implements the EXACT API surface the reference drives —
+documented at reference agent.py:13-20 (imports), :72-77 (force_codec,
+``codec.mimeType``), :149-152 (``RTCRtpSender.getCapabilities`` /
+``codec.name`` / ``setCodecPreferences``), :256-263 (the name-mangled
+``pc._RTCPeerConnection__gather()`` OBS workaround), :123-395 (pc event
+decorators, setRemoteDescription/createAnswer/setLocalDescription,
+localDescription, connectionState) — so the provider and the agent's
+aiortc-specific wiring run for real, pinned against that contract.
+
+``install()`` registers ``aiortc`` and ``aiortc.rtcrtpsender`` in
+sys.modules and returns this module for introspection (created pcs are
+recorded in ``PEER_CONNECTIONS``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import types
+
+import numpy as np
+
+PEER_CONNECTIONS: list["RTCPeerConnection"] = []
+
+
+class RTCSessionDescription:
+    def __init__(self, sdp: str, type: str):
+        self.sdp = sdp
+        self.type = type
+
+
+class RTCIceServer:
+    def __init__(self, urls, username=None, credential=None):
+        self.urls = urls
+        self.username = username
+        self.credential = credential
+
+
+class RTCConfiguration:
+    def __init__(self, iceServers=None):
+        self.iceServers = iceServers or []
+
+
+class RTCRtpCodecCapability:
+    """Real aiortc capabilities expose BOTH mimeType ("video/H264") and a
+    short name ("H264") — the reference filters on each in different spots
+    (agent.py:76 vs :151)."""
+
+    def __init__(self, mimeType: str, clockRate: int = 90000):
+        self.mimeType = mimeType
+        self.clockRate = clockRate
+
+    @property
+    def name(self) -> str:
+        return self.mimeType.split("/", 1)[1]
+
+    def __repr__(self):
+        return f"Codec({self.mimeType})"
+
+
+class _Capabilities:
+    def __init__(self, codecs):
+        self.codecs = codecs
+
+
+class RTCRtpSender:
+    _VIDEO_CODECS = [
+        RTCRtpCodecCapability("video/VP8"),
+        RTCRtpCodecCapability("video/rtx"),
+        RTCRtpCodecCapability("video/H264"),
+        RTCRtpCodecCapability("video/VP9"),
+    ]
+
+    def __init__(self, track=None):
+        self.track = track
+
+    @classmethod
+    def getCapabilities(cls, kind: str):
+        if kind != "video":
+            return _Capabilities([])
+        return _Capabilities(list(cls._VIDEO_CODECS))
+
+
+class _Transceiver:
+    def __init__(self, kind: str, sender: RTCRtpSender):
+        self.kind = kind
+        self.sender = sender
+        self.codec_preferences = None
+
+    def setCodecPreferences(self, prefs):
+        if not prefs:
+            raise ValueError("codec preferences must not be empty")
+        self.codec_preferences = list(prefs)
+
+
+class _EventEmitter:
+    def __init__(self):
+        self._handlers: dict[str, list] = {}
+
+    def on(self, event: str, f=None):
+        def register(fn):
+            self._handlers.setdefault(event, []).append(fn)
+            return fn
+
+        return register(f) if f else register
+
+    def _emit(self, event: str, *args):
+        """Run handlers; async handlers are scheduled like aiortc's
+        AsyncIOEventEmitter does."""
+        for fn in self._handlers.get(event, []):
+            r = fn(*args)
+            if asyncio.iscoroutine(r):
+                asyncio.ensure_future(r)
+
+
+class RemoteVideoTrack(_EventEmitter):
+    """Remote media track announced by setRemoteDescription; recv() yields
+    bare uint8 HWC ndarrays — one of the duck-typed frame forms the
+    pipeline's coerce_frame accepts (reference frame contract
+    lib/tracks.py:34-37; av.VideoFrame-shaped objects are exercised by the
+    loopback/native tiers)."""
+
+    kind = "video"
+
+    def __init__(self, width=64, height=64):
+        super().__init__()
+        self._w, self._h = width, height
+        self._i = 0
+
+    async def recv(self):
+        self._i += 1
+        frame = np.full((self._h, self._w, 3), self._i % 255, np.uint8)
+        return frame
+
+
+class FakeDataChannel(_EventEmitter):
+    label = "control"
+
+    def __init__(self):
+        super().__init__()
+        self.sent: list = []
+
+    def send(self, m):
+        self.sent.append(m)
+
+    async def deliver(self, message: str):
+        """Test hook: run the registered on('message') handlers to
+        completion (the agent's handler is async)."""
+        for fn in self._handlers.get("message", []):
+            r = fn(message)
+            if asyncio.iscoroutine(r):
+                await r
+
+
+class RTCPeerConnection(_EventEmitter):
+    """NOTE: the class must be named exactly ``RTCPeerConnection`` — the
+    agent's OBS workaround calls the name-mangled private
+    ``pc._RTCPeerConnection__gather()`` (reference agent.py:256-263), which
+    only resolves against this class name."""
+
+    def __init__(self, configuration=None):
+        super().__init__()
+        self.configuration = configuration
+        self.connectionState = "new"
+        self.iceConnectionState = "new"
+        self.localDescription = None
+        self.remoteDescription = None
+        self.gather_calls = 0
+        self._transceivers: list[_Transceiver] = []
+        self.data_channels: list[FakeDataChannel] = []
+        self.remote_tracks: list[RemoteVideoTrack] = []
+        PEER_CONNECTIONS.append(self)
+
+    # -- media plumbing ----------------------------------------------------
+    def addTransceiver(self, kind: str):
+        t = _Transceiver(kind, RTCRtpSender())
+        self._transceivers.append(t)
+        return t
+
+    def getTransceivers(self):
+        return list(self._transceivers)
+
+    def addTrack(self, track):
+        sender = RTCRtpSender(track)
+        self._transceivers.append(_Transceiver("video", sender))
+        return sender
+
+    # -- signaling ---------------------------------------------------------
+    async def setRemoteDescription(self, desc):
+        if "m=" not in desc.sdp:
+            # aiortc raises ValueError on an offer with no media sections;
+            # the agent maps this to HTTP 400
+            raise ValueError("offer has no media sections")
+        self.remoteDescription = desc
+        if "m=video" in desc.sdp:
+            track = RemoteVideoTrack()
+            self.remote_tracks.append(track)
+            self._emit("track", track)
+        if "m=application" in desc.sdp:
+            ch = FakeDataChannel()
+            self.data_channels.append(ch)
+            self._emit("datachannel", ch)
+
+    async def __gather(self):  # mangles to _RTCPeerConnection__gather
+        self.gather_calls += 1
+
+    async def createAnswer(self):
+        if self.remoteDescription is None:
+            raise ValueError("no remote description set")
+        lines = ["v=0", "o=- 0 0 IN IP4 127.0.0.1", "s=-", "t=0 0"]
+        if "m=video" in self.remoteDescription.sdp or any(
+            t.kind == "video" for t in self._transceivers
+        ):
+            lines += [
+                "m=video 9 UDP/TLS/RTP/SAVPF 102",
+                "a=rtpmap:102 H264/90000",
+            ]
+            if self.gather_calls:
+                # non-trickle: candidates inline (the point of __gather)
+                lines.append(
+                    "a=candidate:1 1 udp 2130706431 127.0.0.1 40000 typ host"
+                )
+        return RTCSessionDescription(sdp="\r\n".join(lines) + "\r\n", type="answer")
+
+    async def setLocalDescription(self, desc):
+        self.localDescription = desc
+        self.connectionState = "connecting"
+
+    async def close(self):
+        if self.connectionState == "closed":
+            return
+        self.connectionState = "closed"
+        self.iceConnectionState = "closed"
+        self._emit("connectionstatechange")
+
+    # -- test hooks --------------------------------------------------------
+    async def simulate_state(self, state: str):
+        """Drive connectionstatechange handlers to completion."""
+        self.connectionState = state
+        for fn in self._handlers.get("connectionstatechange", []):
+            r = fn()
+            if asyncio.iscoroutine(r):
+                await r
+
+
+def install() -> types.ModuleType:
+    """Register fake 'aiortc' + 'aiortc.rtcrtpsender' modules and return
+    the aiortc module object.  Idempotent; clears PEER_CONNECTIONS."""
+    PEER_CONNECTIONS.clear()
+    mod = types.ModuleType("aiortc")
+    mod.RTCConfiguration = RTCConfiguration
+    mod.RTCIceServer = RTCIceServer
+    mod.RTCPeerConnection = RTCPeerConnection
+    mod.RTCSessionDescription = RTCSessionDescription
+    sub = types.ModuleType("aiortc.rtcrtpsender")
+    sub.RTCRtpSender = RTCRtpSender
+    mod.rtcrtpsender = sub
+    sys.modules["aiortc"] = mod
+    sys.modules["aiortc.rtcrtpsender"] = sub
+    return mod
+
+
+def uninstall() -> None:
+    sys.modules.pop("aiortc", None)
+    sys.modules.pop("aiortc.rtcrtpsender", None)
