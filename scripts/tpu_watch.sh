@@ -72,7 +72,14 @@ run_item() {  # $1=label  $2=timeout-seconds  rest=command
   # CPython's C-level handler) — escalate to SIGKILL after a grace period
   # so one wedged item cannot block the whole queue.  The lease-leak risk
   # of KILL is accepted: a never-returning claim has already leaked it.
-  out=$(timeout -k 180 -s TERM "$tmo" "$@" 2>>"$LOG")
+  # BENCH_CHILD_TIMEOUT_S: bench.py's measurement child gets this item's
+  # budget minus a margin, so the parent's graceful replay line always
+  # beats our TERM/KILL (a fixed child default would cap slow-but-legal
+  # first compiles, e.g. sdxl1024 under its 3600s budget).
+  local child_tmo="$tmo"
+  [ "$tmo" -gt 600 ] && child_tmo=$(( tmo - 300 ))
+  out=$(BENCH_CHILD_TIMEOUT_S="$child_tmo" \
+        timeout -k 180 -s TERM "$tmo" "$@" 2>>"$LOG")
   line=$(printf '%s\n' "$out" | tail -1)
   RUN_ITEM_LINE="$line"  # exposed so callers can classify a failure
   # acceptance predicate lives in scripts/watch_filter.py so the test

@@ -88,3 +88,29 @@ def test_contract_line_happy_path_tiny():
     assert "stage_ms" in d and set(d["stage_ms"]) == {
         "upload", "compute", "readback"
     }
+
+
+def test_wedged_child_still_replays_committed_number(tmp_path):
+    """r3 failure mode: the measurement wedges in an uninterruptible remote
+    call.  The parent process (which never imports jax) must kill the child
+    at BENCH_CHILD_TIMEOUT_S and still emit the committed replay line."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    entry = {
+        "metric": "e2e_fps_sdxl1024_singlechip", "value": 12.3, "unit": "fps",
+        "vs_baseline": 0.41, "backend": "tpu",
+        "recorded_at": "2026-07-31T04:00:00+00:00",
+    }
+    log.write_text(json.dumps(entry) + "\n")
+    # sdxl1024: the child cannot even finish imports + SDXL param init
+    # within 3s on any machine, so the kill path is deterministic (a tiny
+    # config could legitimately finish before the timeout on a warm box)
+    r = _run_bench(
+        {"JAX_PLATFORMS": "cpu", "PERF_LOG_PATH": str(log),
+         "BENCH_CHILD_TIMEOUT_S": "3"},
+        args=("--frames", "1", "--probe-timeout", "60"),
+        config="sdxl1024", timeout=180,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert d["value"] == 12.3 and d["live"] is False
+    assert "wedged" in d["live_attempt"]["error"]
