@@ -241,7 +241,7 @@ def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4,
 
 
 def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
-                          active=None):
+                          active=None, pipeline_depth=None):
     """Most recent committed TPU measurement for ``metric`` from
     PERF_LOG.jsonl (appended + git-committed by scripts/tpu_watch.sh the
     moment a tunnel claim succeeds).  Used ONLY when the accelerator is
@@ -285,6 +285,7 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                     and d.get("quant") == quant
                     and d.get("peers") == peers
                     and d.get("active") == active
+                    and d.get("pipeline_depth") == pipeline_depth
                     # entries predating the variant fields match any
                     # variant (there are no such TPU entries in this repo's
                     # committed log; tolerated for external logs)
@@ -311,6 +312,7 @@ def _maybe_replay(result: dict) -> dict:
         replay = _replay_from_perf_log(
             result["metric"], fbs=result.get("fbs"), quant=result.get("quant"),
             peers=result.get("peers"), active=result.get("active"),
+            pipeline_depth=result.get("pipeline_depth"),
         )
         if replay is None:
             return result
@@ -421,10 +423,17 @@ def main():
                          "the below-capacity bucket path)")
     ap.add_argument("--fbs", type=int, default=1,
                     help="frames per stream-batch step (frame_buffer_size)")
+    ap.add_argument("--pipeline-depth", type=int, default=4,
+                    help="frames in flight (submit->fetch lag); the lever "
+                         "that hides dispatch RTT, which dominates under a "
+                         "tunneled chip (PERF.md)")
     ap.add_argument("--probe-timeout", type=int, default=300,
                     help="seconds to wait for backend init before declaring "
                          "the accelerator unreachable (0 = skip probe)")
     args = ap.parse_args()
+    # same clamp as the serving path (server/tracks.py): depth 0 would blow
+    # up ThreadPoolExecutor instead of measuring synchronously
+    args.pipeline_depth = max(1, args.pipeline_depth)
 
     # The contract line MUST be printed on every exit path (round-1 failure
     # mode: backend init raised before any JSON was emitted — BENCH_r01.json
@@ -447,6 +456,8 @@ def main():
     # replay lookup matches only same-config PERF_LOG entries
     if args.fbs > 1:
         result["fbs"] = args.fbs
+    if args.pipeline_depth != 4:
+        result["pipeline_depth"] = args.pipeline_depth
     if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
         result["quant"] = "w8"
     if args.config == "multipeer":
@@ -500,9 +511,12 @@ def main():
             result["compilation_cache"] = True
 
         if args.config == "multipeer":
-            r = run_bench_multipeer(args.frames, args.peers, active=args.active)
+            r = run_bench_multipeer(args.frames, args.peers,
+                                    pipeline_depth=args.pipeline_depth,
+                                    active=args.active)
         else:
-            r = run_bench(args.config, args.frames, fbs=args.fbs)
+            r = run_bench(args.config, args.frames,
+                          pipeline_depth=args.pipeline_depth, fbs=args.fbs)
         result.update(
             value=round(r["fps"], 2),
             vs_baseline=round(r["fps"] / 30.0, 3),

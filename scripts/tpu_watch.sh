@@ -177,6 +177,10 @@ while true; do
   run_item "numerics" 1800 python -u scripts/tpu_numerics_check.py
   # 3. the headline config with stage_ms + MFU
   run_item "turbo512_f60" 2400 python -u bench.py --config turbo512 --frames 60
+  # dispatch-RTT hiding: deeper pipeline, same executable — but a fresh
+  # process still re-pays the compile when the persistent cache was
+  # dropped, so it gets the same budget as the other bench items
+  run_item "turbo512_pd8" 2400 python -u bench.py --config turbo512 --frames 60 --pipeline-depth 8
   # 4. full-step cross-check (pallas vs xla, bf16 gauge): 3 more compiles
   run_item "numerics_full" 3600 python -u scripts/tpu_numerics_check.py --full
   # 5. AOT cache on hardware: build+serve, then fresh-process reload
