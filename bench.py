@@ -266,7 +266,7 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
 
     want_attn = attn_impl_default("tpu")
     want_fused = fused_epilogue_default("tpu")
-    best = None
+    best_same_variant = best_any_variant = None
     try:
         with open(path) as f:
             for line in f:
@@ -274,7 +274,7 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                     d = json.loads(line)
                 except ValueError:
                     continue
-                if (
+                if not (
                     d.get("metric") == metric
                     and d.get("backend") == "tpu"
                     and d.get("value", 0) > 0
@@ -286,16 +286,21 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                     and d.get("peers") == peers
                     and d.get("active") == active
                     and d.get("pipeline_depth") == pipeline_depth
-                    # entries predating the variant fields match any
-                    # variant (there are no such TPU entries in this repo's
-                    # committed log; tolerated for external logs)
-                    and d.get("attn_impl", want_attn) == want_attn
-                    and d.get("fused_epilogue", want_fused) == want_fused
                 ):
-                    best = d
+                    continue
+                best_any_variant = d
+                # entries predating the variant fields count as same-variant
+                # (none exist in this repo's committed log; tolerated for
+                # external logs)
+                if (d.get("attn_impl", want_attn) == want_attn
+                        and d.get("fused_epilogue", want_fused) == want_fused):
+                    best_same_variant = d
     except OSError:
         return None
-    return best
+    # a different-variant entry (e.g. only the safe xla/unfused path banked
+    # before the tunnel died) is still honest evidence: the line carries its
+    # own attn_impl/fused_epilogue labels — far better than value 0.0
+    return best_same_variant or best_any_variant
 
 
 def _maybe_replay(result: dict) -> dict:

@@ -114,3 +114,34 @@ def test_wedged_child_still_replays_committed_number(tmp_path):
     d = _contract_line(r.stdout)
     assert d["value"] == 12.3 and d["live"] is False
     assert "wedged" in d["live_attempt"]["error"]
+
+
+def test_replay_prefers_same_variant_then_falls_back_labeled(tmp_path):
+    """Two-tier replay: a same-variant entry wins; with only a safe-path
+    (xla/unfused) entry committed, the default-variant request still emits
+    it — the line self-describes its variant, which beats value 0.0."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    safe = {
+        "metric": "e2e_fps_turbo512_singlechip", "value": 17.9, "unit": "fps",
+        "vs_baseline": 0.597, "backend": "tpu", "attn_impl": "xla",
+        "fused_epilogue": False, "recorded_at": "2026-07-31T05:00:00+00:00",
+    }
+    log.write_text(json.dumps(safe) + "\n")
+    # pin the wanted variant to the TPU defaults: an exported ATTN_IMPL or
+    # FUSED_EPILOGUE on the host would otherwise turn the fallback phase
+    # into a tier-1 match
+    env = {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": str(log),
+           "ATTN_IMPL": "", "FUSED_EPILOGUE": ""}
+    r = _run_bench(env)
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert d["value"] == 17.9 and d["live"] is False
+    assert d["attn_impl"] == "xla" and d["fused_epilogue"] is False
+
+    # same-variant entry present -> it wins over the safe one
+    default = dict(safe, value=29.0, attn_impl="pallas", fused_epilogue=True)
+    log.write_text(json.dumps(safe) + "\n" + json.dumps(default) + "\n")
+    r = _run_bench(env)
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert d["value"] == 29.0 and d["attn_impl"] == "pallas"
