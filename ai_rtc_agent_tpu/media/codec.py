@@ -47,12 +47,38 @@ class H264Encoder:
         if lib is None or not lib.tr_h264_available():
             raise RuntimeError("native H.264 not available (libavcodec 5.x required)")
         self._lib = lib
-        bitrate = bitrate or env.get_int("ENC_DEFAULT_BITRATE", 3_000_000)
-        preset = preset or env.get_str("ENC_PRESET", "ultrafast")
-        tune = tune or env.get_str("ENC_TUNING_INFO", "zerolatency")
-        self._enc = lib.tr_h264_encoder_create(
-            width, height, fps, 1, bitrate, gop, preset.encode(), tune.encode()
+        # each ENC_* accepts the reference's NVENC_* spelling as a migration
+        # alias (ref docs/environment.md:17-25)
+        bitrate = bitrate or env.get_int(
+            "ENC_DEFAULT_BITRATE", env.get_int("NVENC_DEFAULT_BITRATE", 3_000_000)
         )
+        preset = preset or env.get_str(
+            "ENC_PRESET", env.get_str("NVENC_PRESET", "ultrafast")
+        )
+        tune = tune or env.get_str(
+            "ENC_TUNING_INFO", env.get_str("NVENC_TUNING_INFO", "zerolatency")
+        )
+        # rate-control bounds as x264 VBV
+        min_rate = env.get_int("ENC_MIN_BITRATE", env.get_int("NVENC_MIN_BITRATE", 0))
+        max_rate = env.get_int("ENC_MAX_BITRATE", env.get_int("NVENC_MAX_BITRATE", 0))
+        if (min_rate or max_rate) and hasattr(lib, "tr_h264_encoder_create_rc"):
+            self._enc = lib.tr_h264_encoder_create_rc(
+                width, height, fps, 1, bitrate, min_rate, max_rate, gop,
+                preset.encode(), tune.encode()
+            )
+        else:
+            if min_rate or max_rate:
+                # a stale committed .so predating the rc export: an operator
+                # who set a bandwidth cap must not silently run uncapped
+                logger.warning(
+                    "ENC_MIN/MAX_BITRATE set but the loaded native library "
+                    "lacks tr_h264_encoder_create_rc — bounds NOT enforced "
+                    "(rebuild native/)"
+                )
+            self._enc = lib.tr_h264_encoder_create(
+                width, height, fps, 1, bitrate, gop, preset.encode(),
+                tune.encode()
+            )
         if not self._enc:
             raise RuntimeError("failed to open H.264 encoder")
         self.width, self.height = width, height

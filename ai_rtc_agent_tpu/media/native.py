@@ -103,6 +103,12 @@ def _declare(lib: ctypes.CDLL):
     lib.tr_h264_encoder_create.argtypes = [
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_int64, c.c_int, c.c_char_p, c.c_char_p,
     ]
+    if hasattr(lib, "tr_h264_encoder_create_rc"):  # absent in pre-r3 builds
+        lib.tr_h264_encoder_create_rc.restype = c.c_void_p
+        lib.tr_h264_encoder_create_rc.argtypes = [
+            c.c_int, c.c_int, c.c_int, c.c_int, c.c_int64, c.c_int64,
+            c.c_int64, c.c_int, c.c_char_p, c.c_char_p,
+        ]
     lib.tr_h264_encode.restype = c.c_int64
     lib.tr_h264_encode.argtypes = [
         c.c_void_p, u8p, c.c_int64, u8p, c.c_int64, c.POINTER(c.c_int),

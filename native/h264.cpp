@@ -198,13 +198,16 @@ extern "C" {
 
 int tr_h264_available() { return load_libs() != nullptr; }
 
+void tr_h264_encoder_destroy(Encoder *e);  // used by create's error path
+
 // ---------------------------------------------------------------------------
 // encoder
 // ---------------------------------------------------------------------------
 
-Encoder *tr_h264_encoder_create(int w, int h, int fps_num, int fps_den,
-                                int64_t bitrate, int gop, const char *preset,
-                                const char *tune) {
+Encoder *tr_h264_encoder_create_rc(int w, int h, int fps_num, int fps_den,
+                                   int64_t bitrate, int64_t min_rate,
+                                   int64_t max_rate, int gop,
+                                   const char *preset, const char *tune) {
     Libs *L = load_libs();
     if (!L) return nullptr;
     const void *codec = L->avcodec_find_encoder(AV_CODEC_ID_H264);
@@ -223,6 +226,19 @@ Encoder *tr_h264_encoder_create(int w, int h, int fps_num, int fps_den,
     L->av_opt_set(e->ctx, "b", buf, 0);
     snprintf(buf, sizeof buf, "%d", gop);
     L->av_opt_set(e->ctx, "g", buf, 0);
+    // rate-control bounds (ENC_MIN/MAX_BITRATE — parity with the
+    // reference's NVENC_MIN/MAX_BITRATE, ref docs/environment.md:17-25).
+    // x264 VBV needs maxrate AND bufsize; one second of max rate keeps
+    // the cap effective without starving zerolatency tuning.
+    if (min_rate > 0) {
+        snprintf(buf, sizeof buf, "%lld", static_cast<long long>(min_rate));
+        L->av_opt_set(e->ctx, "minrate", buf, 0);
+    }
+    if (max_rate > 0) {
+        snprintf(buf, sizeof buf, "%lld", static_cast<long long>(max_rate));
+        L->av_opt_set(e->ctx, "maxrate", buf, 0);
+        L->av_opt_set(e->ctx, "bufsize", buf, 0);
+    }
     // zero-latency tuning (the ENC_PRESET/ENC_TUNING_INFO control surface —
     // parity with the reference's NVENC_PRESET/NVENC_TUNING_INFO,
     // docs/environment.md:17-25)
@@ -239,14 +255,23 @@ Encoder *tr_h264_encoder_create(int w, int h, int fps_num, int fps_den,
     e->frame->width = w;
     e->frame->height = h;
     e->frame->format = AV_PIX_FMT_YUV420P;
-    if (L->av_frame_get_buffer(e->frame, 32) < 0) {
-        delete e;
-        return nullptr;
-    }
     e->pkt = L->av_packet_alloc();
     e->sws = L->sws_getContext(w, h, AV_PIX_FMT_RGB24, w, h, AV_PIX_FMT_YUV420P,
                                SWS_BILINEAR, nullptr, nullptr, nullptr);
+    // every allocation checked: a partial Encoder must not leak the opened
+    // codec context, and a null sws context would segfault in tr_h264_encode
+    if (!e->pkt || !e->sws || L->av_frame_get_buffer(e->frame, 32) < 0) {
+        tr_h264_encoder_destroy(e);
+        return nullptr;
+    }
     return e;
+}
+
+Encoder *tr_h264_encoder_create(int w, int h, int fps_num, int fps_den,
+                                int64_t bitrate, int gop, const char *preset,
+                                const char *tune) {
+    return tr_h264_encoder_create_rc(w, h, fps_num, fps_den, bitrate, 0, 0,
+                                     gop, preset, tune);
 }
 
 // Encode one RGB24 frame (w*h*3 bytes). Writes annex-B bytes to out.

@@ -234,3 +234,32 @@ def test_packetizer_boundary_au_sizes(native_lib):
         else:
             assert len(pkts) >= 2, nal_len  # FU-A fragmentation engaged
     p.close()
+
+
+def test_h264_rate_control_bounds(native_lib, monkeypatch):
+    """ENC_MIN/MAX_BITRATE (NVENC_* accepted as aliases — reference
+    docs/environment.md:17-25): the rc-bound encoder must open via
+    tr_h264_encoder_create_rc and still produce a decodable stream."""
+    if not native_lib.tr_h264_available():
+        pytest.skip("libavcodec 5.x not present")
+    assert hasattr(native_lib, "tr_h264_encoder_create_rc")
+    from ai_rtc_agent_tpu.media.codec import H264Decoder, H264Encoder
+
+    monkeypatch.setenv("NVENC_MAX_BITRATE", "800000")  # alias spelling
+    monkeypatch.setenv("ENC_MIN_BITRATE", "100000")
+    w, h = 128, 96
+    enc = H264Encoder(w, h, fps=30)
+    dec = H264Decoder()
+    rng = np.random.default_rng(5)
+    decoded = 0
+    for i in range(8):
+        f = rng.integers(0, 256, (h, w, 3), np.uint8)
+        data = enc.encode(f, pts=i)
+        if data and dec.decode(data, pts=i) is not None:
+            decoded += 1
+    data = enc.flush()
+    if data and dec.decode(data) is not None:
+        decoded += 1
+    assert decoded >= 1
+    enc.close()
+    dec.close()
