@@ -47,20 +47,20 @@ class H264Encoder:
         if lib is None or not lib.tr_h264_available():
             raise RuntimeError("native H.264 not available (libavcodec 5.x required)")
         self._lib = lib
-        # each ENC_* accepts the reference's NVENC_* spelling as a migration
-        # alias (ref docs/environment.md:17-25)
-        bitrate = bitrate or env.get_int(
-            "ENC_DEFAULT_BITRATE", env.get_int("NVENC_DEFAULT_BITRATE", 3_000_000)
+        # each ENC_* accepts the reference's NVENC_* spelling as a lazy
+        # migration alias (ref docs/environment.md:17-25)
+        bitrate = bitrate or env.get_int_aliased(
+            "ENC_DEFAULT_BITRATE", "NVENC_DEFAULT_BITRATE", 3_000_000
         )
-        preset = preset or env.get_str(
-            "ENC_PRESET", env.get_str("NVENC_PRESET", "ultrafast")
+        preset = preset or env.get_str_aliased(
+            "ENC_PRESET", "NVENC_PRESET", "ultrafast"
         )
-        tune = tune or env.get_str(
-            "ENC_TUNING_INFO", env.get_str("NVENC_TUNING_INFO", "zerolatency")
+        tune = tune or env.get_str_aliased(
+            "ENC_TUNING_INFO", "NVENC_TUNING_INFO", "zerolatency"
         )
         # rate-control bounds as x264 VBV
-        min_rate = env.get_int("ENC_MIN_BITRATE", env.get_int("NVENC_MIN_BITRATE", 0))
-        max_rate = env.get_int("ENC_MAX_BITRATE", env.get_int("NVENC_MAX_BITRATE", 0))
+        min_rate = env.get_int_aliased("ENC_MIN_BITRATE", "NVENC_MIN_BITRATE", 0)
+        max_rate = env.get_int_aliased("ENC_MAX_BITRATE", "NVENC_MAX_BITRATE", 0)
         if (min_rate or max_rate) and hasattr(lib, "tr_h264_encoder_create_rc"):
             self._enc = lib.tr_h264_encoder_create_rc(
                 width, height, fps, 1, bitrate, min_rate, max_rate, gop,

@@ -61,6 +61,23 @@ def get_bool(name: str, default: bool = False) -> bool:
     return v.strip().lower() not in ("0", "false", "no", "off")
 
 
+def get_str_aliased(name: str, alias: str, default: str | None = None):
+    """get_str with a legacy alias consulted ONLY when ``name`` is unset —
+    lazy, so a stale/invalid alias can't shadow a valid primary value
+    (ENC_* vars accept the reference's NVENC_* spellings this way)."""
+    v = os.getenv(name)
+    if v not in (None, ""):
+        return v
+    return get_str(alias, default)
+
+
+def get_int_aliased(name: str, alias: str, default: int) -> int:
+    """get_int with a lazy legacy alias (see get_str_aliased)."""
+    if os.getenv(name) not in (None, ""):
+        return get_int(name, default)
+    return get_int(alias, default)
+
+
 # Graph-variant resolvers (jax-free) ----------------------------------------
 # THE single definitions of the serving-graph variant defaults, parameterized
 # on the backend name so they are usable where jax must not be imported (the

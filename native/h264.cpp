@@ -217,6 +217,10 @@ Encoder *tr_h264_encoder_create_rc(int w, int h, int fps_num, int fps_den,
     e->w = w;
     e->h = h;
     e->ctx = L->avcodec_alloc_context3(codec);
+    if (!e->ctx) {
+        delete e;
+        return nullptr;
+    }
     e->ctx->width = w;
     e->ctx->height = h;
     e->ctx->pix_fmt = AV_PIX_FMT_YUV420P;
@@ -252,14 +256,18 @@ Encoder *tr_h264_encoder_create_rc(int w, int h, int fps_num, int fps_den,
         return nullptr;
     }
     e->frame = L->av_frame_alloc();
+    // every allocation checked: a partial Encoder must not leak the opened
+    // codec context, and a null frame/sws would segfault in tr_h264_encode
+    if (!e->frame) {
+        tr_h264_encoder_destroy(e);
+        return nullptr;
+    }
     e->frame->width = w;
     e->frame->height = h;
     e->frame->format = AV_PIX_FMT_YUV420P;
     e->pkt = L->av_packet_alloc();
     e->sws = L->sws_getContext(w, h, AV_PIX_FMT_RGB24, w, h, AV_PIX_FMT_YUV420P,
                                SWS_BILINEAR, nullptr, nullptr, nullptr);
-    // every allocation checked: a partial Encoder must not leak the opened
-    // codec context, and a null sws context would segfault in tr_h264_encode
     if (!e->pkt || !e->sws || L->av_frame_get_buffer(e->frame, 32) < 0) {
         tr_h264_encoder_destroy(e);
         return nullptr;

@@ -30,3 +30,9 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: full-geometry tests (minutes on the 1-core CPU box)"
+    )

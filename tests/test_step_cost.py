@@ -1,0 +1,51 @@
+"""FLOP-count invariants of the serving step (XLA cost analysis).
+
+PERF.md's static audit puts the turbo512 bf16 step at ~1.05 TFLOP.  The MFU
+gauge (bench._estimate_mfu) divides exactly this cost_analysis figure by
+fps/peak, so a silent graph regression — e.g. an R-CFG branch accidentally
+doubling the UNet, a VAE running twice, a lost fusion turning the stream
+batch into per-index loops — would both corrupt the MFU number and burn
+real fps.  Pin the step cost inside a loose band at the real served
+geometry (lowering only: trace on CPU, no compile, no device).
+"""
+
+import jax
+import pytest
+
+
+def _step_flops(model_id: str, **overrides) -> float:
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine, make_step_fn
+
+    bundle = registry.load_model_bundle(model_id)
+    cfg = registry.default_stream_config(model_id, **overrides)
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    )
+    eng.prepare("cost analysis prompt", guidance_scale=1.0)
+    import numpy as np
+
+    frame = np.zeros((cfg.height, cfg.width, 3), np.uint8)
+    step = make_step_fn(eng.models, eng.cfg)
+    lowered = jax.jit(step).lower(eng.params, eng.state, frame)
+    cost = lowered.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return float(cost.get("flops", 0.0))
+
+
+@pytest.mark.slow
+def test_turbo512_step_cost_band():
+    """SD-Turbo 1-step img2img @512²: ~1.05 TFLOP/step (PERF.md static
+    audit).  A 2x excursion in either direction means the graph changed
+    shape, not just constants — fail loudly before it reaches hardware."""
+    flops = _step_flops("stabilityai/sd-turbo")
+    assert 0.6e12 < flops < 2.1e12, f"turbo512 step = {flops:.3e} FLOPs"
+
+
+def test_tiny_step_cost_sane():
+    """The hermetic tiny model's step must be orders of magnitude below the
+    flagship — guards against the tiny family accidentally inheriting real
+    geometry (which would silently blow up every CPU test's runtime)."""
+    flops = _step_flops("tiny-test")
+    assert 0 < flops < 5e9, f"tiny64 step = {flops:.3e} FLOPs"
