@@ -26,6 +26,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import random
 import time
 import types
@@ -89,6 +90,8 @@ def patch_loop_datagram(local_ports: List[int]):
 # ---------------------------------------------------------------------------
 
 def apply_runtime_config(pipeline, config: dict):
+    if not isinstance(config, dict):
+        raise ValueError("config must be a JSON object")
     t_index_list = config.get("t_index_list")
     if t_index_list is not None:
         pipeline.update_t_index_list(t_index_list)
@@ -106,7 +109,9 @@ def _wire_datachannel(pipeline, channel, guard=None):
         try:
             # prompt updates run a text-encoder forward — never on the loop
             await asyncio.to_thread(apply_runtime_config, pipeline, json.loads(message))
-        except (ValueError, KeyError) as e:
+        except (ValueError, KeyError, TypeError) as e:
+            # TypeError: structurally-wrong JSON from a hostile/buggy client
+            # (e.g. t_index_list [18, null]) must not escape the handler
             logger.error("bad config message: %s", e)
 
 
@@ -489,13 +494,24 @@ async def update_config(request):
     target = request.app.get("multipeer_pipeline") or request.app["pipeline"]
     try:
         await asyncio.to_thread(apply_runtime_config, target, config)
-    except ValueError as e:
+    except (ValueError, TypeError, KeyError) as e:
+        # TypeError/KeyError: structurally-wrong JSON (t_index_list with
+        # nulls, config that is not an object) is a client error, not a 500
         return web.Response(status=400, text=str(e))
     return web.Response(content_type="application/json", text="OK")
 
 
 async def health(_):
     return web.Response(content_type="application/json", text="OK")
+
+
+async def demo(_):
+    """Self-contained browser client for the /offer path — the reference
+    depends on a hosted web app for this (ref docs/connect.md:3-5)."""
+    path = os.path.join(os.path.dirname(__file__), "static", "demo.html")
+    if not os.path.exists(path):
+        return web.Response(status=404, text="demo page not bundled")
+    return web.FileResponse(path)  # non-blocking file serving
 
 
 async def metrics(request):
@@ -709,6 +725,7 @@ def build_app(
     app.router.add_post("/config", update_config)
     app.router.add_get("/", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/demo", demo)
     return app
 
 

@@ -516,3 +516,39 @@ def test_sp_flag_defaults_attention_to_ring(monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_demo_page_served():
+    """GET /demo: the in-repo browser client (the reference points at a
+    hosted app instead — ref docs/connect.md:3-5)."""
+    async def go():
+        app, client = await _client(FakePipeline())
+        try:
+            r = await client.get("/demo")
+            assert r.status == 200
+            body = await r.text()
+            assert "RTCPeerConnection" in body and "/offer" in body
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_config_structurally_wrong_bodies_are_400():
+    """JSON that parses but is the wrong shape (array body, null t_index
+    entries) must map to 400, never escape as a 500 (hostile/buggy demo
+    clients)."""
+    async def go():
+        app, client = await _client(FakePipeline())
+        try:
+            r = await client.post(
+                "/config", data="[1,2]",
+                headers={"Content-Type": "application/json"},
+            )
+            assert r.status == 400
+            r = await client.post("/config", json={"t_index_list": [18, None]})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    run(go())
