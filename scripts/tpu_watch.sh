@@ -22,7 +22,12 @@ cd /root/repo || exit 1
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_persist_cache}
 LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch_r3.log}
 STOP=/tmp/tpu_watch_stop
+ITEM_LOCK=/tmp/tpu_item.lock  # held while a queue item may own the claim
+rm -f "$STOP"  # a stale stop file from a previous round must not kill us
 echo $$ > /tmp/tpu_watch.pid  # stop with: kill -TERM $(cat /tmp/tpu_watch.pid)
+# every exit path must release the item lock — a dead watcher's lock would
+# stall each driver bench for its full claim-wait budget
+trap 'rm -f "$ITEM_LOCK"' EXIT
 # or touch $STOP for a clean between-items exit (never pkill -f: the pattern
 # matches unrelated shells quoting this path)
 
@@ -78,8 +83,14 @@ run_item() {  # $1=label  $2=timeout-seconds  rest=command
   # first compiles, e.g. sdxl1024 under its 3600s budget).
   local child_tmo="$tmo"
   [ "$tmo" -gt 600 ] && child_tmo=$(( tmo - 300 ))
-  out=$(BENCH_CHILD_TIMEOUT_S="$child_tmo" \
+  # item lock: lets the DRIVER's round-end bench detect an in-flight queue
+  # item and wait for it instead of double-claiming the one chip (the
+  # contention recipe behind wedged claims).  TPU_WATCH_OWNER=1 tells our
+  # own bench items to ignore the lock their watcher wrote.
+  echo $$ > "$ITEM_LOCK"
+  out=$(BENCH_CHILD_TIMEOUT_S="$child_tmo" TPU_WATCH_OWNER=1 \
         timeout -k 180 -s TERM "$tmo" "$@" 2>>"$LOG")
+  rm -f "$ITEM_LOCK"
   line=$(printf '%s\n' "$out" | tail -1)
   RUN_ITEM_LINE="$line"  # exposed so callers can classify a failure
   # acceptance predicate lives in scripts/watch_filter.py so the test
@@ -101,7 +112,9 @@ while true; do
     note "TTL expired — exiting"
     exit 0
   fi
+  echo $$ > "$ITEM_LOCK"  # the probe claims the chip too, briefly
   B=$(timeout -k 60 -s TERM 240 python -c "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
+  rm -f "$ITEM_LOCK"
   if [ "$B" != "tpu" ]; then
     note "tunnel still down ($B)"
     sleep 240

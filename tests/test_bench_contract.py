@@ -16,6 +16,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run_bench(extra_env: dict, args=(), config="turbo512", timeout=180):
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)  # keep the subprocess hermetic
+    # never coordinate with (or stop!) a real watcher running on this box —
+    # tests opt in via an explicit TPU_WATCH_PID
+    env.setdefault("TPU_WATCH_PID", os.devnull)
     env.update(extra_env)
     return subprocess.run(
         [sys.executable, "bench.py", "--config", config, *args],
@@ -145,3 +148,52 @@ def test_replay_prefers_same_variant_then_falls_back_labeled(tmp_path):
     assert r.returncode == 0, r.stderr[-800:]
     d = _contract_line(r.stdout)
     assert d["value"] == 29.0 and d["attn_impl"] == "pallas"
+
+
+def test_bench_yields_to_watcher_item_lock(tmp_path):
+    """Coordination: with a LIVE watcher pid and a fresh item lock, the
+    non-watcher bench writes the stop file and waits for the lock's
+    release before claiming; the watcher's own items (TPU_WATCH_OWNER=1)
+    skip coordination entirely.  Deterministic: the lock is released only
+    AFTER the bench's stop file appears, so subprocess startup time can't
+    race the release."""
+    import threading
+    import time as _time
+
+    lock = tmp_path / "tpu_item.lock"
+    lock.write_text("123\n")
+    stop = tmp_path / "watch_stop"
+    pidfile = tmp_path / "watch.pid"
+    pidfile.write_text(f"{os.getpid()}\n")  # "watcher" = this live process
+
+    def release_after_stop_seen():
+        deadline = _time.time() + 60
+        while _time.time() < deadline and not stop.exists():
+            _time.sleep(0.2)
+        _time.sleep(2)  # bench is now provably inside its wait loop
+        lock.unlink()
+
+    threading.Thread(target=release_after_stop_seen, daemon=True).start()
+    r = _run_bench(
+        {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": os.devnull,
+         "TPU_ITEM_LOCK": str(lock), "TPU_WATCH_STOP": str(stop),
+         "TPU_WATCH_PID": str(pidfile), "BENCH_CLAIM_WAIT_S": "60"},
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert "unreachable" in d["error"]  # proceeded after release
+    assert stop.exists()  # asked the watcher to stand down
+    assert not lock.exists()  # proceeded only after the release
+    assert "claim_contention" not in d
+
+    # owner path: same fresh lock + live pid, no waiting, no stop file
+    lock.write_text("123\n")
+    stop2 = tmp_path / "watch_stop2"
+    r = _run_bench(
+        {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": os.devnull,
+         "TPU_ITEM_LOCK": str(lock), "TPU_WATCH_STOP": str(stop2),
+         "TPU_WATCH_PID": str(pidfile), "TPU_WATCH_OWNER": "1",
+         "BENCH_CLAIM_WAIT_S": "60"},
+    )
+    assert _contract_line(r.stdout)
+    assert not stop2.exists()
