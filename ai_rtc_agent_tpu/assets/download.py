@@ -30,8 +30,13 @@ HF_MODEL_SETS = {
     "turbo": ["stabilityai/sd-turbo", "madebyollin/taesd"],
     "sdxl": ["stabilityai/sdxl-turbo", "madebyollin/taesdxl"],
     # conditioned generation + safety (reference wires these optionally:
-    # lib/wrapper.py:617-643 ControlNet, :930-942 safety checker)
-    "controlnet": ["lllyasviel/control_v11p_sd15_canny"],
+    # lib/wrapper.py:617-643 ControlNet + HED, :930-942 safety checker).
+    # lllyasviel/Annotators carries ControlNetHED.pth for --annotator hed
+    # (models/hed.py searches this snapshot unless HED_CHECKPOINT is set).
+    "controlnet": [
+        "lllyasviel/control_v11p_sd15_canny",
+        "lllyasviel/Annotators",
+    ],
     "safety": ["CompVis/stable-diffusion-safety-checker"],
 }
 HF_MODEL_SETS["default"] = (
@@ -71,12 +76,17 @@ def download_civitai_model(name: str, version_id: str) -> str | None:
     return path
 
 
+# repos where only specific files are needed (lllyasviel/Annotators holds a
+# dozen unrelated multi-GB annotator checkpoints; we use exactly one)
+HF_ALLOW_PATTERNS = {"lllyasviel/Annotators": ["ControlNetHED.pth"]}
+
+
 def download(model_set: str = "default"):
     from huggingface_hub import snapshot_download
 
     for repo in HF_MODEL_SETS[model_set]:
         logger.info("snapshot %s", repo)
-        snapshot_download(repo)
+        snapshot_download(repo, allow_patterns=HF_ALLOW_PATTERNS.get(repo))
     for name, version in CIVITAI_MODELS.items():
         download_civitai_model(name, version)
 
