@@ -1,0 +1,38 @@
+"""scripts/profile_step.py plumbing (SURVEY §5 tracing/profiling).
+
+The profiler CLI is a queue-adjacent operator tool; this pins that it runs
+end-to-end on the hermetic tiny config, emits its one-line JSON summary,
+and actually writes a TensorBoard-loadable trace directory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_step_tiny_writes_trace(tmp_path):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = tmp_path / "trace"
+    r = subprocess.run(
+        [sys.executable, os.path.join("scripts", "profile_step.py"),
+         "--config", "tiny64", "--warm", "1", "--steps", "2",
+         "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[-1])
+    assert d["config"] == "tiny64" and d["traced_steps"] == 2
+    assert d["fps_in_trace"] > 0
+    # a real trace landed (plugins/profile/<run>/*.xplane.pb)
+    found = [
+        f for _, _, files in os.walk(out) for f in files
+        if f.endswith(".xplane.pb")
+    ]
+    assert found, f"no xplane.pb under {out}"
