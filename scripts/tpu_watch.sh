@@ -117,7 +117,7 @@ while true; do
   rm -f "$ITEM_LOCK"
   if [ "$B" != "tpu" ]; then
     note "tunnel still down ($B)"
-    sleep 240
+    sleep 120
     continue
   fi
   note "tunnel OK — running queue (shortest first, commit after each)"
@@ -183,7 +183,7 @@ while true; do
   fi
   if [ -z "$FIRST_OK" ]; then
     note "first bench produced no tpu number; re-polling"
-    sleep 240
+    sleep 120
     continue
   fi
   # 2. kernel numerics at served shapes (fast once the backend is up)
