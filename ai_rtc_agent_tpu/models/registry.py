@@ -136,7 +136,25 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
     base.setdefault(
         "dtype", "bfloat16" if jax.default_backend() == "tpu" else "float32"
     )
-    return StreamConfig(**base)
+    # DeepCache-style temporal UNet feature reuse: UNET_CACHE=N (or
+    # "deepcache:N") runs the full UNet every Nth frame and only the
+    # outermost tier between — opt-in; see StreamConfig.unet_cache_interval
+    env_cache = os.getenv("UNET_CACHE", "")
+    if env_cache and "unet_cache_interval" not in base:
+        n = env_cache.rsplit(":", 1)[-1]
+        try:
+            base["unet_cache_interval"] = int(n)
+        except ValueError as e:
+            raise ValueError(
+                f"UNET_CACHE={env_cache!r}: expected N or deepcache:N"
+            ) from e
+    cfg = StreamConfig(**base)
+    if cfg.unet_cache_interval >= 2 and cfg.use_controlnet:
+        raise ValueError(
+            "UNET_CACHE is incompatible with ControlNet (residuals feed "
+            "the skipped deep blocks) — unset one"
+        )
+    return cfg
 
 
 def _model_configs(fam: str):
@@ -350,6 +368,18 @@ def load_model_bundle(
             attn_impl=attn_impl,
         )
 
+    def unet_capture(p, x, t, ctx, added):
+        return U.apply_unet(
+            p["unet"], x, t, ctx, unet_cfg, added_cond=added,
+            attn_impl=attn_impl, deep_cache="capture",
+        )
+
+    def unet_cached(p, x, t, ctx, added, deep_h):
+        return U.apply_unet(
+            p["unet"], x, t, ctx, unet_cfg, added_cond=added,
+            attn_impl=attn_impl, deep_cache="use", cached_h=deep_h,
+        )
+
     def controlnet_apply(p, x, t, ctx, cond_img, added, scale):
         return CN.apply_controlnet(
             p["controlnet"], x, t, ctx, cond_img, unet_cfg,
@@ -392,6 +422,8 @@ def load_model_bundle(
             vae_encode=vae_encode,
             vae_decode=vae_decode,
             controlnet=controlnet_apply if controlnet is not None else None,
+            unet_capture=unet_capture,
+            unet_cached=unet_cached,
         ),
         encode_prompt=encode_prompt,
         unet_cfg=unet_cfg,

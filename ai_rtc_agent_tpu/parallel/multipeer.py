@@ -68,6 +68,14 @@ class MultiPeerEngine:
         self.encode_prompt = encode_prompt
         self.models = models
         self.params = params
+        if cfg.unet_cache_interval >= 2:
+            # per-peer cadence phases would need per-slot graph selection
+            # inside one vmapped step — not supported; refuse loudly rather
+            # than silently serving without the cache (no-silent-flag-drop)
+            raise ValueError(
+                "unet_cache_interval (UNET_CACHE) is not supported in "
+                "multipeer serving; unset it or drop --multipeer"
+            )
         # template engine used to build per-slot states
         self._template = StreamEngine(
             models, params, cfg, encode_prompt, jit_compile=False

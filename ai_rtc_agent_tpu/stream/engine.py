@@ -87,6 +87,17 @@ class StreamConfig:
     # fallback agree WITHOUT mutating process-global env (a fallback on one
     # pipeline must not silently disable Pallas for pipelines built later).
     attn_impl: str = ""
+    # DeepCache-style temporal UNet feature reuse (UNET_CACHE env / --unet-
+    # cache): every Nth step runs the full UNet and captures the feature
+    # entering the outermost up block; the N-1 steps between recompute only
+    # the outermost tier and splice the cache in.  Sound for the stream
+    # batch because slot i ALWAYS denoises at timestep t_i — the cached
+    # deep features stay timestep-aligned across steps.  0/1 = off.
+    # Opt-in: video coherence makes the approximation good in practice, but
+    # fast scene cuts briefly reuse stale deep features until the next full
+    # step.  Incompatible with ControlNet (residuals feed the skipped deep
+    # blocks) and sequential (non-stream-batch) mode.
+    unet_cache_interval: int = 0
 
     @property
     def n_stages(self) -> int:
@@ -122,6 +133,11 @@ class StreamModels:
     vae_encode: Callable
     vae_decode: Callable
     controlnet: Callable | None = None
+    # DeepCache pair (None = family doesn't support it):
+    #   unet_capture(params, x, t, context, added) -> (model_out, deep_h)
+    #   unet_cached(params, x, t, context, added, deep_h) -> model_out
+    unet_capture: Callable | None = None
+    unet_cached: Callable | None = None
 
 
 def _coeff_state(cfg: StreamConfig, schedule: S.NoiseSchedule, t_index_list):
@@ -150,14 +166,39 @@ def _as_step_coeffs(d) -> L.StepCoeffs:
     )
 
 
-def make_step_fn(models: StreamModels, cfg: StreamConfig):
-    """Build the pure step function (to be jitted/AOT-compiled by the caller)."""
+def make_step_fn(models: StreamModels, cfg: StreamConfig,
+                 unet_variant: str = "full"):
+    """Build the pure step function (to be jitted/AOT-compiled by the caller).
+
+    ``unet_variant``: "full" (plain), or the DeepCache pair — "capture"
+    (full UNet; the deep feature lands in ``state['unet_cache']``) and
+    "cached" (outermost-tier-only UNet consuming ``state['unet_cache']``).
+    The engine alternates the two compiled steps on a host-side cadence
+    (StreamConfig.unet_cache_interval) — static graphs, no data-dependent
+    control flow under jit."""
 
     if cfg.use_controlnet and models.controlnet is None:
         raise ValueError(
             "cfg.use_controlnet=True but StreamModels.controlnet is None — "
             "load the bundle with a controlnet model id"
         )
+    if unet_variant != "full":
+        if models.unet_capture is None or models.unet_cached is None:
+            raise ValueError(
+                "unet_cache_interval set but this model bundle has no "
+                "DeepCache apply pair (unet_capture/unet_cached)"
+            )
+        if cfg.use_controlnet:
+            raise ValueError(
+                "unet_cache_interval is incompatible with ControlNet "
+                "(residuals feed the skipped deep blocks)"
+            )
+        if not cfg.use_denoising_batch:
+            raise ValueError(
+                "unet_cache_interval requires denoising-batch mode (the "
+                "sequential path runs multiple timesteps per slot, so the "
+                "per-slot timestep alignment the cache relies on is lost)"
+            )
     B = cfg.batch_size
     fbs = cfg.frame_buffer_size
     dt = cfg.jdtype
@@ -182,14 +223,21 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
         xb = x_t.shape[0]
 
         def run_unet(x, t, ctx, a, cond):
-            if cond is None:
-                return models.unet(params, x, t, ctx, a)
-            dres, mres = models.controlnet(
-                params, x, t, ctx, cond.astype(dt), a, state["cnet_scale"]
-            )
-            return models.unet(
-                params, x, t, ctx, a, down_residuals=dres, mid_residual=mres
-            )
+            """-> (model_out, deep_h_or_None)."""
+            if cond is not None:  # ControlNet path (unet_variant=="full")
+                dres, mres = models.controlnet(
+                    params, x, t, ctx, cond.astype(dt), a, state["cnet_scale"]
+                )
+                return models.unet(
+                    params, x, t, ctx, a, down_residuals=dres, mid_residual=mres
+                ), None
+            if unet_variant == "capture":
+                return models.unet_capture(params, x, t, ctx, a)
+            if unet_variant == "cached":
+                return models.unet_cached(
+                    params, x, t, ctx, a, state["unet_cache"]
+                ), None
+            return models.unet(params, x, t, ctx, a), None
 
         t = coeffs.timesteps
         added = None
@@ -223,14 +271,14 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                 if cond_img is not None
                 else None
             )
-            out = run_unet(x2, t2, ctx2, added2, cond2)
+            out, new_cache = run_unet(x2, t2, ctx2, added2, cond2)
             eps_u, eps_c = jnp.split(out, 2, axis=0)
             eps = R.combine_full(eps_u, eps_c, state["guidance"])
             new_stock = stock
         else:
-            eps_c = run_unet(x_t, t, cond, added, cond_img)
+            eps_c, new_cache = run_unet(x_t, t, cond, added, cond_img)
             if return_raw:
-                return eps_c, stock
+                return eps_c, stock, new_cache
             if cfg.cfg_type == "none":
                 eps = eps_c
                 new_stock = stock
@@ -244,7 +292,7 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                     )
                 else:
                     new_stock = stock
-        return eps, new_stock
+        return eps, new_stock, new_cache
 
     def step(params, state, frame_u8):
         """frame_u8: [fbs,H,W,3] (or [H,W,3] when fbs==1) uint8 RGB."""
@@ -284,7 +332,7 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                 else x_new
             )
             if fused_ok:
-                eps_c, _ = unet_with_guidance(
+                eps_c, _, new_cache = unet_with_guidance(
                     params, x_t, state, coeffs, state["stock"], cond_full,
                     return_raw=True,
                 )
@@ -322,7 +370,7 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                 out_latent = denoised[B - fbs :]
                 new_buf = advanced[: B - fbs] if B > fbs else state["x_buf"]
             else:
-                eps, new_stock = unet_with_guidance(
+                eps, new_stock, new_cache = unet_with_guidance(
                     params, x_t, state, coeffs, state["stock"], cond_full
                 )
                 if cfg.scheduler == "turbo":
@@ -367,7 +415,7 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
                         )
                     ]
                 )
-                eps, stock_sl = unet_with_guidance(
+                eps, stock_sl, _ = unet_with_guidance(
                     params, x, state, sub, new_stock[sl],
                     cond_full[:fbs] if cond_full is not None else None,
                 )
@@ -396,6 +444,8 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig):
         new_state["stock"] = new_stock
         if cfg.use_controlnet and new_cnet_ring is not None:
             new_state["cnet_cond"] = new_cnet_ring
+        if unet_variant == "capture":
+            new_state["unet_cache"] = new_cache.astype(dt)
         return new_state, out_u8
 
     return step
@@ -450,6 +500,9 @@ def stream_engine_key(model_id: str, cfg: StreamConfig, **extra) -> str:
         # of the key or different graphs collide on one cache entry
         cnet=f"{int(cfg.use_controlnet)}{cfg.annotator if cfg.use_controlnet else ''}",
         fused=int(cfg.use_fused_epilogue),
+        # only when ON, so every pre-existing engine key stays valid
+        **({"dcache": cfg.unet_cache_interval}
+           if cfg.unet_cache_interval >= 2 else {}),
         # the attention impl is baked into the traced graph at bundle build
         # time; without it in the key a Pallas-attention executable could be
         # adopted by a serving process that just fell back to XLA (and vice
@@ -533,8 +586,10 @@ class StreamEngine:
                 )
             params = jax.device_put(params, SH.param_shardings(mesh, params))
         self.params = params
-        step = make_step_fn(models, cfg)
-        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+
+        def _wrap_sp(fn):
+            if mesh is None or mesh.shape.get("sp", 1) <= 1:
+                return fn
             # sequence-parallel serving: activate the sp attention context
             # around the step so ATTN_IMPL=ring/ulysses models route their
             # token axis over the mesh (layers.sp_attention_mesh); the
@@ -542,16 +597,33 @@ class StreamEngine:
             # matters
             from ..models.layers import sp_attention_mesh
 
-            inner = step
-
-            def step(params, state, frame_u8, _inner=inner):
+            def wrapped(params, state, frame_u8, _inner=fn):
                 with sp_attention_mesh(self.mesh, axis="sp"):
                     return _inner(params, state, frame_u8)
 
-        if jit_compile:
-            self._step = jax.jit(step, donate_argnums=(1,) if donate else ())
+            return wrapped
+
+        def _jit(fn):
+            if not jit_compile:
+                return fn
+            return jax.jit(fn, donate_argnums=(1,) if donate else ())
+
+        self._cache_interval = (
+            cfg.unet_cache_interval if cfg.unet_cache_interval >= 2 else 0
+        )
+        self._tick = 0
+        if self._cache_interval:
+            # DeepCache cadence: two static graphs, host-side alternation
+            self._raw_capture_step = _wrap_sp(
+                make_step_fn(models, cfg, unet_variant="capture")
+            )
+            self._step = _jit(self._raw_capture_step)
+            self._step_cached = _jit(
+                _wrap_sp(make_step_fn(models, cfg, unet_variant="cached"))
+            )
         else:
-            self._step = step
+            self._step = _jit(_wrap_sp(make_step_fn(models, cfg)))
+            self._step_cached = None
         self.state = None
         self._skip_count = 0
         self._last_out = None
@@ -639,6 +711,20 @@ class StreamEngine:
             state["stock"] = self.models.unet(
                 self.params, x, coeffs.timesteps, unc, added
             )
+        if self._cache_interval:
+            # pre-size the DeepCache slot (trace-only, no compile) so the
+            # capture step's state pytree is identical on every call —
+            # otherwise the first capture (no cache key) and later captures
+            # (cache key present) would cost two full compiles
+            spec = jax.ShapeDtypeStruct(
+                (cfg.frame_buffer_size, cfg.height, cfg.width, 3), jnp.uint8
+            )
+            shaped, _ = jax.eval_shape(
+                self._raw_capture_step, self.params, state, spec
+            )
+            dh = shaped["unet_cache"]
+            state["unet_cache"] = jnp.zeros(dh.shape, dh.dtype)
+            self._tick = 0  # first real submit captures a fresh cache
         self.state = state
         return self
 
@@ -663,6 +749,11 @@ class StreamEngine:
             # serialized executables are per-topology; the tp/sp serving
             # meshes keep the plain jit path (same policy as
             # MultiPeerEngine.use_aot_cache)
+            return False
+        if self._cache_interval:
+            # DeepCache alternates two executables; the single-engine AOT
+            # adoption keeps the plain jit pair instead (both steps still
+            # hit JAX's persistent compilation cache when enabled)
             return False
         if self.state is None:
             raise RuntimeError("call prepare() first (state defines the signature)")
@@ -728,7 +819,15 @@ class StreamEngine:
                 # synchronous copy (reference NVDEC zero-copy analog,
                 # README.md:11-15)
                 frame_u8 = jax.device_put(frame_u8)
-            self.state, out = self._step(self.params, self.state, frame_u8)
+            fn = self._step
+            if self._cache_interval:
+                # full/capture every Nth step, cached between (static
+                # cadence: both graphs are already compiled, the host just
+                # picks one — no data-dependent control flow on device)
+                if self._tick % self._cache_interval != 0:
+                    fn = self._step_cached
+                self._tick += 1
+            self.state, out = fn(self.params, self.state, frame_u8)
             try:  # overlap device->host copy with subsequent compute
                 out.copy_to_host_async()
             except (AttributeError, RuntimeError):
@@ -805,6 +904,10 @@ class StreamEngine:
                 self.state["added_text"] = jnp.asarray(
                     extras["pooled"], self.cfg.jdtype
                 )
+            # DeepCache: deep cross-attention (where prompt conditioning
+            # lives) must not serve stale features for up to N-1 frames —
+            # force the next step to recapture
+            self._tick = 0
 
     def _encode(self, prompt: str):
         res = self.encode_prompt(prompt)
@@ -827,6 +930,14 @@ class StreamEngine:
         coeffs = _coeff_state(self.cfg, self.schedule, t_index_list)
         with self._submit_lock:
             self.state["coeffs"] = coeffs
+            self._tick = 0  # DeepCache: new timesteps -> recapture next step
+
+    def reset_cache_cadence(self):
+        """DeepCache: make the NEXT step a full capture (called after the
+        build probe and by control-plane updates so stale deep features are
+        never served across a known discontinuity)."""
+        with self._submit_lock:
+            self._tick = 0
 
     def update_guidance(self, guidance_scale=None, delta=None):
         with self._submit_lock:

@@ -135,8 +135,19 @@ class StreamDiffusionPipeline:
         if cfg.frame_buffer_size > 1:
             shape = (cfg.frame_buffer_size,) + shape
         probe = np.zeros(shape, np.uint8)
+
+        def _finish_probe(engine):
+            if getattr(engine, "_cache_interval", 0):
+                # warm the SECOND DeepCache graph too (one probe step only
+                # compiles the capture variant), then restart the cadence so
+                # the first live frame recaptures instead of splicing deep
+                # features of this zero-filled probe
+                engine(probe)
+                engine.reset_cache_cadence()
+
         try:
             self.engine(probe)
+            _finish_probe(self.engine)
             return cfg
         except Exception:
             logger.exception(
@@ -154,6 +165,7 @@ class StreamDiffusionPipeline:
             try:
                 self.engine = build(safe_cfg, bundle=bundle)
                 self.engine(probe)
+                _finish_probe(self.engine)
                 return safe_cfg
             except Exception:
                 if not pallas_attn:
@@ -170,6 +182,7 @@ class StreamDiffusionPipeline:
         self._bundle = None  # xla closures need a fresh bundle; free the old
         self.engine = build(safe_cfg)
         self.engine(probe)  # a failure here is structural: let it raise
+        _finish_probe(self.engine)
         return safe_cfg
 
     # -- control plane (reference lib/pipeline.py:44-48) --------------------

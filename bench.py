@@ -31,7 +31,7 @@ logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 logger = logging.getLogger("bench")
 
 
-def build_engine(config: str, fbs: int = 1):
+def build_engine(config: str, fbs: int = 1, unet_cache: int = 0):
     import jax
 
     from ai_rtc_agent_tpu.models import registry
@@ -59,6 +59,8 @@ def build_engine(config: str, fbs: int = 1):
 
     if fbs > 1:
         overrides["frame_buffer_size"] = fbs
+    if unet_cache >= 2:
+        overrides["unet_cache_interval"] = unet_cache
     bundle = registry.load_model_bundle(model_id, controlnet=controlnet)
     cfg = registry.default_stream_config(model_id, **overrides)
     bundle.params = registry.cast_params(bundle.params, dtype)
@@ -106,7 +108,8 @@ def _pipelined_loop(submit, fetch, make_frame, n_iters: int,
     }, out
 
 
-def run_bench(config: str, frames: int, pipeline_depth: int = 4, fbs: int = 1):
+def run_bench(config: str, frames: int, pipeline_depth: int = 4, fbs: int = 1,
+              unet_cache: int = 0):
     """Streaming benchmark: frames are SUBMITTED as they 'arrive' and results
     fetched ``pipeline_depth`` frames later — the dispatch pipeline stays
     full, exactly like the async serving loop (stream/engine.py submit/fetch).
@@ -116,7 +119,7 @@ def run_bench(config: str, frames: int, pipeline_depth: int = 4, fbs: int = 1):
     lib/wrapper.py:159-163): one dispatch + one readback amortize over fbs
     frames at the cost of fbs frames of extra latency.
     """
-    eng, cfg = build_engine(config, fbs=fbs)
+    eng, cfg = build_engine(config, fbs=fbs, unet_cache=unet_cache)
     rng = np.random.default_rng(0)
     shape = (cfg.height, cfg.width, 3) if fbs == 1 else (fbs, cfg.height, cfg.width, 3)
     frame = rng.integers(0, 256, shape, dtype=np.uint8)
@@ -181,12 +184,23 @@ def _estimate_mfu(eng, frame, fps: float, fbs: int):
     try:
         from ai_rtc_agent_tpu.stream.engine import make_step_fn
 
-        step = make_step_fn(eng.models, eng.cfg)
-        lowered = jax.jit(step).lower(eng.params, eng.state, jax.device_put(frame))
-        cost = lowered.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops = float(cost.get("flops", 0.0))
+        def _flops(variant):
+            step = make_step_fn(eng.models, eng.cfg, unet_variant=variant)
+            lowered = jax.jit(step).lower(
+                eng.params, eng.state, jax.device_put(frame)
+            )
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            return float(cost.get("flops", 0.0))
+
+        n = eng.cfg.unet_cache_interval
+        if n >= 2:
+            # DeepCache mix: full every Nth step, cached between — the MFU
+            # must divide by what actually executed, not the full graph
+            flops = (_flops("capture") + (n - 1) * _flops("cached")) / n
+        else:
+            flops = _flops("full")
     except Exception as e:
         logger.warning("cost analysis unavailable: %s", e)
         return None
@@ -241,7 +255,7 @@ def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4,
 
 
 def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
-                          active=None, pipeline_depth=None):
+                          active=None, pipeline_depth=None, unet_cache=None):
     """Most recent committed TPU measurement for ``metric`` from
     PERF_LOG.jsonl (appended + git-committed by scripts/tpu_watch.sh the
     moment a tunnel claim succeeds).  Used ONLY when the accelerator is
@@ -286,6 +300,7 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                     and d.get("peers") == peers
                     and d.get("active") == active
                     and d.get("pipeline_depth") == pipeline_depth
+                    and d.get("unet_cache") == unet_cache
                 ):
                     continue
                 best_any_variant = d
@@ -318,6 +333,7 @@ def _maybe_replay(result: dict) -> dict:
             result["metric"], fbs=result.get("fbs"), quant=result.get("quant"),
             peers=result.get("peers"), active=result.get("active"),
             pipeline_depth=result.get("pipeline_depth"),
+            unet_cache=result.get("unet_cache"),
         )
         if replay is None:
             return result
@@ -494,6 +510,10 @@ def main():
                     help="frames in flight (submit->fetch lag); the lever "
                          "that hides dispatch RTT, which dominates under a "
                          "tunneled chip (PERF.md)")
+    ap.add_argument("--unet-cache", type=int, default=0,
+                    help="DeepCache interval N (full UNet every Nth frame, "
+                         "outermost-tier-only between — cached step is "
+                         "~0.54x the FLOPs at 512^2); 0 = off")
     ap.add_argument("--probe-timeout", type=int, default=300,
                     help="seconds to wait for backend init before declaring "
                          "the accelerator unreachable (0 = skip probe)")
@@ -501,6 +521,10 @@ def main():
     # same clamp as the serving path (server/tracks.py): depth 0 would blow
     # up ThreadPoolExecutor instead of measuring synchronously
     args.pipeline_depth = max(1, args.pipeline_depth)
+    if args.unet_cache >= 2 and args.config == "multipeer":
+        # mirror serving (multipeer.py refuses loudly): running cache-off
+        # while stamping unet_cache=N would commit a mislabeled PERF_LOG row
+        ap.error("--unet-cache is not supported with --config multipeer")
 
     # The contract line MUST be printed on every exit path (round-1 failure
     # mode: backend init raised before any JSON was emitted — BENCH_r01.json
@@ -525,6 +549,8 @@ def main():
         result["fbs"] = args.fbs
     if args.pipeline_depth != 4:
         result["pipeline_depth"] = args.pipeline_depth
+    if args.unet_cache >= 2:
+        result["unet_cache"] = args.unet_cache
     if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
         result["quant"] = "w8"
     if args.config == "multipeer":
@@ -585,7 +611,8 @@ def main():
                                     active=args.active)
         else:
             r = run_bench(args.config, args.frames,
-                          pipeline_depth=args.pipeline_depth, fbs=args.fbs)
+                          pipeline_depth=args.pipeline_depth, fbs=args.fbs,
+                          unet_cache=args.unet_cache)
         result.update(
             value=round(r["fps"], 2),
             vs_baseline=round(r["fps"] / 30.0, 3),

@@ -194,6 +194,10 @@ while true; do
   # process still re-pays the compile when the persistent cache was
   # dropped, so it gets the same budget as the other bench items
   run_item "turbo512_pd8" 2400 python -u bench.py --config turbo512 --frames 60 --pipeline-depth 8
+  # DeepCache: full UNet every 3rd frame, outermost tier between (cached
+  # step is compiler-pinned 0.54x FLOPs at this geometry — the fps delta
+  # on hardware is the number this row exists for)
+  run_item "turbo512_dc3" 2400 python -u bench.py --config turbo512 --frames 60 --unet-cache 3
   # 4. full-step cross-check (pallas vs xla, bf16 gauge): 3 more compiles
   run_item "numerics_full" 3600 python -u scripts/tpu_numerics_check.py --full
   # 5. AOT cache on hardware: build+serve, then fresh-process reload
