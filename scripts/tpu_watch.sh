@@ -198,6 +198,10 @@ while true; do
   # step is compiler-pinned 0.54x FLOPs at this geometry — the fps delta
   # on hardware is the number this row exists for)
   run_item "turbo512_dc3" 2400 python -u bench.py --config turbo512 --frames 60 --unet-cache 3
+  # interval 5: SAME two executables as dc3 (only the host cadence differs)
+  # -> nearly free after dc3 when the persistent compile cache held; same
+  # full budget as other rows in case it was dropped (fresh-process compile)
+  run_item "turbo512_dc5" 2400 python -u bench.py --config turbo512 --frames 60 --unet-cache 5
   # 4. full-step cross-check (pallas vs xla, bf16 gauge): 3 more compiles
   run_item "numerics_full" 3600 python -u scripts/tpu_numerics_check.py --full
   # 5. AOT cache on hardware: build+serve, then fresh-process reload

@@ -184,3 +184,26 @@ def test_control_plane_updates_force_recapture(monkeypatch):
     assert eng._tick == 0
     eng.reset_cache_cadence()
     assert eng._tick == 0
+
+
+def test_cadence_with_frame_batching():
+    """fbs>1: the cache rides the batched step (slots = n_stages*fbs) —
+    shapes line up and the cadence alternates per step (not per frame)."""
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config(
+        "tiny-test", unet_cache_interval=2, frame_buffer_size=2
+    )
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    )
+    eng.prepare("fbs deepcache", guidance_scale=1.0, seed=1)
+    assert eng.state["unet_cache"].shape[0] == cfg.batch_size
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        out = eng(rng.integers(0, 256, (2, cfg.height, cfg.width, 3), np.uint8))
+        assert out.shape == (2, cfg.height, cfg.width, 3)
+        assert np.isfinite(out.astype(np.float64)).all()
+    assert eng._tick == 4
