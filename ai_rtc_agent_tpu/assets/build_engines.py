@@ -52,23 +52,39 @@ def build(
     )
     engine.prepare(prompt="engine build probe")
 
-    step = make_step_fn(bundle.stream_models, cfg)
     frame = np.zeros(
         (cfg.height, cfg.width, 3)
         if cfg.frame_buffer_size == 1
         else (cfg.frame_buffer_size, cfg.height, cfg.width, 3),
         np.uint8,
     )
-    key = stream_engine_key(model_id, cfg)
     cache = EngineCache(cache_dir)
-    call = cache.load_or_build(
-        key, step, (bundle.params, engine.state, frame), donate_argnums=(1,)
-    )
-    # smoke-run the built engine once
-    new_state, out = call(bundle.params, engine.state, frame)
-    jax.block_until_ready(out)
-    logger.info("engine %s built and verified (out %s)", key, np.asarray(out).shape)
-    return key
+    if cfg.unet_cache_interval >= 2:
+        # DeepCache pair: the capture and cached variants are distinct
+        # executables (distinct keys), both needed at serve time
+        variants = [("capture", "capture"), ("cached", "cached")]
+    else:
+        variants = [("full", None)]
+    keys = []
+    state = engine.state
+    for unet_variant, key_variant in variants:
+        step = make_step_fn(bundle.stream_models, cfg, unet_variant=unet_variant)
+        extra = {"variant": key_variant} if key_variant else {}
+        key = stream_engine_key(model_id, cfg, **extra)
+        call = cache.load_or_build(
+            key, step, (bundle.params, state, frame), donate_argnums=(1,)
+        )
+        # smoke-run each built engine once; thread the state forward — the
+        # donated input buffers are consumed by the call
+        state, out = call(bundle.params, state, frame)
+        jax.block_until_ready(out)
+        logger.info(
+            "engine %s built and verified (out %s)", key, np.asarray(out).shape
+        )
+        keys.append(key)
+    # every key built this run (a DeepCache config builds a PAIR — shipping
+    # only one variant would defeat serve-time pair-atomic adoption)
+    return keys
 
 
 def main(argv=None):

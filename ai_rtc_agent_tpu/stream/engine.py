@@ -750,15 +750,9 @@ class StreamEngine:
             # meshes keep the plain jit path (same policy as
             # MultiPeerEngine.use_aot_cache)
             return False
-        if self._cache_interval:
-            # DeepCache alternates two executables; the single-engine AOT
-            # adoption keeps the plain jit pair instead (both steps still
-            # hit JAX's persistent compilation cache when enabled)
-            return False
         if self.state is None:
             raise RuntimeError("call prepare() first (state defines the signature)")
         cache = EngineCache(cache_dir)
-        key = stream_engine_key(model_id, self.cfg)
         fbs = self.cfg.frame_buffer_size
         frame_spec = jax.ShapeDtypeStruct(
             (self.cfg.height, self.cfg.width, 3)
@@ -767,15 +761,31 @@ class StreamEngine:
             jnp.uint8,
         )
         args = (self.params, self.state, frame_spec)
-        if not build_on_miss and not cache.has(key, args):
+        if self._cache_interval:
+            # DeepCache pair: two distinct executables (capture + cached),
+            # adopted atomically — a half-adopted pair would mix an AOT
+            # step with a cold jit step mid-cadence
+            plan = [("capture", {"variant": "capture"}, "_step"),
+                    ("cached", {"variant": "cached"}, "_step_cached")]
+        else:
+            plan = [("full", {}, "_step")]
+        keys = [stream_engine_key(model_id, self.cfg, **extra)
+                for _, extra, _ in plan]
+        if not build_on_miss and not all(
+            cache.has(k, args) for k in keys
+        ):
             return False
-        step = make_step_fn(self.models, self.cfg)
-        call = cache.load_or_build(
-            key, step, args, donate_argnums=(1,), build=build_on_miss
-        )
-        if call is None:  # unreadable blob with build_on_miss=False
-            return False
-        self._step = call
+        calls = []
+        for (unet_variant, _, _), k in zip(plan, keys):
+            step = make_step_fn(self.models, self.cfg, unet_variant=unet_variant)
+            call = cache.load_or_build(
+                k, step, args, donate_argnums=(1,), build=build_on_miss
+            )
+            if call is None:  # unreadable blob with build_on_miss=False
+                return False
+            calls.append(call)
+        for (_, _, attr), call in zip(plan, calls):
+            setattr(self, attr, call)
         return True
 
     # -- hot path -----------------------------------------------------------

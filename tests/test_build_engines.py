@@ -6,7 +6,7 @@ from ai_rtc_agent_tpu.assets.build_engines import build
 
 
 def test_build_engine_tiny(tmp_path, monkeypatch):
-    key = build("tiny-test", cache_dir=str(tmp_path))
+    (key,) = build("tiny-test", cache_dir=str(tmp_path))
     d = os.path.join(tmp_path, key)
     assert os.path.isdir(d)
     blobs = [f for f in os.listdir(d) if f.endswith(".jaxexport")]
@@ -53,7 +53,20 @@ def test_no_adoption_without_prebuilt_engine(tmp_path, monkeypatch):
 def test_build_controlnet_engine_variant(tmp_path):
     """ControlNet engine variant gets its own cache key (reference compiles a
     separate UNet+ControlNet engine, lib/wrapper.py:870-877)."""
-    key_plain = build("tiny-test", cache_dir=str(tmp_path))
-    key_cnet = build("tiny-test", cache_dir=str(tmp_path), controlnet="tiny-cnet")
+    (key_plain,) = build("tiny-test", cache_dir=str(tmp_path))
+    (key_cnet,) = build("tiny-test", cache_dir=str(tmp_path), controlnet="tiny-cnet")
     assert key_plain != key_cnet
     assert os.path.isdir(os.path.join(tmp_path, key_cnet))
+
+
+def test_build_deepcache_pair(tmp_path, monkeypatch):
+    """UNET_CACHE config builds BOTH variants (capture + cached) with
+    distinct keys — serve-time adoption is pair-atomic."""
+    monkeypatch.setenv("UNET_CACHE", "2")
+    keys = build("tiny-test", cache_dir=str(tmp_path))
+    assert len(keys) == 2 and keys[0] != keys[1]
+    assert any("capture" in k for k in keys)
+    assert any("cached" in k for k in keys)
+    for k in keys:
+        d = os.path.join(tmp_path, k)
+        assert [f for f in os.listdir(d) if f.endswith(".jaxexport")]

@@ -61,7 +61,7 @@ def test_use_requires_cache_and_rejects_controlnet_residuals():
         )
 
 
-def test_engine_cadence_and_flops(monkeypatch):
+def test_engine_cadence_and_flops(monkeypatch, tmp_path):
     """Engine e2e at tiny geometry: interval-3 cadence runs (cache slot in
     state, finite frames), and the cached step lowers to strictly fewer
     FLOPs than the capture step."""
@@ -69,6 +69,9 @@ def test_engine_cadence_and_flops(monkeypatch):
     from ai_rtc_agent_tpu.stream.engine import StreamEngine, make_step_fn
 
     monkeypatch.setenv("UNET_CACHE", "deepcache:3")
+    # hermetic: the no-adoption assert below must not see engines that some
+    # other run built into the repo-default cache dir
+    monkeypatch.setenv("XLA_ENGINES_CACHE", str(tmp_path))
     bundle = registry.load_model_bundle("tiny-test")
     cfg = registry.default_stream_config("tiny-test")
     assert cfg.unet_cache_interval == 3
@@ -96,8 +99,8 @@ def test_engine_cadence_and_flops(monkeypatch):
     f_full, f_cached = flops("capture"), flops("cached")
     assert 0 < f_cached < f_full
 
-    # AOT adoption refuses (two alternating executables) without touching
-    # the jit pair
+    # AOT adoption is pair-atomic: with neither variant prebuilt in the
+    # default cache dir, a no-build adoption misses and keeps the jit pair
     assert eng.use_aot_cache("tiny-test", build_on_miss=False) is False
 
 
@@ -207,3 +210,35 @@ def test_cadence_with_frame_batching():
         assert out.shape == (2, cfg.height, cfg.width, 3)
         assert np.isfinite(out.astype(np.float64)).all()
     assert eng._tick == 4
+
+
+def test_aot_pair_build_and_fresh_adoption(tmp_path):
+    """The TRT-engine-cache analog covers DeepCache: build_engines-style
+    pair build (capture + cached executables, distinct keys), then a fresh
+    engine adopts BOTH without compiling and serves the cadence."""
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test", unet_cache_interval=2)
+
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        jit_compile=False,
+    )
+    eng.prepare("aot deepcache", guidance_scale=1.0, seed=1)
+    assert eng.use_aot_cache("tiny-test", cache_dir=str(tmp_path)) is True
+
+    eng2 = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        jit_compile=False,
+    )
+    eng2.prepare("aot deepcache", guidance_scale=1.0, seed=1)
+    assert eng2.use_aot_cache(
+        "tiny-test", cache_dir=str(tmp_path), build_on_miss=False
+    ) is True
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        out = eng2(rng.integers(0, 256, (cfg.height, cfg.width, 3), np.uint8))
+        assert np.isfinite(out.astype(np.float64)).all()
+    assert eng2._tick == 3
