@@ -68,32 +68,47 @@ class MultiPeerEngine:
         self.encode_prompt = encode_prompt
         self.models = models
         self.params = params
-        if cfg.unet_cache_interval >= 2:
-            # per-peer cadence phases would need per-slot graph selection
-            # inside one vmapped step — not supported; refuse loudly rather
-            # than silently serving without the cache (no-silent-flag-drop)
-            raise ValueError(
-                "unet_cache_interval (UNET_CACHE) is not supported in "
-                "multipeer serving; unset it or drop --multipeer"
-            )
-        # template engine used to build per-slot states
+        # template engine used to build per-slot states (with DeepCache on,
+        # its prepare() pre-sizes the per-slot unet_cache ring too)
         self._template = StreamEngine(
             models, params, cfg, encode_prompt, jit_compile=False
         )
-        step = make_step_fn(models, cfg)
-        vstep = jax.vmap(step, in_axes=(None, 0, 0))
-        if mesh is not None and mesh.shape.get("dp", 1) > 1:
-            state_sh = NamedSharding(mesh, P("dp"))
-            frame_sh = NamedSharding(mesh, P("dp"))
-            repl = NamedSharding(mesh, P())
-            self._step = jax.jit(
-                vstep,
-                in_shardings=(repl, state_sh, frame_sh),
-                out_shardings=(state_sh, frame_sh),
-                donate_argnums=(1,),
+        self._cache_interval = (
+            cfg.unet_cache_interval if cfg.unet_cache_interval >= 2 else 0
+        )
+        self._tick = 0
+
+        def _vjit(vfn):
+            if mesh is not None and mesh.shape.get("dp", 1) > 1:
+                state_sh = NamedSharding(mesh, P("dp"))
+                frame_sh = NamedSharding(mesh, P("dp"))
+                repl = NamedSharding(mesh, P())
+                return jax.jit(
+                    vfn,
+                    in_shardings=(repl, state_sh, frame_sh),
+                    out_shardings=(state_sh, frame_sh),
+                    donate_argnums=(1,),
+                )
+            return jax.jit(vfn, donate_argnums=(1,))
+
+        if self._cache_interval:
+            # GLOBAL cadence: every slot captures on the same tick (one
+            # vmapped graph per variant — per-peer phases are unnecessary
+            # since the vmapped step applies one graph to all slots anyway;
+            # install() resets the cadence so a fresh slot's zeroed cache
+            # is never consumed before its first capture)
+            vstep = jax.vmap(
+                make_step_fn(models, cfg, unet_variant="capture"),
+                in_axes=(None, 0, 0),
             )
+            self._step_cached = _vjit(jax.vmap(
+                make_step_fn(models, cfg, unet_variant="cached"),
+                in_axes=(None, 0, 0),
+            ))
         else:
-            self._step = jax.jit(vstep, donate_argnums=(1,))
+            vstep = jax.vmap(make_step_fn(models, cfg), in_axes=(None, 0, 0))
+            self._step_cached = None
+        self._step = _vjit(vstep)
         self.states = None  # stacked pytree [P, ...]
         self.active = [False] * max_peers
         # guards the shared template engine during heavy state builds
@@ -123,6 +138,16 @@ class MultiPeerEngine:
         self._use_buckets = single_device and _env.get_bool(
             "MULTIPEER_BUCKETS", True
         )
+        if self._cache_interval and self._use_buckets:
+            # buckets x cache variants would double every occupancy
+            # compile; the cache's per-step savings apply to all slots
+            # (idle ones included), so prefer it and say so loudly
+            logger.warning(
+                "UNET_CACHE set: active-count buckets disabled for this "
+                "multipeer engine (would double the per-occupancy variant "
+                "compiles); idle slots still pay the cached-step rate"
+            )
+            self._use_buckets = False
         self._aot_adopted = False
         self._prewarmed = False
 
@@ -161,6 +186,10 @@ class MultiPeerEngine:
     def install(self, slot: int, state):
         """Cheap slot-state write (device .at[slot].set)."""
         self._set_slot_state(slot, state)
+        if self._cache_interval:
+            # the fresh slot's unet_cache is zeros — make the NEXT step a
+            # global capture so it is never consumed
+            self._tick = 0
         logger.info("peer connected -> slot %d", slot)
 
     def connect(self, prompt: str, seed: int | None = None) -> int:
@@ -198,6 +227,11 @@ class MultiPeerEngine:
         # (round-1 defect: pooled embeds silently kept the old prompt's)
         if self.cfg.use_added_cond and "pooled" in extras:
             self._set_slot_leaf(("added_text",), slot, extras["pooled"])
+        if self._cache_interval:
+            # DeepCache: stale deep cross-attention features must not serve
+            # under the NEW prompt — recapture globally (same contract as
+            # StreamEngine.update_prompt)
+            self._tick = 0
 
     def update_prompt(self, slot: int, prompt: str):
         """Per-peer prompt update (an upgrade over the reference's global
@@ -217,6 +251,8 @@ class MultiPeerEngine:
         coeffs = _coeff_state(self.cfg, self._template.schedule, t_index_list)
         for k, v in coeffs.items():
             self.states["coeffs"][k] = self.states["coeffs"][k].at[slot].set(v)
+        if self._cache_interval:
+            self._tick = 0  # DeepCache: new timesteps -> global recapture
 
     def _template_encode(self, prompt):
         res = self.encode_prompt(prompt)
@@ -246,6 +282,11 @@ class MultiPeerEngine:
         are not exported (serialization is per-topology); returns False.
         """
         if self.mesh is not None and np.prod(list(self.mesh.shape.values())) > 1:
+            return False
+        if self._cache_interval:
+            # the multipeer DeepCache pair keeps the plain jit path (the
+            # single-stream engine ships pair adoption; the multipeer
+            # export would need both variants serialized per peer count)
             return False
         if self.states is None:
             raise RuntimeError("call start() first (states define the signature)")
@@ -383,7 +424,12 @@ class MultiPeerEngine:
                 frames = jax.device_put(frames, NamedSharding(self.mesh, P("dp")))
             else:
                 frames = jax.device_put(frames)
-        self.states, out = self._step(self.params, self.states, frames)
+        fn = self._step
+        if self._cache_interval:
+            if self._tick % self._cache_interval != 0:
+                fn = self._step_cached
+            self._tick += 1
+        self.states, out = fn(self.params, self.states, frames)
         try:
             out.copy_to_host_async()
         except (AttributeError, RuntimeError):

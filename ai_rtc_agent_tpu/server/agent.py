@@ -593,11 +593,6 @@ async def on_startup(app):
     if app.get("fbs", 0) > 1:
         overrides["frame_buffer_size"] = app["fbs"]
     if app.get("unet_cache", 0) >= 2:
-        if app.get("multipeer", 0):
-            raise ValueError(
-                "--unet-cache is not supported with --multipeer (per-peer "
-                "cadence phases can't share one vmapped step)"
-            )
         overrides["unet_cache_interval"] = app["unet_cache"]
     if app.get("mode") and app["mode"] != "img2img":
         overrides["mode"] = app["mode"]

@@ -210,7 +210,7 @@ def _estimate_mfu(eng, frame, fps: float, fbs: int):
 
 
 def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4,
-                        active: int | None = None):
+                        active: int | None = None, unet_cache: int = 0):
     """BASELINE configs[4]: N concurrent streams batched on one chip.
     fps is AGGREGATE (frames/sec across ACTIVE peers).
 
@@ -228,7 +228,10 @@ def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4,
     dtype = "bfloat16" if jax.default_backend() != "cpu" else "float32"
     model_id = "stabilityai/sd-turbo"
     bundle = registry.load_model_bundle(model_id)
-    cfg = registry.default_stream_config(model_id, dtype=dtype)
+    overrides = {}
+    if unet_cache >= 2:
+        overrides["unet_cache_interval"] = unet_cache
+    cfg = registry.default_stream_config(model_id, dtype=dtype, **overrides)
     bundle.params = registry.cast_params(bundle.params, dtype)
     eng = MultiPeerEngine(
         bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
@@ -521,10 +524,6 @@ def main():
     # same clamp as the serving path (server/tracks.py): depth 0 would blow
     # up ThreadPoolExecutor instead of measuring synchronously
     args.pipeline_depth = max(1, args.pipeline_depth)
-    if args.unet_cache >= 2 and args.config == "multipeer":
-        # mirror serving (multipeer.py refuses loudly): running cache-off
-        # while stamping unet_cache=N would commit a mislabeled PERF_LOG row
-        ap.error("--unet-cache is not supported with --config multipeer")
 
     # The contract line MUST be printed on every exit path (round-1 failure
     # mode: backend init raised before any JSON was emitted — BENCH_r01.json
@@ -608,7 +607,8 @@ def main():
         if args.config == "multipeer":
             r = run_bench_multipeer(args.frames, args.peers,
                                     pipeline_depth=args.pipeline_depth,
-                                    active=args.active)
+                                    active=args.active,
+                                    unet_cache=args.unet_cache)
         else:
             r = run_bench(args.config, args.frames,
                           pipeline_depth=args.pipeline_depth, fbs=args.fbs,

@@ -117,16 +117,6 @@ def test_incompatible_modes_raise(monkeypatch):
     with pytest.raises(ValueError, match="denoising-batch"):
         make_step_fn(bundle.stream_models, cfg, unet_variant="cached")
 
-    # multipeer serving refuses loudly (no silent flag drop)
-    from ai_rtc_agent_tpu.parallel.multipeer import MultiPeerEngine
-
-    cfg2 = registry.default_stream_config("tiny-test", unet_cache_interval=2)
-    with pytest.raises(ValueError, match="multipeer"):
-        MultiPeerEngine(
-            bundle.stream_models, bundle.params, cfg2,
-            bundle.encode_prompt, max_peers=2,
-        )
-
     # controlnet + cache rejected at config time
     monkeypatch.setenv("UNET_CACHE", "2")
     with pytest.raises(ValueError, match="ControlNet"):
@@ -242,3 +232,40 @@ def test_aot_pair_build_and_fresh_adoption(tmp_path):
         out = eng2(rng.integers(0, 256, (cfg.height, cfg.width, 3), np.uint8))
         assert np.isfinite(out.astype(np.float64)).all()
     assert eng2._tick == 3
+
+
+def test_multipeer_global_cadence():
+    """Multipeer + DeepCache: one GLOBAL cadence for all slots (the vmapped
+    step applies one graph to every slot anyway); buckets auto-disable; a
+    connect resets the cadence so a fresh slot's zeroed cache is never
+    consumed before its first capture."""
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.parallel.multipeer import MultiPeerEngine
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test", unet_cache_interval=3)
+    mp = MultiPeerEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_peers=2,
+    ).start("deepcache peers")
+    assert mp._use_buckets is False  # buckets yield to the cache
+    mp.connect("peer a")
+    assert mp._tick == 0  # connect resets the cadence
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (2, cfg.height, cfg.width, 3), np.uint8)
+    for _ in range(3):
+        out = mp.step_all(frames)
+        assert out.shape == (2, cfg.height, cfg.width, 3)
+        assert np.isfinite(out.astype(np.float64)).all()
+    assert mp._tick == 3
+    mp.connect("peer b")
+    assert mp._tick == 0  # second connect forces a recapture again
+    out = mp.step_all(frames)
+    assert np.isfinite(out.astype(np.float64)).all()
+    # control-plane updates force a global recapture too (same contract as
+    # the single-stream engine)
+    mp.update_prompt(0, "new prompt for a")
+    assert mp._tick == 0
+    mp.step_all(frames)
+    mp.update_t_index(0, list(cfg.t_index_list))
+    assert mp._tick == 0
