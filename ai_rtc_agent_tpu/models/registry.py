@@ -154,6 +154,12 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
             "UNET_CACHE is incompatible with ControlNet (residuals feed "
             "the skipped deep blocks) — unset one"
         )
+    if cfg.unet_cache_interval >= 2 and cfg.mode == "txt2img":
+        logger.warning(
+            "UNET_CACHE with txt2img: consecutive ticks share no input "
+            "frame, so the temporal-coherence assumption behind the cache "
+            "is weak — expect a stronger approximation than img2img"
+        )
     return cfg
 
 

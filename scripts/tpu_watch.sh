@@ -220,6 +220,8 @@ while true; do
   # batching x caching compound: 4 peers, global DeepCache cadence
   run_item "multipeer4_dc3" 2400 python -u bench.py --config multipeer --frames 80 --peers 4 --unet-cache 3
   run_item "lcm4x512" 3600 python -u bench.py --config lcm4x512 --frames 30
+  # the 4-t-index stream batch has the most UNet FLOPs to save per frame
+  run_item "lcm4x512_dc3" 2400 python -u bench.py --config lcm4x512 --frames 30 --unet-cache 3
   run_item "controlnet512" 3600 python -u bench.py --config controlnet512 --frames 30
   run_item "sdxl1024" 3600 python -u bench.py --config sdxl1024 --frames 10
   # 7. glass-to-glass: codec-inclusive e2e metrics snapshot (VERDICT item 9)
