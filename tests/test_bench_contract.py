@@ -197,3 +197,25 @@ def test_bench_yields_to_watcher_item_lock(tmp_path):
     )
     assert _contract_line(r.stdout)
     assert not stop2.exists()
+
+
+def test_bench_refuses_to_contend_with_unreleased_claim(tmp_path):
+    """A watcher item that never releases within the wait budget means the
+    bench must NOT double-claim (the lease-leak wedge mode): it emits the
+    contract line (or a replay) labeled with the contention error instead."""
+    lock = tmp_path / "tpu_item.lock"
+    lock.write_text("123\n")
+    stop = tmp_path / "watch_stop"
+    pidfile = tmp_path / "watch.pid"
+    pidfile.write_text(f"{os.getpid()}\n")
+    r = _run_bench(
+        {"JAX_PLATFORMS": "cpu", "PERF_LOG_PATH": os.devnull,
+         "TPU_ITEM_LOCK": str(lock), "TPU_WATCH_STOP": str(stop),
+         "TPU_WATCH_PID": str(pidfile), "BENCH_CLAIM_WAIT_S": "6"},
+        args=("--frames", "2", "--probe-timeout", "30"), config="tiny64",
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert d["value"] == 0.0
+    assert "not contending" in d["error"]
+    assert stop.exists()
