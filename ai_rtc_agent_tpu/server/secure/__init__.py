@@ -1,0 +1,19 @@
+"""Secure media transport: ICE-lite STUN, DTLS 1.2, SRTP.
+
+The reference gets this entire tier from its aiortc fork (reference
+agent.py:13-20); aiortc is not installable in this environment, so the
+framework implements the three protocols itself on top of the
+``cryptography`` primitive library (no pyOpenSSL in the image):
+
+  * stun.py      RFC 5389 messages + the ICE-lite binding responder
+                 (RFC 8445 s2.5 — we never initiate checks)
+  * dtls.py      sans-IO DTLS 1.2 (RFC 6347) server+client,
+                 ECDHE + ECDSA-P256, AES-128-GCM, use_srtp (RFC 5764),
+                 RFC 5705 keying-material exporter
+  * srtp.py      RFC 3711 SRTP/SRTCP, AES128_CM_HMAC_SHA1_80
+  * endpoint.py  RFC 7983 demux glueing the three onto one UDP socket
+"""
+
+from .stun import StunMessage, IceLiteResponder  # noqa: F401
+from .srtp import SrtpContext, derive_srtp_contexts  # noqa: F401
+from .dtls import DtlsEndpoint, generate_certificate  # noqa: F401

@@ -1,0 +1,1010 @@
+"""Sans-IO DTLS 1.2 (RFC 6347) — server and client roles.
+
+The reference delegates DTLS to aiortc's OpenSSL bindings (reference
+agent.py:13-20 → aiortc's RTCDtlsTransport).  Neither aiortc nor pyOpenSSL
+is installable in this image, so this module implements the protocol
+directly over the ``cryptography`` primitive library:
+
+  * cipher suite TLS_ECDHE_ECDSA_WITH_AES_128_GCM_SHA256 (0xC02B) — the
+    suite every browser offers for WebRTC, with x25519 or P-256 key share
+  * self-signed ECDSA-P256 certificate (the WebRTC model: trust comes from
+    the SDP a=fingerprint, not a CA — RFC 8827 s6.5)
+  * cookie exchange (HelloVerifyRequest), fragmentation + reassembly,
+    duplicate-triggered flight retransmission
+  * extended master secret (RFC 7627), renegotiation_info echo
+  * use_srtp negotiation (RFC 5764) + RFC 5705 keying-material exporter —
+    the bridge into srtp.py
+  * optional CertificateRequest so the peer's certificate can be checked
+    against the SDP fingerprint (browsers always hold a certificate)
+
+Design: `DtlsEndpoint` is sans-IO — `handle_datagram(bytes) -> [bytes]`
+plus `start()`/`retransmit()`; the UDP plumbing lives in endpoint.py.
+Interop is pinned against `openssl s_client -dtls1_2 -use_srtp` in
+tests/test_secure_dtls.py (the same stack browsers run).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import logging
+import os
+import struct
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, x25519
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+from cryptography.x509.oid import NameOID
+
+logger = logging.getLogger(__name__)
+
+DTLS_10 = 0xFEFF
+DTLS_12 = 0xFEFD
+
+CT_CCS = 20
+CT_ALERT = 21
+CT_HANDSHAKE = 22
+CT_APPDATA = 23
+
+HT_HELLO_REQUEST = 0
+HT_CLIENT_HELLO = 1
+HT_SERVER_HELLO = 2
+HT_HELLO_VERIFY_REQUEST = 3
+HT_CERTIFICATE = 11
+HT_SERVER_KEY_EXCHANGE = 12
+HT_CERTIFICATE_REQUEST = 13
+HT_SERVER_HELLO_DONE = 14
+HT_CERTIFICATE_VERIFY = 15
+HT_CLIENT_KEY_EXCHANGE = 16
+HT_FINISHED = 20
+
+CIPHER_ECDHE_ECDSA_AES128_GCM_SHA256 = 0xC02B
+
+EXT_SUPPORTED_GROUPS = 0x000A
+EXT_EC_POINT_FORMATS = 0x000B
+EXT_SIGNATURE_ALGORITHMS = 0x000D
+EXT_USE_SRTP = 0x000E
+EXT_EXTENDED_MASTER_SECRET = 0x0017
+EXT_RENEGOTIATION_INFO = 0xFF01
+
+GROUP_SECP256R1 = 0x0017
+GROUP_X25519 = 0x001D
+
+SIG_ECDSA_SECP256R1_SHA256 = 0x0403
+
+SRTP_AES128_CM_HMAC_SHA1_80 = 0x0001
+
+MASTER_SECRET_LEN = 48
+VERIFY_DATA_LEN = 12
+GCM_TAG_LEN = 16
+RECORD_HEADER_LEN = 13
+HS_HEADER_LEN = 12
+
+
+def p_sha256(secret: bytes, label: bytes, seed: bytes, n: int) -> bytes:
+    """TLS 1.2 PRF (RFC 5246 s5) with SHA-256."""
+    seed = label + seed
+    out = b""
+    a = seed
+    while len(out) < n:
+        a = hmac.new(secret, a, hashlib.sha256).digest()
+        out += hmac.new(secret, a + seed, hashlib.sha256).digest()
+    return out[:n]
+
+
+def fingerprint_of_der(der: bytes) -> str:
+    digest = hashlib.sha256(der).hexdigest().upper()
+    return ":".join(digest[i : i + 2] for i in range(0, len(digest), 2))
+
+
+class DtlsCertificate:
+    """Self-signed ECDSA-P256 identity + its SDP fingerprint string."""
+
+    def __init__(self, private_key, cert):
+        self.private_key = private_key
+        self.cert = cert
+        self.der = cert.public_bytes(serialization.Encoding.DER)
+        self.fingerprint = fingerprint_of_der(self.der)
+
+
+def generate_certificate(common_name: str = "ai-rtc-agent-tpu") -> DtlsCertificate:
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .sign(key, hashes.SHA256())
+    )
+    return DtlsCertificate(key, cert)
+
+
+class DtlsError(Exception):
+    pass
+
+
+class _RecordCipher:
+    """One direction of the epoch-1 AES-128-GCM record protection."""
+
+    def __init__(self, key: bytes, implicit_iv: bytes):
+        self.aead = AESGCM(key)
+        self.iv = implicit_iv  # 4 bytes
+
+    def seal(self, seq8: bytes, ctype: int, plaintext: bytes) -> bytes:
+        # explicit nonce on the wire = the 8-byte epoch||seq (standard
+        # practice; RFC 5288 only requires uniqueness)
+        nonce = self.iv + seq8
+        aad = seq8 + struct.pack("!BHH", ctype, DTLS_12, len(plaintext))
+        return seq8 + self.aead.encrypt(nonce, plaintext, aad)
+
+    def open(self, seq8: bytes, ctype: int, wire: bytes) -> bytes:
+        if len(wire) < 8 + GCM_TAG_LEN:
+            raise DtlsError("short GCM record")
+        explicit, ct = wire[:8], wire[8:]
+        nonce = self.iv + explicit
+        aad = seq8 + struct.pack(
+            "!BHH", ctype, DTLS_12, len(ct) - GCM_TAG_LEN
+        )
+        try:
+            return self.aead.decrypt(nonce, ct, aad)
+        except Exception as e:  # InvalidTag
+            raise DtlsError(f"record decrypt failed: {e}")
+
+
+def _hs_header(msg_type: int, length: int, msg_seq: int) -> bytes:
+    return (
+        struct.pack("!B", msg_type)
+        + length.to_bytes(3, "big")
+        + struct.pack("!H", msg_seq)
+        + (0).to_bytes(3, "big")
+        + length.to_bytes(3, "big")
+    )
+
+
+class DtlsEndpoint:
+    """One DTLS 1.2 association (sans-IO).
+
+    Usage:
+        server = DtlsEndpoint("server", cert)
+        out = server.handle_datagram(dgram)      # -> datagrams to send
+        ...
+        if server.established:
+            km = server.export_srtp_keying_material()
+
+    A client additionally calls start() for its first flight."""
+
+    MTU = 1200
+
+    def __init__(
+        self,
+        role: str,
+        certificate: DtlsCertificate | None = None,
+        srtp_profiles: tuple = (SRTP_AES128_CM_HMAC_SHA1_80,),
+        request_client_cert: bool = False,
+        verify_fingerprint: str | None = None,
+    ):
+        assert role in ("server", "client")
+        self.role = role
+        self.cert = certificate or generate_certificate()
+        self.srtp_profiles = srtp_profiles
+        self.request_client_cert = request_client_cert
+        # expected peer cert SHA-256 fingerprint (from the SDP a=fingerprint);
+        # verified when the peer presents a certificate
+        self.verify_fingerprint = verify_fingerprint
+        self.established = False
+        self.failed: str | None = None
+        self.srtp_profile: int | None = None
+        self.peer_cert_der: bytes | None = None
+        self.alert_received: tuple | None = None
+
+        self._cookie_secret = os.urandom(16)
+        self._client_random = b""
+        self._server_random = b""
+        self._session_hash_input = bytearray()  # transcript (CH2 onward)
+        self._master_secret: bytes | None = None
+        self._pre_master: bytes | None = None
+        self._ems = False
+        self._peer_offered_ems = False
+        self._peer_offered_reneg = False
+        self._session_hash: bytes | None = None  # through ClientKeyExchange
+        self._ecdh_private = None
+        self._ecdh_group: int | None = None
+        self._peer_key_share: bytes | None = None
+
+        self._send_epoch = 0
+        self._send_seq = {0: 0, 1: 0}
+        self._recv_epoch = 0
+        self._send_msg_seq = 0
+        self._recv_next_seq = 0
+        self._write_cipher: _RecordCipher | None = None
+        self._read_cipher: _RecordCipher | None = None
+        self._reassembly: dict = {}
+        # epoch-1 anti-replay sliding window (RFC 6347 s4.1.2.6)
+        self._replay_max = -1
+        self._replay_mask = 0
+        # records before version negotiation go out as DTLS 1.0 (the
+        # ClientHello/HelloVerifyRequest convention); everything after must
+        # say DTLS 1.2 — OpenSSL silently DISCARDS post-first-packet records
+        # whose version differs from the negotiated one
+        self._record_version = DTLS_10
+        self._key_block: bytes | None = None
+        self._dup_seen = False
+        self._last_flight: list = []  # datagrams (for retransmit)
+        self._appdata: list = []
+        self._state = "WAIT_CH1" if role == "server" else "START"
+        # client-side accumulators for the server flight
+        self._client_seen_done = False
+        self._expect_cert_verify = False
+        self._peer_wants_cert = False
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def start(self) -> list:
+        """Client only: produce the first ClientHello flight."""
+        assert self.role == "client"
+        self._client_random = os.urandom(32)
+        ch = self._build_client_hello(cookie=b"")
+        self._state = "WAIT_SH"
+        flight = self._flush_handshake([(HT_CLIENT_HELLO, ch, False)])
+        self._last_flight = flight
+        return flight
+
+    def handle_datagram(self, data: bytes) -> list:
+        """Feed one UDP datagram; returns datagrams to transmit."""
+        if self.failed is not None:
+            return []  # dead association — a fatal alert already went out
+        out: list = []
+        self._dup_seen = False
+        off = 0
+        while off + RECORD_HEADER_LEN <= len(data):
+            ctype, ver, epoch = struct.unpack_from("!BHH", data, off)
+            seq6 = data[off + 5 : off + 11]
+            (length,) = struct.unpack_from("!H", data, off + 11)
+            frag = data[off + RECORD_HEADER_LEN : off + RECORD_HEADER_LEN + length]
+            off += RECORD_HEADER_LEN + length
+            if len(frag) < length:
+                break  # truncated datagram
+            try:
+                out.extend(self._handle_record(ctype, epoch, seq6, frag))
+            except DtlsError as e:
+                logger.warning("dtls %s: %s", self.role, e)
+                self.failed = str(e)
+                out.append(self._alert_datagram(2, 40))  # fatal handshake_failure
+                return out
+            except Exception as e:
+                # malformed bodies must never crash the UDP receive loop:
+                # a truncated ClientKeyExchange, a bogus key share, etc. are
+                # hostile input, not programming errors reachable only here
+                logger.warning(
+                    "dtls %s: malformed input (%s: %s)", self.role, type(e).__name__, e
+                )
+                self.failed = f"malformed peer message: {type(e).__name__}"
+                out.append(self._alert_datagram(2, 50))  # fatal decode_error
+                return out
+        if self._dup_seen and not out and self._last_flight:
+            # the peer retransmitted a flight we already processed — our
+            # answering flight was lost; resend it (once per datagram)
+            out.extend(self._last_flight)
+        return out
+
+    def retransmit(self) -> list:
+        """Resend the last flight (caller drives the timer)."""
+        return list(self._last_flight)
+
+    def send_application_data(self, payload: bytes) -> list:
+        if not self.established:
+            raise DtlsError("not established")
+        return [self._encrypt_record(CT_APPDATA, payload)]
+
+    def recv_application_data(self) -> list:
+        out, self._appdata = self._appdata, []
+        return out
+
+    def export_srtp_keying_material(self, length: int = 60) -> bytes:
+        """RFC 5705 exporter, label "EXTRACTOR-dtls_srtp" (RFC 5764 s4.2)."""
+        if self._master_secret is None:
+            raise DtlsError("handshake incomplete")
+        return p_sha256(
+            self._master_secret,
+            b"EXTRACTOR-dtls_srtp",
+            self._client_random + self._server_random,
+            length,
+        )
+
+    def peer_fingerprint(self) -> str | None:
+        if self.peer_cert_der is None:
+            return None
+        return fingerprint_of_der(self.peer_cert_der)
+
+    def close(self) -> list:
+        try:
+            return [self._alert_datagram(1, 0)]  # warning close_notify
+        except Exception:
+            return []
+
+    # ------------------------------------------------------------------
+    # record layer
+    # ------------------------------------------------------------------
+
+    def _encrypt_record(self, ctype: int, payload: bytes) -> bytes:
+        epoch = self._send_epoch
+        seq = self._send_seq[epoch]
+        self._send_seq[epoch] = seq + 1
+        seq8 = struct.pack("!H", epoch) + seq.to_bytes(6, "big")
+        if epoch == 0:
+            body = payload
+        else:
+            body = self._write_cipher.seal(seq8, ctype, payload)
+        return (
+            struct.pack("!BH", ctype, DTLS_12 if epoch else self._record_version)
+            + seq8
+            + struct.pack("!H", len(body))
+            + body
+        )
+
+    def _alert_datagram(self, level: int, desc: int) -> bytes:
+        return self._encrypt_record(CT_ALERT, struct.pack("!BB", level, desc))
+
+    def _handle_record(self, ctype: int, epoch: int, seq6: bytes, frag: bytes) -> list:
+        if epoch != self._recv_epoch:
+            # wrong-epoch records are dropped unauthenticated noise: an
+            # epoch-1 record before CCS (peer will retransmit), or — the
+            # security-relevant case — a spoofed PLAINTEXT epoch-0 record
+            # after the handshake, which must never reach the alert or
+            # handshake logic (flight recovery rides the authenticated
+            # epoch-1 Finished duplicate instead)
+            return []
+        if epoch > 0:
+            seq8 = struct.pack("!H", epoch) + seq6
+            seq_int = int.from_bytes(seq6, "big")
+            if not self._replay_ok(seq_int):
+                # an exact replay is how a retransmitted final flight looks
+                # when the peer resends identical bytes — treat it as the
+                # our-flight-was-lost signal rather than processing it
+                self._dup_seen = True
+                return []
+            frag = self._read_cipher.open(seq8, ctype, frag)
+            self._replay_note(seq_int)
+        if ctype == CT_CCS:
+            # peer switches to its epoch-1 cipher for everything after
+            self._derive_keys_if_needed()
+            if self._key_block is None:
+                return []  # CCS before key exchange completed — drop
+            self._read_cipher = self._peer_cipher()
+            self._recv_epoch = 1
+            return []
+        if ctype == CT_ALERT:
+            if len(frag) >= 2:
+                self.alert_received = (frag[0], frag[1])
+                if frag[0] == 2:
+                    self.failed = f"peer fatal alert {frag[1]}"
+            return []
+        if ctype == CT_APPDATA:
+            if self.established:
+                self._appdata.append(frag)
+            return []
+        if ctype != CT_HANDSHAKE:
+            return []
+        return self._handle_handshake_fragment(frag)
+
+    def _replay_ok(self, seq: int) -> bool:
+        if seq > self._replay_max:
+            return True
+        diff = self._replay_max - seq
+        if diff >= 64:
+            return False
+        return not (self._replay_mask >> diff) & 1
+
+    def _replay_note(self, seq: int) -> None:
+        if seq > self._replay_max:
+            shift = seq - self._replay_max
+            self._replay_mask = (
+                (self._replay_mask << shift) | 1
+            ) & 0xFFFFFFFFFFFFFFFF
+            self._replay_max = seq
+        else:
+            self._replay_mask |= 1 << (self._replay_max - seq)
+
+    # ------------------------------------------------------------------
+    # handshake reassembly
+    # ------------------------------------------------------------------
+
+    def _handle_handshake_fragment(self, frag: bytes) -> list:
+        out: list = []
+        off = 0
+        while off + HS_HEADER_LEN <= len(frag):
+            msg_type = frag[off]
+            total = int.from_bytes(frag[off + 1 : off + 4], "big")
+            (msg_seq,) = struct.unpack_from("!H", frag, off + 4)
+            frag_off = int.from_bytes(frag[off + 6 : off + 9], "big")
+            frag_len = int.from_bytes(frag[off + 9 : off + 12], "big")
+            body = frag[off + HS_HEADER_LEN : off + HS_HEADER_LEN + frag_len]
+            off += HS_HEADER_LEN + frag_len
+            if len(body) < frag_len:
+                break
+            if msg_seq < self._recv_next_seq:
+                # duplicate from the peer's last flight → ours was likely
+                # lost; flag for a single resend (classic DTLS recovery)
+                self._dup_seen = True
+                continue
+            # bound attacker-controlled allocations: no legitimate handshake
+            # message here exceeds a few KB (largest: a certificate chain),
+            # and flights never run more than a handful of messages ahead
+            if total > 0x10000 or msg_seq >= self._recv_next_seq + 8:
+                continue
+            slot = self._reassembly.setdefault(
+                msg_seq, [msg_type, total, bytearray(total), bytearray(total)]
+            )
+            if slot[0] != msg_type or slot[1] != total:
+                continue  # inconsistent fragment — drop
+            slot[2][frag_off : frag_off + frag_len] = body
+            for i in range(frag_off, min(frag_off + frag_len, total)):
+                slot[3][i] = 1
+            # drain in-order completed messages
+            while True:
+                nxt = self._reassembly.get(self._recv_next_seq)
+                if nxt is None or not all(nxt[3]):
+                    break
+                mtype, mtotal, mbody, _ = nxt
+                del self._reassembly[self._recv_next_seq]
+                seq = self._recv_next_seq
+                self._recv_next_seq += 1
+                out.extend(self._process_handshake(mtype, bytes(mbody), seq))
+        return out
+
+    def _transcribe(self, msg_type: int, body: bytes, msg_seq: int) -> None:
+        self._session_hash_input += _hs_header(msg_type, len(body), msg_seq) + body
+
+    def _transcript_hash(self) -> bytes:
+        return hashlib.sha256(bytes(self._session_hash_input)).digest()
+
+    # ------------------------------------------------------------------
+    # handshake message construction
+    # ------------------------------------------------------------------
+
+    def _flush_handshake(self, msgs: list) -> list:
+        """msgs: [(type, body, encrypted)] → records packed into datagrams.
+        Each message is transcribed (unless it is CH1/HVR) and fragmented
+        to MTU."""
+        datagrams: list = []
+        pending = b""
+        for msg_type, body, encrypted in msgs:
+            msg_seq = self._send_msg_seq
+            self._send_msg_seq += 1
+            transcribe = not (
+                msg_type == HT_HELLO_VERIFY_REQUEST
+                or (msg_type == HT_CLIENT_HELLO and self._ch_is_first(body))
+            )
+            if transcribe:
+                self._transcribe(msg_type, body, msg_seq)
+            # fragment
+            max_frag = self.MTU - RECORD_HEADER_LEN - HS_HEADER_LEN - 64
+            offsets = range(0, max(len(body), 1), max_frag)
+            for fo in offsets:
+                chunk = body[fo : fo + max_frag]
+                hdr = (
+                    struct.pack("!B", msg_type)
+                    + len(body).to_bytes(3, "big")
+                    + struct.pack("!H", msg_seq)
+                    + fo.to_bytes(3, "big")
+                    + len(chunk).to_bytes(3, "big")
+                )
+                record = self._encrypt_record(CT_HANDSHAKE, hdr + chunk) if encrypted else self._plain_record(CT_HANDSHAKE, hdr + chunk)
+                if pending and len(pending) + len(record) > self.MTU:
+                    datagrams.append(pending)
+                    pending = b""
+                pending += record
+        if pending:
+            datagrams.append(pending)
+        return datagrams
+
+    def _ch_is_first(self, body: bytes) -> bool:
+        """A ClientHello with an empty cookie is the pre-cookie CH1 — it and
+        the HelloVerifyRequest stay out of the transcript (RFC 6347 s4.2.1)."""
+        try:
+            off = 2 + 32
+            sid_len = body[off]
+            off += 1 + sid_len
+            cookie_len = body[off]
+            return cookie_len == 0
+        except IndexError:
+            return False
+
+    def _plain_record(self, ctype: int, payload: bytes) -> bytes:
+        seq = self._send_seq[0]
+        self._send_seq[0] = seq + 1
+        seq8 = struct.pack("!H", 0) + seq.to_bytes(6, "big")
+        return (
+            struct.pack("!BH", ctype, self._record_version)
+            + seq8
+            + struct.pack("!H", len(payload))
+            + payload
+        )
+
+    def _build_client_hello(self, cookie: bytes) -> bytes:
+        exts = b""
+        exts += struct.pack(
+            "!HHH", EXT_SUPPORTED_GROUPS, 6, 4
+        ) + struct.pack("!HH", GROUP_X25519, GROUP_SECP256R1)
+        exts += struct.pack("!HH", EXT_EC_POINT_FORMATS, 2) + b"\x01\x00"
+        exts += struct.pack(
+            "!HHH", EXT_SIGNATURE_ALGORITHMS, 4, 2
+        ) + struct.pack("!H", SIG_ECDSA_SECP256R1_SHA256)
+        profiles = b"".join(struct.pack("!H", p) for p in self.srtp_profiles)
+        exts += (
+            struct.pack("!HH", EXT_USE_SRTP, len(profiles) + 3)
+            + struct.pack("!H", len(profiles))
+            + profiles
+            + b"\x00"
+        )
+        exts += struct.pack("!HH", EXT_EXTENDED_MASTER_SECRET, 0)
+        exts += struct.pack("!HH", EXT_RENEGOTIATION_INFO, 1) + b"\x00"
+        body = struct.pack("!H", DTLS_12) + self._client_random
+        body += b"\x00"  # session id
+        body += struct.pack("!B", len(cookie)) + cookie
+        body += struct.pack("!H", 2) + struct.pack(
+            "!H", CIPHER_ECDHE_ECDSA_AES128_GCM_SHA256
+        )
+        body += b"\x01\x00"  # compression: null
+        body += struct.pack("!H", len(exts)) + exts
+        return body
+
+    # ------------------------------------------------------------------
+    # handshake state machine
+    # ------------------------------------------------------------------
+
+    def _process_handshake(self, msg_type: int, body: bytes, msg_seq: int) -> list:
+        if self.role == "server":
+            return self._server_process(msg_type, body, msg_seq)
+        return self._client_process(msg_type, body, msg_seq)
+
+    # ---------------- server ----------------
+
+    def _server_process(self, msg_type: int, body: bytes, msg_seq: int) -> list:
+        if msg_type == HT_CLIENT_HELLO:
+            return self._server_on_client_hello(body, msg_seq)
+        if msg_type == HT_CERTIFICATE and self._state == "WAIT_CLIENT_FLIGHT":
+            self._transcribe(msg_type, body, msg_seq)
+            self._parse_peer_certificate(body)
+            return []
+        if msg_type == HT_CLIENT_KEY_EXCHANGE and self._state == "WAIT_CLIENT_FLIGHT":
+            self._transcribe(msg_type, body, msg_seq)
+            plen = body[0]
+            self._peer_key_share = body[1 : 1 + plen]
+            self._compute_pre_master()
+            # EMS session hash: transcript through ClientKeyExchange
+            self._session_hash = self._transcript_hash()
+            self._expect_cert_verify = (
+                self.peer_cert_der is not None and self.request_client_cert
+            )
+            return []
+        if msg_type == HT_CERTIFICATE_VERIFY and self._state == "WAIT_CLIENT_FLIGHT":
+            self._verify_certificate_verify(body)
+            self._transcribe(msg_type, body, msg_seq)
+            self._expect_cert_verify = False
+            return []
+        if msg_type == HT_FINISHED and self._state == "WAIT_CLIENT_FLIGHT":
+            if self._expect_cert_verify:
+                # a replayed certificate without proof of key possession
+                # must not authenticate (the whole point of CertificateVerify)
+                raise DtlsError("client presented a certificate but no CertificateVerify")
+            self._derive_keys_if_needed()
+            expect = p_sha256(
+                self._master_secret,
+                b"client finished",
+                self._transcript_hash(),
+                VERIFY_DATA_LEN,
+            )
+            if not hmac.compare_digest(expect, body):
+                raise DtlsError("client Finished verify_data mismatch")
+            self._transcribe(msg_type, body, msg_seq)
+            # flight 6: CCS + server Finished
+            ccs = self._plain_record(CT_CCS, b"\x01")
+            self._send_epoch = 1
+            self._write_cipher = self._own_cipher()
+            verify = p_sha256(
+                self._master_secret,
+                b"server finished",
+                self._transcript_hash(),
+                VERIFY_DATA_LEN,
+            )
+            fin = self._flush_handshake([(HT_FINISHED, verify, True)])
+            self.established = True
+            self._state = "ESTABLISHED"
+            flight = [ccs + fin[0]] + fin[1:]
+            self._last_flight = flight
+            return flight
+        return []
+
+    def _server_on_client_hello(self, body: bytes, msg_seq: int) -> list:
+        # parse
+        off = 0
+        (client_version,) = struct.unpack_from("!H", body, off)
+        off += 2
+        client_random = body[off : off + 32]
+        off += 32
+        sid_len = body[off]
+        off += 1 + sid_len
+        cookie_len = body[off]
+        cookie = body[off + 1 : off + 1 + cookie_len]
+        off += 1 + cookie_len
+        (cs_len,) = struct.unpack_from("!H", body, off)
+        off += 2
+        ciphers = [
+            struct.unpack_from("!H", body, off + i)[0] for i in range(0, cs_len, 2)
+        ]
+        off += cs_len
+        comp_len = body[off]
+        off += 1 + comp_len
+        exts = self._parse_extensions(body[off:])
+
+        expected_cookie = hmac.new(
+            self._cookie_secret, client_random, hashlib.sha256
+        ).digest()[:16]
+        if not cookie or not hmac.compare_digest(cookie, expected_cookie):
+            hvr = (
+                struct.pack("!H", DTLS_10)
+                + struct.pack("!B", len(expected_cookie))
+                + expected_cookie
+            )
+            flight = self._flush_handshake([(HT_HELLO_VERIFY_REQUEST, hvr, False)])
+            self._last_flight = flight
+            self._state = "WAIT_CH2"
+            return flight
+
+        # CH2 accepted — everything we send from here is DTLS 1.2
+        self._record_version = DTLS_12
+        self._transcribe(HT_CLIENT_HELLO, body, msg_seq)
+        self._client_random = client_random
+        if CIPHER_ECDHE_ECDSA_AES128_GCM_SHA256 not in ciphers:
+            raise DtlsError("no common cipher suite (need 0xC02B)")
+        if client_version < DTLS_12:  # DTLS versions compare inverted
+            pass  # fefd < feff numerically; accept any >= 1.0, negotiate 1.2
+        groups = exts.get(EXT_SUPPORTED_GROUPS, b"")
+        offered_groups = []
+        if len(groups) >= 2:
+            (glen,) = struct.unpack_from("!H", groups, 0)
+            offered_groups = [
+                struct.unpack_from("!H", groups, 2 + i)[0]
+                for i in range(0, min(glen, len(groups) - 2), 2)
+            ]
+        if GROUP_X25519 in offered_groups or not offered_groups:
+            self._ecdh_group = GROUP_X25519
+        elif GROUP_SECP256R1 in offered_groups:
+            self._ecdh_group = GROUP_SECP256R1
+        else:
+            raise DtlsError("no common ECDH group")
+        self._peer_offered_ems = EXT_EXTENDED_MASTER_SECRET in exts
+        self._peer_offered_reneg = EXT_RENEGOTIATION_INFO in exts or 0x00FF in (
+            ciphers
+        )
+        srtp = exts.get(EXT_USE_SRTP)
+        if srtp and len(srtp) >= 2:
+            (plen,) = struct.unpack_from("!H", srtp, 0)
+            offered = [
+                struct.unpack_from("!H", srtp, 2 + i)[0]
+                for i in range(0, min(plen, len(srtp) - 2), 2)
+            ]
+            for p in self.srtp_profiles:
+                if p in offered:
+                    self.srtp_profile = p
+                    break
+
+        self._server_random = os.urandom(32)
+        self._ems = self._peer_offered_ems
+
+        # ServerHello
+        exts_out = b""
+        if self._peer_offered_reneg:
+            exts_out += struct.pack("!HH", EXT_RENEGOTIATION_INFO, 1) + b"\x00"
+        exts_out += struct.pack("!HH", EXT_EC_POINT_FORMATS, 2) + b"\x01\x00"
+        if self.srtp_profile is not None:
+            exts_out += (
+                struct.pack("!HH", EXT_USE_SRTP, 5)
+                + struct.pack("!H", 2)
+                + struct.pack("!H", self.srtp_profile)
+                + b"\x00"
+            )
+        if self._ems:
+            exts_out += struct.pack("!HH", EXT_EXTENDED_MASTER_SECRET, 0)
+        sh = (
+            struct.pack("!H", DTLS_12)
+            + self._server_random
+            + b"\x00"  # session id
+            + struct.pack("!H", CIPHER_ECDHE_ECDSA_AES128_GCM_SHA256)
+            + b"\x00"  # compression
+            + struct.pack("!H", len(exts_out))
+            + exts_out
+        )
+
+        # Certificate
+        cert_entry = len(self.cert.der).to_bytes(3, "big") + self.cert.der
+        cert_msg = len(cert_entry).to_bytes(3, "big") + cert_entry
+
+        # ServerKeyExchange
+        if self._ecdh_group == GROUP_X25519:
+            self._ecdh_private = x25519.X25519PrivateKey.generate()
+            pub = self._ecdh_private.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        else:
+            self._ecdh_private = ec.generate_private_key(ec.SECP256R1())
+            pub = self._ecdh_private.public_key().public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.UncompressedPoint,
+            )
+        params = (
+            b"\x03"
+            + struct.pack("!H", self._ecdh_group)
+            + struct.pack("!B", len(pub))
+            + pub
+        )
+        signed = self._client_random + self._server_random + params
+        sig = self.cert.private_key.sign(signed, ec.ECDSA(hashes.SHA256()))
+        ske = (
+            params
+            + struct.pack("!H", SIG_ECDSA_SECP256R1_SHA256)
+            + struct.pack("!H", len(sig))
+            + sig
+        )
+
+        msgs = [
+            (HT_SERVER_HELLO, sh, False),
+            (HT_CERTIFICATE, cert_msg, False),
+            (HT_SERVER_KEY_EXCHANGE, ske, False),
+        ]
+        if self.request_client_cert:
+            # ecdsa_sign cert type, sha256/ecdsa sig alg, no CA names
+            creq = (
+                b"\x01\x40"
+                + struct.pack("!H", 2)
+                + struct.pack("!H", SIG_ECDSA_SECP256R1_SHA256)
+                + struct.pack("!H", 0)
+            )
+            msgs.append((HT_CERTIFICATE_REQUEST, creq, False))
+        msgs.append((HT_SERVER_HELLO_DONE, b"", False))
+        flight = self._flush_handshake(msgs)
+        self._last_flight = flight
+        self._state = "WAIT_CLIENT_FLIGHT"
+        return flight
+
+    def _parse_peer_certificate(self, body: bytes) -> None:
+        total = int.from_bytes(body[0:3], "big")
+        if total == 0:
+            self.peer_cert_der = None  # empty list (no client cert)
+            return
+        first_len = int.from_bytes(body[3:6], "big")
+        self.peer_cert_der = bytes(body[6 : 6 + first_len])
+        if self.verify_fingerprint:
+            got = fingerprint_of_der(self.peer_cert_der)
+            if got.lower() != self.verify_fingerprint.lower():
+                raise DtlsError(
+                    "peer certificate fingerprint mismatch "
+                    f"(sdp {self.verify_fingerprint[:16]}…, dtls {got[:16]}…)"
+                )
+
+    def _verify_certificate_verify(self, body: bytes) -> None:
+        if len(body) < 4:
+            raise DtlsError("short CertificateVerify")
+        (alg,) = struct.unpack_from("!H", body, 0)
+        (slen,) = struct.unpack_from("!H", body, 2)
+        sig = body[4 : 4 + slen]
+        if alg != SIG_ECDSA_SECP256R1_SHA256:
+            raise DtlsError(f"unsupported CertificateVerify alg {alg:#06x}")
+        pub = x509.load_der_x509_certificate(self.peer_cert_der).public_key()
+        try:
+            pub.verify(
+                sig, bytes(self._session_hash_input), ec.ECDSA(hashes.SHA256())
+            )
+        except Exception:
+            raise DtlsError("CertificateVerify signature invalid")
+
+    # ---------------- client ----------------
+
+    def _client_process(self, msg_type: int, body: bytes, msg_seq: int) -> list:
+        if msg_type == HT_HELLO_VERIFY_REQUEST:
+            cookie_len = body[2]
+            cookie = body[3 : 3 + cookie_len]
+            # CH2 restarts the transcript (CH1/HVR excluded per RFC 6347)
+            self._session_hash_input = bytearray()
+            ch = self._build_client_hello(cookie=cookie)
+            flight = self._flush_handshake([(HT_CLIENT_HELLO, ch, False)])
+            self._last_flight = flight
+            return flight
+        if msg_type == HT_SERVER_HELLO:
+            self._record_version = DTLS_12
+            self._transcribe(msg_type, body, msg_seq)
+            self._server_random = body[2:34]
+            off = 34
+            sid_len = body[off]
+            off += 1 + sid_len
+            (cipher,) = struct.unpack_from("!H", body, off)
+            off += 3  # cipher + compression
+            if cipher != CIPHER_ECDHE_ECDSA_AES128_GCM_SHA256:
+                raise DtlsError(f"server chose unsupported cipher {cipher:#06x}")
+            exts = {}
+            if off + 2 <= len(body):
+                exts = self._parse_extensions(body[off:])
+            self._ems = EXT_EXTENDED_MASTER_SECRET in exts
+            srtp = exts.get(EXT_USE_SRTP)
+            if srtp and len(srtp) >= 4:
+                self.srtp_profile = struct.unpack_from("!H", srtp, 2)[0]
+            return []
+        if msg_type == HT_CERTIFICATE:
+            self._transcribe(msg_type, body, msg_seq)
+            self._parse_peer_certificate(body)
+            return []
+        if msg_type == HT_SERVER_KEY_EXCHANGE:
+            self._transcribe(msg_type, body, msg_seq)
+            if body[0] != 3:
+                raise DtlsError("only named_curve ECDHE supported")
+            (group,) = struct.unpack_from("!H", body, 1)
+            plen = body[3]
+            point = body[4 : 4 + plen]
+            off = 4 + plen
+            (alg,) = struct.unpack_from("!H", body, off)
+            (slen,) = struct.unpack_from("!H", body, off + 2)
+            sig = body[off + 4 : off + 4 + slen]
+            # verify the params signature against the server certificate
+            params = body[: 4 + plen]
+            signed = self._client_random + self._server_random + params
+            pub = x509.load_der_x509_certificate(self.peer_cert_der).public_key()
+            try:
+                pub.verify(sig, signed, ec.ECDSA(hashes.SHA256()))
+            except Exception:
+                raise DtlsError("ServerKeyExchange signature invalid")
+            self._ecdh_group = group
+            self._peer_key_share = point
+            return []
+        if msg_type == HT_CERTIFICATE_REQUEST:
+            self._transcribe(msg_type, body, msg_seq)
+            self._peer_wants_cert = True
+            return []
+        if msg_type == HT_SERVER_HELLO_DONE:
+            self._transcribe(msg_type, body, msg_seq)
+            return self._client_final_flight()
+        if msg_type == HT_FINISHED:
+            self._derive_keys_if_needed()
+            expect = p_sha256(
+                self._master_secret,
+                b"server finished",
+                self._transcript_hash(),
+                VERIFY_DATA_LEN,
+            )
+            if not hmac.compare_digest(expect, body):
+                raise DtlsError("server Finished verify_data mismatch")
+            self._transcribe(msg_type, body, msg_seq)
+            self.established = True
+            self._state = "ESTABLISHED"
+            return []
+        return []
+
+    def _client_final_flight(self) -> list:
+        msgs = []
+        if self._peer_wants_cert:
+            cert_entry = len(self.cert.der).to_bytes(3, "big") + self.cert.der
+            cert_msg = len(cert_entry).to_bytes(3, "big") + cert_entry
+            msgs.append((HT_CERTIFICATE, cert_msg, False))
+        # ClientKeyExchange
+        if self._ecdh_group == GROUP_X25519:
+            self._ecdh_private = x25519.X25519PrivateKey.generate()
+            pub = self._ecdh_private.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        else:
+            self._ecdh_private = ec.generate_private_key(ec.SECP256R1())
+            pub = self._ecdh_private.public_key().public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.UncompressedPoint,
+            )
+        cke = struct.pack("!B", len(pub)) + pub
+        msgs.append((HT_CLIENT_KEY_EXCHANGE, cke, False))
+        pre_flight = self._flush_handshake(msgs)
+        self._compute_pre_master()
+        self._session_hash = self._transcript_hash()
+        cv_flight: list = []
+        if self._peer_wants_cert:
+            sig = self.cert.private_key.sign(
+                bytes(self._session_hash_input), ec.ECDSA(hashes.SHA256())
+            )
+            cv = (
+                struct.pack("!H", SIG_ECDSA_SECP256R1_SHA256)
+                + struct.pack("!H", len(sig))
+                + sig
+            )
+            cv_flight = self._flush_handshake([(HT_CERTIFICATE_VERIFY, cv, False)])
+        self._derive_keys_if_needed()
+        ccs = self._plain_record(CT_CCS, b"\x01")
+        self._send_epoch = 1
+        self._write_cipher = self._own_cipher()
+        verify = p_sha256(
+            self._master_secret,
+            b"client finished",
+            self._transcript_hash(),
+            VERIFY_DATA_LEN,
+        )
+        fin = self._flush_handshake([(HT_FINISHED, verify, True)])
+        flight = pre_flight + cv_flight + [ccs + fin[0]] + fin[1:]
+        self._last_flight = flight
+        self._state = "WAIT_SERVER_FINISHED"
+        return flight
+
+    # ------------------------------------------------------------------
+    # key schedule
+    # ------------------------------------------------------------------
+
+    def _compute_pre_master(self) -> None:
+        if self._ecdh_group == GROUP_X25519:
+            peer = x25519.X25519PublicKey.from_public_bytes(
+                bytes(self._peer_key_share)
+            )
+            self._pre_master = self._ecdh_private.exchange(peer)
+        else:
+            peer = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256R1(), bytes(self._peer_key_share)
+            )
+            self._pre_master = self._ecdh_private.exchange(ec.ECDH(), peer)
+
+    def _derive_keys_if_needed(self) -> None:
+        if self._master_secret is not None or self._pre_master is None:
+            return
+        if self._ems:
+            self._master_secret = p_sha256(
+                self._pre_master,
+                b"extended master secret",
+                self._session_hash,
+                MASTER_SECRET_LEN,
+            )
+        else:
+            self._master_secret = p_sha256(
+                self._pre_master,
+                b"master secret",
+                self._client_random + self._server_random,
+                MASTER_SECRET_LEN,
+            )
+        # AEAD key block: client_key(16) server_key(16) client_iv(4) server_iv(4)
+        kb = p_sha256(
+            self._master_secret,
+            b"key expansion",
+            self._server_random + self._client_random,
+            40,
+        )
+        self._key_block = kb
+
+    def _own_cipher(self) -> _RecordCipher:
+        kb = self._key_block
+        if self.role == "client":
+            return _RecordCipher(kb[0:16], kb[32:36])
+        return _RecordCipher(kb[16:32], kb[36:40])
+
+    def _peer_cipher(self) -> _RecordCipher:
+        kb = self._key_block
+        if self.role == "client":
+            return _RecordCipher(kb[16:32], kb[36:40])
+        return _RecordCipher(kb[0:16], kb[32:36])
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _parse_extensions(data: bytes) -> dict:
+        out: dict = {}
+        if len(data) < 2:
+            return out
+        (total,) = struct.unpack_from("!H", data, 0)
+        off = 2
+        end = min(2 + total, len(data))
+        while off + 4 <= end:
+            etype, elen = struct.unpack_from("!HH", data, off)
+            off += 4
+            out[etype] = data[off : off + elen]
+            off += elen
+        return out

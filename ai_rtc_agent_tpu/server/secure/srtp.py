@@ -1,0 +1,195 @@
+"""SRTP / SRTCP (RFC 3711), profile SRTP_AES128_CM_HMAC_SHA1_80.
+
+The reference's SRTP lives inside aiortc's C bindings (libsrtp); here it is
+~250 lines of Python over ``cryptography``'s AES-CTR/ECB + HMAC — fast
+enough for the control-plane rates this tier protects (the per-packet work
+is one AES-CTR pass over <=1200 bytes + one HMAC-SHA1; the pixel hot loop
+stays in the jitted graph and the C codec ring, untouched).
+
+Key derivation is pinned by the RFC 3711 B.3 test vectors in
+tests/test_secure_srtp.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import struct
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+AUTH_TAG_LEN = 10  # HMAC-SHA1-80
+SRTCP_INDEX_LEN = 4
+
+LABEL_RTP_ENCRYPTION = 0x00
+LABEL_RTP_AUTH = 0x01
+LABEL_RTP_SALT = 0x02
+LABEL_RTCP_ENCRYPTION = 0x03
+LABEL_RTCP_AUTH = 0x04
+LABEL_RTCP_SALT = 0x05
+
+
+def _aes_ecb(key: bytes, block: bytes) -> bytes:
+    enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+    return enc.update(block) + enc.finalize()
+
+
+def _aes_ctr(key: bytes, iv16: bytes, data: bytes) -> bytes:
+    enc = Cipher(algorithms.AES(key), modes.CTR(iv16)).encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def kdf(master_key: bytes, master_salt: bytes, label: int, out_len: int) -> bytes:
+    """AES-CM key derivation (RFC 3711 s4.3.1, kdr=0 so index/kdr = 0):
+    x = label||0^48  XOR  master_salt, keystream = AES-CM(master_key, x)."""
+    salt_int = int.from_bytes(master_salt, "big")  # 112-bit
+    x = salt_int ^ (label << 48)
+    iv = (x << 16).to_bytes(16, "big")
+    return _aes_ctr(master_key, iv, b"\x00" * out_len)
+
+
+class SrtpContext:
+    """One direction of an SRTP session (one master key/salt).
+
+    protect()/unprotect() handle SRTP packets; protect_rtcp()/
+    unprotect_rtcp() handle the (encrypted, E=1) SRTCP variant the PLI
+    keyframe-recovery channel rides on (server/rtc_native.py)."""
+
+    def __init__(self, master_key: bytes, master_salt: bytes):
+        if len(master_key) != 16 or len(master_salt) != 14:
+            raise ValueError("AES128_CM needs a 16-byte key + 14-byte salt")
+        self.session_key = kdf(master_key, master_salt, LABEL_RTP_ENCRYPTION, 16)
+        self.session_auth = kdf(master_key, master_salt, LABEL_RTP_AUTH, 20)
+        self.session_salt = kdf(master_key, master_salt, LABEL_RTP_SALT, 14)
+        self.rtcp_key = kdf(master_key, master_salt, LABEL_RTCP_ENCRYPTION, 16)
+        self.rtcp_auth = kdf(master_key, master_salt, LABEL_RTCP_AUTH, 20)
+        self.rtcp_salt = kdf(master_key, master_salt, LABEL_RTCP_SALT, 14)
+        # rollover counter state per SSRC: ssrc -> [roc, highest_seq_seen]
+        self._roc: dict = {}
+        self._rtcp_index = 0  # our outbound SRTCP index (31-bit)
+
+    # -- packet index (RFC 3711 s3.3.1 + appendix A) --------------------
+
+    def _estimate_index(self, ssrc: int, seq: int, update: bool) -> int:
+        roc, s_l = self._roc.get(ssrc, (0, None))
+        if s_l is None:
+            v = roc
+        elif s_l < 32768:
+            v = roc - 1 if (seq - s_l > 32768) else roc
+        else:
+            v = roc + 1 if (s_l - seq > 32768) else roc
+        v = max(v, 0)
+        if update:
+            if s_l is None:
+                self._roc[ssrc] = (roc, seq)
+            elif v > roc:
+                self._roc[ssrc] = (v, seq)
+            elif v == roc and seq > s_l:
+                self._roc[ssrc] = (roc, seq)
+            # v == roc-1: late packet from the previous rollover — no update
+        return (v << 16) | seq
+
+    def _keystream_iv(self, salt: bytes, ssrc: int, index: int) -> bytes:
+        salt_int = int.from_bytes(salt, "big")
+        iv = (salt_int << 16) ^ (ssrc << 64) ^ (index << 16)
+        return (iv & ((1 << 128) - 1)).to_bytes(16, "big")
+
+    # -- SRTP ------------------------------------------------------------
+
+    @staticmethod
+    def _payload_offset(pkt: bytes) -> int:
+        """RTP header length: 12 + 4*CC (+ extension if X set)."""
+        if len(pkt) < 12:
+            raise ValueError("short RTP packet")
+        off = 12 + 4 * (pkt[0] & 0x0F)
+        if pkt[0] & 0x10:  # extension
+            if len(pkt) < off + 4:
+                raise ValueError("truncated RTP extension")
+            ext_words = struct.unpack_from("!H", pkt, off + 2)[0]
+            off += 4 + 4 * ext_words
+        if off > len(pkt):
+            raise ValueError("truncated RTP packet")
+        return off
+
+    def protect(self, pkt: bytes) -> bytes:
+        ssrc = struct.unpack_from("!I", pkt, 8)[0]
+        seq = struct.unpack_from("!H", pkt, 2)[0]
+        index = self._estimate_index(ssrc, seq, update=True)
+        off = self._payload_offset(pkt)
+        iv = self._keystream_iv(self.session_salt, ssrc, index)
+        enc = pkt[:off] + _aes_ctr(self.session_key, iv, pkt[off:])
+        roc = index >> 16
+        tag = hmac.new(
+            self.session_auth, enc + struct.pack("!I", roc), hashlib.sha1
+        ).digest()[:AUTH_TAG_LEN]
+        return enc + tag
+
+    def unprotect(self, pkt: bytes) -> bytes:
+        if len(pkt) < 12 + AUTH_TAG_LEN:
+            raise ValueError("short SRTP packet")
+        enc, tag = pkt[:-AUTH_TAG_LEN], pkt[-AUTH_TAG_LEN:]
+        ssrc = struct.unpack_from("!I", enc, 8)[0]
+        seq = struct.unpack_from("!H", enc, 2)[0]
+        index = self._estimate_index(ssrc, seq, update=False)
+        roc = index >> 16
+        expect = hmac.new(
+            self.session_auth, enc + struct.pack("!I", roc), hashlib.sha1
+        ).digest()[:AUTH_TAG_LEN]
+        if not hmac.compare_digest(expect, tag):
+            raise ValueError("SRTP auth failure")
+        self._estimate_index(ssrc, seq, update=True)
+        off = self._payload_offset(enc)
+        iv = self._keystream_iv(self.session_salt, ssrc, index)
+        return enc[:off] + _aes_ctr(self.session_key, iv, enc[off:])
+
+    # -- SRTCP (RFC 3711 s3.4) -------------------------------------------
+
+    def protect_rtcp(self, pkt: bytes) -> bytes:
+        if len(pkt) < 8:
+            raise ValueError("short RTCP packet")
+        ssrc = struct.unpack_from("!I", pkt, 4)[0]
+        self._rtcp_index = (self._rtcp_index + 1) & 0x7FFFFFFF
+        index = self._rtcp_index
+        iv = self._keystream_iv(self.rtcp_salt, ssrc, index)
+        enc = pkt[:8] + _aes_ctr(self.rtcp_key, iv, pkt[8:])
+        e_index = struct.pack("!I", index | 0x80000000)  # E=1: encrypted
+        tag = hmac.new(self.rtcp_auth, enc + e_index, hashlib.sha1).digest()[
+            :AUTH_TAG_LEN
+        ]
+        return enc + e_index + tag
+
+    def unprotect_rtcp(self, pkt: bytes) -> bytes:
+        if len(pkt) < 8 + SRTCP_INDEX_LEN + AUTH_TAG_LEN:
+            raise ValueError("short SRTCP packet")
+        tag = pkt[-AUTH_TAG_LEN:]
+        e_index = pkt[-(AUTH_TAG_LEN + SRTCP_INDEX_LEN) : -AUTH_TAG_LEN]
+        enc = pkt[: -(AUTH_TAG_LEN + SRTCP_INDEX_LEN)]
+        expect = hmac.new(
+            self.rtcp_auth, enc + e_index, hashlib.sha1
+        ).digest()[:AUTH_TAG_LEN]
+        if not hmac.compare_digest(expect, tag):
+            raise ValueError("SRTCP auth failure")
+        raw_index = struct.unpack("!I", e_index)[0]
+        if not raw_index & 0x80000000:  # E=0: payload was never encrypted
+            return enc
+        index = raw_index & 0x7FFFFFFF
+        ssrc = struct.unpack_from("!I", enc, 4)[0]
+        iv = self._keystream_iv(self.rtcp_salt, ssrc, index)
+        return enc[:8] + _aes_ctr(self.rtcp_key, iv, enc[8:])
+
+
+def derive_srtp_contexts(
+    keying_material: bytes, is_server: bool
+) -> tuple:
+    """Split the 60-byte DTLS-SRTP exporter output (RFC 5764 s4.2:
+    client_key || server_key || client_salt || server_salt) into
+    (tx_context, rx_context) for our role."""
+    if len(keying_material) < 60:
+        raise ValueError("need 2*(16+14) bytes of keying material")
+    ck, sk = keying_material[0:16], keying_material[16:32]
+    cs, ss = keying_material[32:46], keying_material[46:60]
+    client = SrtpContext(ck, cs)
+    server = SrtpContext(sk, ss)
+    # the server SENDS with the server write key and receives client-keyed
+    # packets (and vice versa)
+    return (server, client) if is_server else (client, server)

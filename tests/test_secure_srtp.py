@@ -1,0 +1,131 @@
+"""SRTP (server/secure/srtp.py) pinned against RFC 3711 test vectors.
+
+The key-derivation vectors are Appendix B.3 of the RFC — byte-exact
+published values, so the KDF is pinned independently of our own code.
+"""
+
+import struct
+
+import pytest
+
+from ai_rtc_agent_tpu.server.secure import srtp
+
+B3_MASTER_KEY = bytes.fromhex("E1F97A0D3E018BE0D64FA32C06DE4139")
+B3_MASTER_SALT = bytes.fromhex("0EC675AD498AFEEBB6960B3AABE6")
+
+
+def test_rfc3711_b3_cipher_key():
+    out = srtp.kdf(B3_MASTER_KEY, B3_MASTER_SALT, srtp.LABEL_RTP_ENCRYPTION, 16)
+    assert out == bytes.fromhex("C61E7A93744F39EE10734AFE3FF7A087")
+
+
+def test_rfc3711_b3_cipher_salt():
+    out = srtp.kdf(B3_MASTER_KEY, B3_MASTER_SALT, srtp.LABEL_RTP_SALT, 14)
+    assert out == bytes.fromhex("30CBBC08863D8C85D49DB34A9AE1")
+
+
+def test_rfc3711_b3_auth_key():
+    out = srtp.kdf(B3_MASTER_KEY, B3_MASTER_SALT, srtp.LABEL_RTP_AUTH, 20)
+    assert out == bytes.fromhex("CEBE321F6FF7716B6FD4AB49AF256A156D38BAA4")
+
+
+def _rtp_packet(seq: int, ssrc: int = 0x1234, payload: bytes = b"\xab" * 160):
+    return (
+        struct.pack("!BBHII", 0x80, 96, seq & 0xFFFF, 1000 + seq, ssrc)
+        + payload
+    )
+
+
+class TestSrtpRoundtrip:
+    def _pair(self):
+        key, salt = b"k" * 16, b"s" * 14
+        return srtp.SrtpContext(key, salt), srtp.SrtpContext(key, salt)
+
+    def test_protect_unprotect(self):
+        tx, rx = self._pair()
+        pkt = _rtp_packet(1)
+        wire = tx.protect(pkt)
+        assert len(wire) == len(pkt) + srtp.AUTH_TAG_LEN
+        assert wire[:12] == pkt[:12]  # header in clear
+        assert wire[12 : len(pkt)] != pkt[12:]  # payload encrypted
+        assert rx.unprotect(wire) == pkt
+
+    def test_tamper_detected(self):
+        tx, rx = self._pair()
+        wire = bytearray(tx.protect(_rtp_packet(1)))
+        wire[20] ^= 0x01
+        with pytest.raises(ValueError, match="auth"):
+            rx.unprotect(bytes(wire))
+
+    def test_wrong_key_detected(self):
+        tx = srtp.SrtpContext(b"k" * 16, b"s" * 14)
+        rx = srtp.SrtpContext(b"K" * 16, b"s" * 14)
+        with pytest.raises(ValueError, match="auth"):
+            rx.unprotect(tx.protect(_rtp_packet(1)))
+
+    def test_sequence_rollover_keeps_decrypting(self):
+        """ROC advances at the 16-bit seq wrap; both ends stay in sync
+        (RFC 3711 s3.3.1 index estimation)."""
+        tx, rx = self._pair()
+        for seq in [65533, 65534, 65535, 0, 1, 2]:
+            pkt = _rtp_packet(seq)
+            assert rx.unprotect(tx.protect(pkt)) == pkt
+        assert tx._roc[0x1234][0] == 1  # rolled over exactly once
+
+    def test_distinct_ssrc_independent_roc(self):
+        tx, rx = self._pair()
+        for ssrc in (0x111, 0x222):
+            pkt = _rtp_packet(7, ssrc=ssrc)
+            assert rx.unprotect(tx.protect(pkt)) == pkt
+
+    def test_csrc_and_extension_headers_stay_clear(self):
+        tx, rx = self._pair()
+        # CC=1 (one CSRC), X=1 (4-byte extension with 1 word)
+        hdr = struct.pack("!BBHII", 0x80 | 0x10 | 0x01, 96, 5, 99, 0x77)
+        hdr += struct.pack("!I", 0xDEADBEEF)  # CSRC
+        hdr += struct.pack("!HH", 0xBEDE, 1) + b"\x00" * 4  # extension
+        pkt = hdr + b"payload-bytes"
+        wire = tx.protect(pkt)
+        assert wire[: len(hdr)] == hdr
+        assert rx.unprotect(wire) == pkt
+
+
+class TestSrtcp:
+    def test_rtcp_roundtrip(self):
+        key, salt = b"q" * 16, b"z" * 14
+        tx, rx = srtp.SrtpContext(key, salt), srtp.SrtpContext(key, salt)
+        # RTCP PLI-shaped packet: V=2 PT=206 FMT=1, sender+media ssrc
+        pkt = struct.pack("!BBHII", 0x81, 206, 2, 0xAAA, 0xBBB)
+        wire = tx.protect_rtcp(pkt)
+        assert len(wire) == len(pkt) + 4 + srtp.AUTH_TAG_LEN
+        assert wire[:8] == pkt[:8]
+        assert rx.unprotect_rtcp(wire) == pkt
+
+    def test_rtcp_tamper_detected(self):
+        key, salt = b"q" * 16, b"z" * 14
+        tx, rx = srtp.SrtpContext(key, salt), srtp.SrtpContext(key, salt)
+        wire = bytearray(tx.protect_rtcp(struct.pack("!BBHII", 0x81, 206, 2, 1, 2)))
+        wire[9] ^= 0x01
+        with pytest.raises(ValueError, match="auth"):
+            rx.unprotect_rtcp(bytes(wire))
+
+    def test_rtcp_index_increments(self):
+        key, salt = b"q" * 16, b"z" * 14
+        tx, rx = srtp.SrtpContext(key, salt), srtp.SrtpContext(key, salt)
+        pkt = struct.pack("!BBHII", 0x81, 206, 2, 1, 2)
+        w1, w2 = tx.protect_rtcp(pkt), tx.protect_rtcp(pkt)
+        assert w1 != w2  # index (and so keystream) differs
+        assert rx.unprotect_rtcp(w1) == pkt
+        assert rx.unprotect_rtcp(w2) == pkt
+
+
+def test_derive_srtp_contexts_roles_mirror():
+    km = bytes(range(60))
+    srv_tx, srv_rx = srtp.derive_srtp_contexts(km, is_server=True)
+    cli_tx, cli_rx = srtp.derive_srtp_contexts(km, is_server=False)
+    pkt = _rtp_packet(3)
+    # server-sent packet decrypts with the client's rx context
+    assert cli_rx.unprotect(srv_tx.protect(pkt)) == pkt
+    assert srv_rx.unprotect(cli_tx.protect(pkt)) == pkt
+    with pytest.raises(ValueError):
+        srtp.derive_srtp_contexts(km[:30], is_server=True)

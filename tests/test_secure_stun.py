@@ -1,0 +1,165 @@
+"""STUN (server/secure/stun.py) pinned against RFC 5769 test vectors.
+
+The reference's STUN/ICE lives in aiortc (reference agent.py:13-20); these
+vectors pin our wire format against the IETF's published byte-exact
+samples, not against our own encoder.
+"""
+
+import struct
+
+from ai_rtc_agent_tpu.server.secure import stun
+
+def _rfc5769_request() -> bytes:
+    # s2.1 — sample request: SOFTWARE "STUN test client", PRIORITY,
+    # ICE-CONTROLLED, USERNAME "evtj:h6vY", MESSAGE-INTEGRITY keyed
+    # "VOkJxbRl1RmTxUk/WvJxBt", FINGERPRINT
+    return bytes.fromhex(
+        "000100582112a442b7e7a701bc34d686fa87dfae"
+        "80220010"
+        "5354554e207465737420636c69656e74"
+        "00240004"
+        "6e0001ff"
+        "80290008"
+        "932ff9b151263b36"
+        "00060009"
+        "6576746a3a68367659202020"
+        "00080014"
+        "9aeaa70cbfd8cb56781ef2b5b2d3f249c1b571a2"
+        "80280004"
+        "e57a3bcf"
+    )
+
+
+def _rfc5769_response() -> bytes:
+    # s2.2 — sample IPv4 response (XOR-MAPPED-ADDRESS 192.0.2.1:32853,
+    # SOFTWARE "test vector")
+    return bytes.fromhex(
+        "0101003c2112a442b7e7a701bc34d686fa87dfae"
+        "8022000b"
+        "7465737420766563746f7220"
+        "00200008"
+        "0001a147e112a643"
+        "00080014"
+        "2b91f599fd9e90c38c7489f92af9ba53f06be7d7"
+        "80280004"
+        "c07d4c96"
+    )
+
+
+def test_rfc5769_request_decodes_and_verifies():
+    raw = _rfc5769_request()
+    assert stun.is_stun(raw)
+    msg = stun.StunMessage.decode(raw)
+    assert msg.message_type == stun.BINDING_REQUEST
+    assert msg.get(stun.ATTR_USERNAME) == b"evtj:h6vY"
+    assert msg.verify_integrity(b"VOkJxbRl1RmTxUk/WvJxBt", raw)
+    # wrong key must fail
+    assert not msg.verify_integrity(b"wrong-password", raw)
+
+
+def test_rfc5769_response_xor_mapped_address():
+    msg = stun.StunMessage.decode(_rfc5769_response())
+    assert msg.message_type == stun.BINDING_SUCCESS
+    assert msg.xor_mapped_address() == ("192.0.2.1", 32853)
+
+
+def test_xor_address_roundtrip():
+    val = stun.StunMessage.xor_address_value("203.0.113.7", 61000)
+    msg = stun.StunMessage(stun.BINDING_SUCCESS)
+    msg.attributes.append((stun.ATTR_XOR_MAPPED_ADDRESS, val))
+    raw = msg.encode()
+    back = stun.StunMessage.decode(raw)
+    assert back.xor_mapped_address() == ("203.0.113.7", 61000)
+
+
+def test_encode_with_integrity_verifies():
+    msg = stun.StunMessage(stun.BINDING_REQUEST)
+    msg.attributes.append((stun.ATTR_USERNAME, b"abcd:efgh"))
+    raw = msg.encode(integrity_key=b"secret-pwd")
+    back = stun.StunMessage.decode(raw)
+    assert back.verify_integrity(b"secret-pwd", raw)
+    # fingerprint attribute must be last and valid per RFC 5389 s15.5
+    assert back.attributes[-1][0] == stun.ATTR_FINGERPRINT
+
+
+def test_tampered_message_fails_integrity():
+    msg = stun.StunMessage(stun.BINDING_REQUEST)
+    msg.attributes.append((stun.ATTR_USERNAME, b"abcd:efgh"))
+    raw = bytearray(msg.encode(integrity_key=b"secret-pwd"))
+    raw[25] ^= 0xFF  # flip a bit inside USERNAME
+    back = stun.StunMessage.decode(bytes(raw))
+    assert not back.verify_integrity(b"secret-pwd", bytes(raw))
+
+
+class TestIceLiteResponder:
+    def _bind_request(self, resp: stun.IceLiteResponder, use_candidate=True):
+        msg = stun.StunMessage(stun.BINDING_REQUEST)
+        msg.attributes.append(
+            (stun.ATTR_USERNAME, f"{resp.ufrag}:clientfrag".encode())
+        )
+        msg.attributes.append((stun.ATTR_PRIORITY, struct.pack("!I", 12345)))
+        if use_candidate:
+            msg.attributes.append((stun.ATTR_USE_CANDIDATE, b""))
+        return msg.encode(integrity_key=resp.pwd.encode())
+
+    def test_authenticated_binding_gets_success_and_latches(self):
+        resp = stun.IceLiteResponder()
+        raw = self._bind_request(resp)
+        reply = resp.handle(raw, ("198.51.100.9", 50000))
+        assert reply is not None
+        back = stun.StunMessage.decode(reply)
+        assert back.message_type == stun.BINDING_SUCCESS
+        assert back.transaction_id == stun.StunMessage.decode(raw).transaction_id
+        assert back.xor_mapped_address() == ("198.51.100.9", 50000)
+        # reply is integrity-protected with our pwd (RFC 8445 s7.3)
+        assert back.verify_integrity(resp.pwd.encode(), reply)
+        assert resp.nominated_addr == ("198.51.100.9", 50000)
+
+    def test_wrong_password_is_dropped(self):
+        resp = stun.IceLiteResponder()
+        msg = stun.StunMessage(stun.BINDING_REQUEST)
+        msg.attributes.append(
+            (stun.ATTR_USERNAME, f"{resp.ufrag}:x".encode())
+        )
+        raw = msg.encode(integrity_key=b"not-the-password")
+        assert resp.handle(raw, ("198.51.100.9", 50000)) is None
+        assert resp.nominated_addr is None
+
+    def test_wrong_ufrag_is_dropped(self):
+        resp = stun.IceLiteResponder()
+        msg = stun.StunMessage(stun.BINDING_REQUEST)
+        msg.attributes.append((stun.ATTR_USERNAME, b"someoneelse:x"))
+        raw = msg.encode(integrity_key=resp.pwd.encode())
+        assert resp.handle(raw, ("198.51.100.9", 50000)) is None
+
+    def test_credentialless_probe_answered_but_never_latches(self):
+        """A spoofed credential-less Binding Request must not steer media
+        (code-review r4): it still gets its XOR-MAPPED-ADDRESS reply, but
+        only MESSAGE-INTEGRITY-verified requests may latch the peer addr."""
+        resp = stun.IceLiteResponder()
+        probe = stun.StunMessage(stun.BINDING_REQUEST).encode()
+        reply = resp.handle(probe, ("203.0.113.66", 4444))
+        assert reply is not None
+        assert stun.StunMessage.decode(reply).xor_mapped_address() == (
+            "203.0.113.66",
+            4444,
+        )
+        assert resp.nominated_addr is None
+        assert resp.seen_addr is None
+        # an authenticated request from the real peer then wins the latch
+        raw = self._bind_request(resp)
+        resp.handle(raw, ("198.51.100.9", 50000))
+        assert resp.nominated_addr == ("198.51.100.9", 50000)
+
+    def test_non_stun_and_malformed_ignored(self):
+        resp = stun.IceLiteResponder()
+        assert resp.handle(b"\x80\x60aaaa", ("1.2.3.4", 5)) is None
+        assert resp.handle(b"\x00\x01", ("1.2.3.4", 5)) is None
+
+    def test_ice_string_alphabet(self):
+        s = stun.random_ice_string(22)
+        assert len(s) == 22
+        allowed = set(
+            "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/"
+        )
+        assert set(s) <= allowed
