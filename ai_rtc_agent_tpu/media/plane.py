@@ -64,6 +64,13 @@ class H264RingSource:
         self.use_h264 = native.h264_available() if use_h264 is None else use_h264
         self._dec = H264Decoder() if self.use_h264 else None
         self._ring = FrameRing((height, width, 3), n_slots=ring_slots)
+        self._ring_slots = ring_slots
+        # rings replaced by a geometry change are RETIRED, not freed: the
+        # consumer thread reads self._ring without a lock, so an immediate
+        # native destroy would race a concurrent pop() (use-after-free);
+        # close() reaps them when the consumer is provably gone
+        self._retired_rings: list = []
+        self._dropped_before_resize = 0
         self._depkt = RtpDepacketizer() if native.load() else None
         self._reorder = RtpReorderBuffer()
         self._meta: dict = {}  # pts -> wall_ts at decode completion
@@ -144,6 +151,18 @@ class H264RingSource:
             if len(self._meta) > 64:  # bound the pts->wall map
                 for k in sorted(self._meta)[:-64]:
                     self._meta.pop(k, None)
+            if frame.shape != self._ring.frame_shape:
+                # real-SDP offers carry no geometry — the H.264 SPS is the
+                # source of truth.  A browser camera at any resolution must
+                # work, so the ring follows the decoder, not the ctor hint.
+                logger.info(
+                    "stream geometry %s != configured %s — resizing ring",
+                    frame.shape,
+                    self._ring.frame_shape,
+                )
+                self._dropped_before_resize += self._ring.dropped
+                self._retired_rings.append(self._ring)
+                self._ring = FrameRing(frame.shape, n_slots=self._ring_slots)
             self._ring.push_latest(frame, meta=int(out_pts))
         if self._loop is not None and self._frame_event is not None:
             try:
@@ -191,12 +210,15 @@ class H264RingSource:
 
     @property
     def dropped(self) -> int:
-        return self._ring.dropped
+        return self._ring.dropped + self._dropped_before_resize
 
     def close(self):
         with self._io_lock:  # never free the decoder under an active decode
             self._closed = True
             self._ring.close()
+            for ring in self._retired_rings:  # geometry-change leftovers
+                ring.close()
+            self._retired_rings.clear()
             if self._dec:
                 self._dec.close()
             if self._depkt:
@@ -222,6 +244,8 @@ class H264Sink:
         self.stats = stats or FrameStats()
         self.use_h264 = native.h264_available() if use_h264 is None else use_h264
         self._enc = H264Encoder(width, height, fps) if self.use_h264 else None
+        self._wh = (height, width)
+        self._fps = fps
         self._pkt = (
             RtpPacketizer(ssrc=ssrc, payload_type=payload_type)
             if native.load()
@@ -242,6 +266,18 @@ class H264Sink:
         self._pts = int(pts) + self._pts_step
 
         t0 = time.monotonic()
+        if self.use_h264 and arr.shape[:2] != self._wh:
+            # the pipeline's output geometry is the model's, which a
+            # real-SDP answer cannot know up front — restart the encoder at
+            # the true size (new SPS; decoders re-sync on it)
+            logger.info(
+                "encode geometry %s != configured %s — restarting encoder",
+                arr.shape[:2],
+                self._wh,
+            )
+            self._enc.close()
+            self._wh = (arr.shape[0], arr.shape[1])
+            self._enc = H264Encoder(arr.shape[1], arr.shape[0], self._fps)
         if self.use_h264:
             au = self._enc.encode(arr, pts=int(pts))
         else:

@@ -65,8 +65,13 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
     PLI_MIN_INTERVAL = 0.25  # s — bound the PLI storm under loss bursts
 
-    def __init__(self, source: H264RingSource, on_pli=None):
+    def __init__(self, source: H264RingSource | None, on_pli=None, session=None):
+        """`session`: a secure.SecureMediaSession — when given, this socket
+        speaks the full RFC 7983 mux (STUN + DTLS + SRTP/SRTCP) instead of
+        plain RTP; `source` may be None for a send-only (WHEP) secure peer
+        whose socket still has to answer ICE checks and the handshake."""
         self.source = source
+        self.session = session
         self.transport = None
         self._on_pli = on_pli
         self._last_addr = None
@@ -74,8 +79,9 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         self._q: asyncio.Queue = asyncio.Queue(maxsize=256)
         self._task = asyncio.ensure_future(self._decode_loop())
         self._loop = asyncio.get_event_loop()
-        # fired on the decode worker thread -> hop back to the loop to send
-        source.on("decode_error", self._request_keyframe_threadsafe)
+        if source is not None:
+            # fired on the decode worker thread -> hop back to the loop
+            source.on("decode_error", self._request_keyframe_threadsafe)
 
     def connection_made(self, transport):
         self.transport = transport
@@ -100,18 +106,50 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         try:
             from ..media import rtp as R
 
-            self.transport.sendto(R.make_pli(), self._last_addr)
+            pkt = R.make_pli()
+            if self.session is not None:
+                pkt = self.session.protect_rtcp(pkt)
+                if pkt is None:
+                    return  # keys not derived yet — nothing to recover
+            self.transport.sendto(pkt, self._last_addr)
         except Exception:
             logger.exception("PLI send failed")
+
+    def send_media(self, packet: bytes) -> bool:
+        """Outbound RTP through this socket (secure tier: SRTP-protected to
+        the ICE-latched peer).  Returns False while not yet sendable."""
+        if self.transport is None:
+            return False
+        if self.session is None:
+            return False  # plain tier sends on its own socket
+        wire = self.session.protect_rtp(packet)
+        addr = self.session.peer_addr
+        if wire is None or addr is None:
+            return False
+        self.transport.sendto(wire, addr)
+        return True
 
     def datagram_received(self, data, addr):
         from ..media import rtp as R
 
-        self._last_addr = addr
-        if R.is_pli(data):
-            if self._on_pli is not None:
-                self._on_pli()
-            return
+        if self.session is not None:
+            outs, kind, payload = self.session.handle(data, addr)
+            for d, a in outs:
+                self.transport.sendto(d, a)
+            if kind == "rtcp":
+                if R.is_pli(payload) and self._on_pli is not None:
+                    self._on_pli()
+                return
+            if kind != "rtp" or self.source is None:
+                return
+            data = payload
+            self._last_addr = self.session.peer_addr or addr
+        else:
+            self._last_addr = addr
+            if R.is_pli(data):
+                if self._on_pli is not None:
+                    self._on_pli()
+                return
         try:
             # reorder + depacketize inline (microseconds); queue only
             # COMPLETED access units so the worker hop is per frame
@@ -177,6 +215,7 @@ class NativeRtpPeerConnection:
         self._payload: dict = {}
         self._sdp_offer = None  # parsed real-SDP offer (server/sdp.py)
         self._h264_pt: int | None = None  # offered H264 payload type
+        self._secure_session = None  # secure.SecureMediaSession (DTLS tier)
         self.server_port: int | None = None
         self.pc_id = str(uuid.uuid4())
 
@@ -237,6 +276,25 @@ class NativeRtpPeerConnection:
                 "video": video.direction in ("sendonly", "sendrecv"),
             }
             payload = self._payload
+            if offer.is_secure():
+                # browser-shaped offer: ICE-lite + DTLS-SRTP on ONE socket
+                # (the tier the reference gets from aiortc; built in-repo —
+                # server/secure/).  Media flows only after the handshake.
+                if offer.fingerprint_algo != "sha-256":
+                    # refusing beats silently comparing a sha-384 value
+                    # against our sha-256 digest (every connection would die
+                    # with a misleading "fingerprint mismatch")
+                    raise ValueError(
+                        "only sha-256 DTLS fingerprints are supported "
+                        f"(offer used {offer.fingerprint_algo!r})"
+                    )
+                from .secure import SecureMediaSession
+
+                self._secure_session = SecureMediaSession(
+                    certificate=self._provider.dtls_certificate,
+                    remote_fingerprint=offer.fingerprint,
+                    remote_ufrag=offer.ice_ufrag,
+                )
         else:
             try:
                 payload = json.loads(desc.sdp)
@@ -250,26 +308,37 @@ class NativeRtpPeerConnection:
             if payload.get("client_addr"):
                 host, port = payload["client_addr"]
                 self._client_addr = (str(host), int(port))
-        if payload.get("video", True):
-            w = int(payload.get("width", self._provider.default_width))
-            h = int(payload.get("height", self._provider.default_height))
-            self.in_track = H264RingSource(
-                w, h, stats=self._provider.stats,
-                use_h264=self._provider.use_h264,
-            )
+        wants_video = payload.get("video", True)
+        if wants_video or self._secure_session is not None:
+            if wants_video:
+                w = int(payload.get("width", self._provider.default_width))
+                h = int(payload.get("height", self._provider.default_height))
+                self.in_track = H264RingSource(
+                    w, h, stats=self._provider.stats,
+                    use_h264=self._provider.use_h264,
+                )
             loop = asyncio.get_event_loop()
-            # port 0 routes through the pinned-UDP-port patch when active
+            # port 0 routes through the pinned-UDP-port patch when active;
+            # in the secure tier this one socket carries EVERYTHING —
+            # ICE checks, the DTLS handshake, SRTP in and SRTCP/SRTP out
             self._recv_transport, self._recv_protocol = (
                 await loop.create_datagram_endpoint(
                     lambda: _RtpReceiverProtocol(
-                        self.in_track, on_pli=self._force_sink_keyframe
+                        self.in_track,
+                        on_pli=self._force_sink_keyframe,
+                        session=self._secure_session,
                     ),
                     local_addr=("0.0.0.0", 0),
                 )
             )
             self.server_port = self._recv_transport.get_extra_info("sockname")[1]
-            await self._emit("track", self.in_track)
-        if not payload.get("video", True) and self._client_addr is not None:
+            if self.in_track is not None:
+                await self._emit("track", self.in_track)
+        if (
+            not wants_video
+            and self._secure_session is None
+            and self._client_addr is not None
+        ):
             # pure send side (WHEP viewer): bind the send socket NOW so the
             # answer advertises ITS port — the viewer's RTCP PLI must have a
             # reachable target or keyframe recovery never engages
@@ -281,11 +350,19 @@ class NativeRtpPeerConnection:
         if self._sdp_offer is not None:
             # real SDP in -> real SDP out; port 9 (discard) when we opened
             # no receive socket (pure WHEP send side)
+            secure = None
+            if self._secure_session is not None:
+                secure = {
+                    "ice_ufrag": self._secure_session.ice.ufrag,
+                    "ice_pwd": self._secure_session.ice.pwd,
+                    "fingerprint": self._secure_session.fingerprint(),
+                }
             return SessionDescription(
                 sdp=sdp.build_answer(
                     self._sdp_offer,
                     host=self._provider.advertise_host,
                     video_port=self.server_port or 9,
+                    secure=secure,
                 ),
                 type="answer",
             )
@@ -325,9 +402,15 @@ class NativeRtpPeerConnection:
         )
 
     async def _start_senders(self):
-        if not self.out_tracks or self._client_addr is None:
+        if not self.out_tracks:
             return
-        await self._ensure_send_transport()
+        if self._secure_session is None:
+            if self._client_addr is None:
+                return
+            await self._ensure_send_transport()
+        # secure tier: outbound SRTP rides the ONE demuxed socket, to the
+        # ICE-latched address — the SDP c= line of a browser offer is
+        # useless (0.0.0.0 / trickle), so there is no _client_addr to need
         w = int(self._payload.get("width", self._provider.default_width))
         h = int(self._payload.get("height", self._provider.default_height))
         self._sink = H264Sink(
@@ -347,7 +430,11 @@ class NativeRtpPeerConnection:
             while self.connectionState != "closed":
                 frame = await track.recv()
                 for pkt in await asyncio.to_thread(sink.consume, frame):
-                    self._send_transport.sendto(pkt)
+                    if self._secure_session is not None:
+                        # drops silently until DTLS keys + ICE latch exist
+                        self._recv_protocol.send_media(pkt)
+                    else:
+                        self._send_transport.sendto(pkt)
         except (ConnectionError, asyncio.CancelledError):
             pass
         except Exception:
@@ -397,6 +484,17 @@ class NativeRtpProvider:
         self.advertise_host = advertise_host or os.getenv(
             "ADVERTISE_HOST", "127.0.0.1"
         )
+        self._dtls_certificate = None
+
+    @property
+    def dtls_certificate(self):
+        """One DTLS identity per provider (lazy: ECDSA keygen only when a
+        secure offer actually arrives)."""
+        if self._dtls_certificate is None:
+            from .secure import generate_certificate
+
+            self._dtls_certificate = generate_certificate()
+        return self._dtls_certificate
 
     def attach_stats(self, stats: FrameStats):
         self.stats = stats

@@ -69,6 +69,15 @@ class SdpOffer:
     media: list
     ice_ufrag: str | None
     raw: str
+    ice_pwd: str | None = None
+    fingerprint: str | None = None  # value only (colon-hex)
+    fingerprint_algo: str | None = None  # e.g. "sha-256"
+    setup: str | None = None  # actpass | active | passive
+
+    def is_secure(self) -> bool:
+        """A browser/OBS WebRTC offer: DTLS fingerprint present (the
+        UDP/TLS/RTP/SAVPF tier the reference serves via aiortc)."""
+        return self.fingerprint is not None
 
     def video(self) -> MediaSection | None:
         for m in self.media:
@@ -85,8 +94,30 @@ def is_sdp(text: str) -> bool:
 def parse(text: str) -> SdpOffer:
     session_conn = None
     ice_ufrag = None
+    ice_pwd = None
+    fingerprint = None
+    fingerprint_algo = None
+    setup = None
     media: list = []
     cur: MediaSection | None = None
+
+    def _secure_attr(val: str) -> bool:
+        # fingerprint/ice credentials appear at session OR media level
+        # (browsers put them per-media); first value wins either way
+        nonlocal ice_ufrag, ice_pwd, fingerprint, fingerprint_algo, setup
+        if val.startswith("ice-ufrag:") and ice_ufrag is None:
+            ice_ufrag = val.split(":", 1)[1]
+        elif val.startswith("ice-pwd:") and ice_pwd is None:
+            ice_pwd = val.split(":", 1)[1]
+        elif val.startswith("fingerprint:") and fingerprint is None:
+            parts = val.split(":", 1)[1].split(None, 1)
+            if len(parts) == 2:
+                fingerprint_algo, fingerprint = parts[0].lower(), parts[1]
+        elif val.startswith("setup:") and setup is None:
+            setup = val.split(":", 1)[1]
+        else:
+            return False
+        return True
 
     for raw_line in text.replace("\r\n", "\n").split("\n"):
         line = raw_line.strip()
@@ -117,10 +148,10 @@ def parse(text: str) -> SdpOffer:
                 cur.connection = addr
         elif key == "a":
             if cur is None:
-                if val.startswith("ice-ufrag:"):
-                    ice_ufrag = val.split(":", 1)[1]
+                _secure_attr(val)
                 continue
             cur.attrs.append(val)
+            _secure_attr(val)
             if val.startswith("rtpmap:"):
                 m = re.match(r"rtpmap:(\d+)\s+(\S+)", val)
                 if m:
@@ -133,8 +164,6 @@ def parse(text: str) -> SdpOffer:
                 cur.direction = val
             elif val.startswith("mid:"):
                 cur.mid = val.split(":", 1)[1]
-            elif val.startswith("ice-ufrag:") and ice_ufrag is None:
-                ice_ufrag = val.split(":", 1)[1]
     if not media:
         raise ValueError("offer has no m= sections")
     return SdpOffer(
@@ -142,6 +171,10 @@ def parse(text: str) -> SdpOffer:
         media=media,
         ice_ufrag=ice_ufrag,
         raw=text,
+        ice_pwd=ice_pwd,
+        fingerprint=fingerprint,
+        fingerprint_algo=fingerprint_algo,
+        setup=setup,
     )
 
 
@@ -158,8 +191,15 @@ def build_answer(
     host: str,
     video_port: int,
     session_id: int = 1,
+    secure: dict | None = None,
 ) -> str:
-    """Answer accepting H264 video over plain RTP; everything else rejected.
+    """Answer accepting H264 video; everything else rejected.
+
+    Plain RTP by default; when `secure` is given (keys: ice_ufrag, ice_pwd,
+    fingerprint) the answer carries the ICE-lite + DTLS-SRTP surface a
+    browser requires: a=ice-lite, per-media ice credentials,
+    a=fingerprint:sha-256, a=setup:passive (we are always the DTLS server —
+    the reference's aiortc answers actpass offers the same way).
 
     The host candidate is embedded in the answer (a=candidate +
     a=end-of-candidates): full gather before answering, never trickle —
@@ -171,6 +211,8 @@ def build_answer(
         "s=tpu-rtc-agent",
         "t=0 0",
     ]
+    if secure is not None:
+        lines.append("a=ice-lite")
     for m in offer.media:
         if m.kind != "video":
             # rejected section: port 0, mirror the proto + first payload
@@ -189,6 +231,11 @@ def build_answer(
             lines.append(f"a=fmtp:{pt} {fmtp}")
         if m.mid is not None:
             lines.append(f"a=mid:{m.mid}")
+        if secure is not None:
+            lines.append(f"a=ice-ufrag:{secure['ice_ufrag']}")
+            lines.append(f"a=ice-pwd:{secure['ice_pwd']}")
+            lines.append(f"a=fingerprint:sha-256 {secure['fingerprint']}")
+            lines.append("a=setup:passive")
         lines.append(f"a={_MIRROR.get(m.direction, 'sendrecv')}")
         lines.append("a=rtcp-mux")
         lines.append(

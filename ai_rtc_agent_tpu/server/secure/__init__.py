@@ -17,3 +17,4 @@ framework implements the three protocols itself on top of the
 from .stun import StunMessage, IceLiteResponder  # noqa: F401
 from .srtp import SrtpContext, derive_srtp_contexts  # noqa: F401
 from .dtls import DtlsEndpoint, generate_certificate  # noqa: F401
+from .endpoint import SecureMediaSession, classify  # noqa: F401

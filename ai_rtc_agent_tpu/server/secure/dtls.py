@@ -407,9 +407,14 @@ class DtlsEndpoint:
     def _replay_note(self, seq: int) -> None:
         if seq > self._replay_max:
             shift = seq - self._replay_max
-            self._replay_mask = (
-                (self._replay_mask << shift) | 1
-            ) & 0xFFFFFFFFFFFFFFFF
+            # clamp BEFORE shifting: a 2^48-range seq jump must not build a
+            # terabit big-int on the way to the 64-bit mask
+            if shift >= 64:
+                self._replay_mask = 1
+            else:
+                self._replay_mask = (
+                    (self._replay_mask << shift) | 1
+                ) & 0xFFFFFFFFFFFFFFFF
             self._replay_max = seq
         else:
             self._replay_mask |= 1 << (self._replay_max - seq)
@@ -780,6 +785,14 @@ class DtlsEndpoint:
     def _parse_peer_certificate(self, body: bytes) -> None:
         total = int.from_bytes(body[0:3], "big")
         if total == 0:
+            if self.verify_fingerprint:
+                # the SDP pinned an identity — a peer declining to present
+                # its certificate must not complete the handshake, or the
+                # pin is advisory (RFC 8827 s6.5 makes it mandatory)
+                raise DtlsError(
+                    "peer declined to present a certificate but the SDP "
+                    "pins a fingerprint"
+                )
             self.peer_cert_der = None  # empty list (no client cert)
             return
         first_len = int.from_bytes(body[3:6], "big")

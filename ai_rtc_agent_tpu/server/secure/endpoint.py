@@ -1,0 +1,160 @@
+"""RFC 7983 demux: STUN + DTLS + SRTP/SRTCP on one UDP socket.
+
+The reference's aiortc runs exactly this multiplexing inside its
+RTCDtlsTransport/RTCIceTransport pair (reference agent.py:13-20);
+`SecureMediaSession` is the framework's sans-IO equivalent, composed from
+the three protocol modules in this package.  The asyncio plumbing lives in
+server/rtc_native.py (`_SecureMediaProtocol`).
+
+Demux rule (RFC 7983 s7): first byte 0..3 → STUN, 20..63 → DTLS,
+128..191 → RTP/RTCP (RTCP when the full second byte is 192..223,
+i.e. payload types 200-206 — RFC 5761 s4).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .dtls import DtlsEndpoint, DtlsCertificate, generate_certificate
+from .srtp import derive_srtp_contexts
+from .stun import IceLiteResponder, is_stun
+
+logger = logging.getLogger(__name__)
+
+
+def classify(datagram: bytes) -> str:
+    if not datagram:
+        return "drop"
+    b = datagram[0]
+    if b < 4:
+        return "stun" if is_stun(datagram) else "drop"
+    if 20 <= b <= 63:
+        return "dtls"
+    if 128 <= b <= 191:
+        if len(datagram) >= 2 and 192 <= datagram[1] <= 223:
+            return "rtcp"
+        return "rtp"
+    return "drop"
+
+
+class SecureMediaSession:
+    """Security state for ONE peer on one socket: ICE-lite responder, a
+    DTLS server endpoint, and the SRTP contexts derived when the handshake
+    completes.
+
+    Sans-IO: `handle(datagram, addr)` returns
+        (to_send: list[(bytes, addr)], kind: str, plaintext: bytes | None)
+    where `plaintext` is the unprotected RTP/RTCP payload when kind is
+    "rtp"/"rtcp" and the handshake is done.  Outbound media goes through
+    `protect_rtp` / `protect_rtcp` (None until keys exist)."""
+
+    def __init__(
+        self,
+        certificate: DtlsCertificate | None = None,
+        remote_fingerprint: str | None = None,
+        remote_ufrag: str | None = None,
+        ice_ufrag: str | None = None,
+        ice_pwd: str | None = None,
+    ):
+        self.cert = certificate or generate_certificate()
+        self.ice = IceLiteResponder(ufrag=ice_ufrag, pwd=ice_pwd)
+        self.ice.set_remote(remote_ufrag, None)
+        # WebRTC requires verifying the peer's certificate against its SDP
+        # fingerprint (RFC 8827 s6.5) — request the client cert whenever the
+        # offer carried one
+        self.dtls = DtlsEndpoint(
+            "server",
+            self.cert,
+            request_client_cert=remote_fingerprint is not None,
+            verify_fingerprint=remote_fingerprint,
+        )
+        self.tx_srtp = None
+        self.rx_srtp = None
+        self._handshake_done_cb = None
+        self.peer_addr: tuple | None = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def established(self) -> bool:
+        return self.dtls.established and self.rx_srtp is not None
+
+    def on_established(self, cb) -> None:
+        self._handshake_done_cb = cb
+
+    def fingerprint(self) -> str:
+        return self.cert.fingerprint
+
+    def handle(self, datagram: bytes, addr: tuple):
+        kind = classify(datagram)
+        out: list = []
+        payload = None
+        if kind == "stun":
+            reply = self.ice.handle(datagram, addr)
+            if reply is not None:
+                out.append((reply, addr))
+            if self.ice.nominated_addr is not None:
+                self.peer_addr = self.ice.nominated_addr
+        elif kind == "dtls":
+            was_established = self.dtls.established
+            for d in self.dtls.handle_datagram(datagram):
+                out.append((d, addr))
+            if self.dtls.established:
+                self.peer_addr = self.peer_addr or addr
+                if not was_established:
+                    self._derive_srtp()
+        elif kind == "rtp":
+            if self.rx_srtp is not None:
+                try:
+                    payload = self.rx_srtp.unprotect(datagram)
+                except ValueError as e:
+                    logger.debug("srtp drop: %s", e)
+                    kind = "drop"
+            else:
+                kind = "drop"  # media before keys — never pass unprotected
+        elif kind == "rtcp":
+            if self.rx_srtp is not None:
+                try:
+                    payload = self.rx_srtp.unprotect_rtcp(datagram)
+                except ValueError as e:
+                    logger.debug("srtcp drop: %s", e)
+                    kind = "drop"
+            else:
+                kind = "drop"
+        return out, kind, payload
+
+    def _derive_srtp(self) -> None:
+        profile = self.dtls.srtp_profile
+        if profile != 0x0001:
+            logger.warning(
+                "dtls done but no usable SRTP profile (%s) — media stays off",
+                profile,
+            )
+            return
+        km = self.dtls.export_srtp_keying_material()
+        self.tx_srtp, self.rx_srtp = derive_srtp_contexts(km, is_server=True)
+        logger.info(
+            "DTLS-SRTP established (peer fp %s…)",
+            (self.dtls.peer_fingerprint() or "none")[:23],
+        )
+        if self._handshake_done_cb is not None:
+            self._handshake_done_cb()
+
+    # ------------------------------------------------------------------
+
+    def protect_rtp(self, packet: bytes) -> bytes | None:
+        if self.tx_srtp is None:
+            return None
+        return self.tx_srtp.protect(packet)
+
+    def protect_rtcp(self, packet: bytes) -> bytes | None:
+        if self.tx_srtp is None:
+            return None
+        return self.tx_srtp.protect_rtcp(packet)
+
+    def retransmit(self) -> list:
+        """Datagrams to resend if the peer has gone quiet mid-handshake
+        (the caller owns the timer)."""
+        if self.dtls.established or self.peer_addr is None:
+            return []
+        return [(d, self.peer_addr) for d in self.dtls.retransmit()]

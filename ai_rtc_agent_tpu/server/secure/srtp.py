@@ -67,6 +67,10 @@ class SrtpContext:
         # rollover counter state per SSRC: ssrc -> [roc, highest_seq_seen]
         self._roc: dict = {}
         self._rtcp_index = 0  # our outbound SRTCP index (31-bit)
+        # replay protection (RFC 3711 s3.3.2, a MUST): 64-deep sliding
+        # window over the 48-bit packet index, per SSRC; one more for SRTCP
+        self._replay: dict = {}  # ssrc -> [max_index, mask]
+        self._rtcp_replay = [-1, 0]
 
     # -- packet index (RFC 3711 s3.3.1 + appendix A) --------------------
 
@@ -88,6 +92,22 @@ class SrtpContext:
                 self._roc[ssrc] = (roc, seq)
             # v == roc-1: late packet from the previous rollover — no update
         return (v << 16) | seq
+
+    @staticmethod
+    def _replay_check(state: list, index: int) -> None:
+        """state = [max_index, mask]; raises on replay, else records."""
+        mx, mask = state
+        if index > mx:
+            shift = index - mx
+            state[0] = index
+            state[1] = 1 if shift >= 64 else ((mask << shift) | 1) & (
+                0xFFFFFFFFFFFFFFFF
+            )
+            return
+        diff = mx - index
+        if diff >= 64 or (mask >> diff) & 1:
+            raise ValueError("SRTP replayed packet")
+        state[1] = mask | (1 << diff)
 
     def _keystream_iv(self, salt: bytes, ssrc: int, index: int) -> bytes:
         salt_int = int.from_bytes(salt, "big")
@@ -137,6 +157,9 @@ class SrtpContext:
         ).digest()[:AUTH_TAG_LEN]
         if not hmac.compare_digest(expect, tag):
             raise ValueError("SRTP auth failure")
+        # replay check only after the tag verified (unauthenticated noise
+        # must not advance the window)
+        self._replay_check(self._replay.setdefault(ssrc, [-1, 0]), index)
         self._estimate_index(ssrc, seq, update=True)
         off = self._payload_offset(enc)
         iv = self._keystream_iv(self.session_salt, ssrc, index)
@@ -170,9 +193,10 @@ class SrtpContext:
         if not hmac.compare_digest(expect, tag):
             raise ValueError("SRTCP auth failure")
         raw_index = struct.unpack("!I", e_index)[0]
+        index = raw_index & 0x7FFFFFFF
+        self._replay_check(self._rtcp_replay, index)
         if not raw_index & 0x80000000:  # E=0: payload was never encrypted
             return enc
-        index = raw_index & 0x7FFFFFFF
         ssrc = struct.unpack_from("!I", enc, 4)[0]
         iv = self._keystream_iv(self.rtcp_salt, ssrc, index)
         return enc[:8] + _aes_ctr(self.rtcp_key, iv, enc[8:])

@@ -234,6 +234,35 @@ class TestInMemoryHandshake:
         assert not server.established
         assert "CertificateVerify" in (server.failed or "")
 
+    def test_declined_certificate_with_pin_fails(self):
+        """A peer that answers the CertificateRequest with an EMPTY
+        certificate list must not complete a fingerprint-pinned handshake
+        (code-review r4: the pin would otherwise be advisory)."""
+        from ai_rtc_agent_tpu.server.secure import dtls as D
+
+        scert, ccert = generate_certificate(), generate_certificate()
+        server = DtlsEndpoint(
+            "server", scert, request_client_cert=True,
+            verify_fingerprint=ccert.fingerprint,
+        )
+        client = DtlsEndpoint("client", ccert)
+        orig = client._flush_handshake
+
+        def empty_cert(msgs, _orig=orig):
+            out = []
+            for t, b, e in msgs:
+                if t == D.HT_CERTIFICATE:
+                    b = (0).to_bytes(3, "big")  # declare zero certificates
+                if t == D.HT_CERTIFICATE_VERIFY:
+                    continue  # nothing to prove possession of
+                out.append((t, b, e))
+            return _orig(out)
+
+        client._flush_handshake = empty_cert
+        run_handshake(server, client)
+        assert not server.established
+        assert "declined to present a certificate" in (server.failed or "")
+
     def test_reassembly_allocation_bounded(self):
         """Tiny fragments claiming 16 MB totals must not allocate."""
         import struct as _s

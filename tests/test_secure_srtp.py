@@ -78,6 +78,27 @@ class TestSrtpRoundtrip:
             pkt = _rtp_packet(7, ssrc=ssrc)
             assert rx.unprotect(tx.protect(pkt)) == pkt
 
+    def test_replayed_packet_rejected(self):
+        """RFC 3711 s3.3.2 replay list (code-review r4): a captured packet
+        re-sent verbatim must not decrypt twice."""
+        tx, rx = self._pair()
+        wire = tx.protect(_rtp_packet(5))
+        rx.unprotect(wire)
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect(wire)
+        # later packets still flow
+        w2 = tx.protect(_rtp_packet(6))
+        assert rx.unprotect(w2)
+
+    def test_out_of_order_within_window_ok_once(self):
+        tx, rx = self._pair()
+        wires = [tx.protect(_rtp_packet(s)) for s in (10, 11, 12)]
+        rx.unprotect(wires[0])
+        rx.unprotect(wires[2])
+        assert rx.unprotect(wires[1])  # late but fresh: fine
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect(wires[1])  # replayed late packet: rejected
+
     def test_csrc_and_extension_headers_stay_clear(self):
         tx, rx = self._pair()
         # CC=1 (one CSRC), X=1 (4-byte extension with 1 word)
@@ -108,6 +129,15 @@ class TestSrtcp:
         wire[9] ^= 0x01
         with pytest.raises(ValueError, match="auth"):
             rx.unprotect_rtcp(bytes(wire))
+
+    def test_rtcp_replay_rejected(self):
+        """A replayed (captured) SRTCP PLI must not re-trigger keyframes."""
+        key, salt = b"q" * 16, b"z" * 14
+        tx, rx = srtp.SrtpContext(key, salt), srtp.SrtpContext(key, salt)
+        wire = tx.protect_rtcp(struct.pack("!BBHII", 0x81, 206, 2, 1, 2))
+        rx.unprotect_rtcp(wire)
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect_rtcp(wire)
 
     def test_rtcp_index_increments(self):
         key, salt = b"q" * 16, b"z" * 14
