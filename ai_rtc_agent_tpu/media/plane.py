@@ -163,6 +163,11 @@ class H264RingSource:
                 self._dropped_before_resize += self._ring.dropped
                 self._retired_rings.append(self._ring)
                 self._ring = FrameRing(frame.shape, n_slots=self._ring_slots)
+                # bound the graveyard: a ring retired two generations ago
+                # cannot still be inside a (microseconds-long) pop — free it
+                # rather than letting a geometry-flapping sender grow memory
+                while len(self._retired_rings) > 2:
+                    self._retired_rings.pop(0).close()
             self._ring.push_latest(frame, meta=int(out_pts))
         if self._loop is not None and self._frame_event is not None:
             try:
@@ -246,6 +251,10 @@ class H264Sink:
         self._enc = H264Encoder(width, height, fps) if self.use_h264 else None
         self._wh = (height, width)
         self._fps = fps
+        # consume() runs on a worker thread while force_keyframe()/close()
+        # arrive from the event loop (PLI path) — the encoder swap on a
+        # geometry change must not free a handle another thread is using
+        self._enc_lock = threading.Lock()
         self._pkt = (
             RtpPacketizer(ssrc=ssrc, payload_type=payload_type)
             if native.load()
@@ -266,22 +275,25 @@ class H264Sink:
         self._pts = int(pts) + self._pts_step
 
         t0 = time.monotonic()
-        if self.use_h264 and arr.shape[:2] != self._wh:
-            # the pipeline's output geometry is the model's, which a
-            # real-SDP answer cannot know up front — restart the encoder at
-            # the true size (new SPS; decoders re-sync on it)
-            logger.info(
-                "encode geometry %s != configured %s — restarting encoder",
-                arr.shape[:2],
-                self._wh,
-            )
-            self._enc.close()
-            self._wh = (arr.shape[0], arr.shape[1])
-            self._enc = H264Encoder(arr.shape[1], arr.shape[0], self._fps)
-        if self.use_h264:
-            au = self._enc.encode(arr, pts=int(pts))
-        else:
-            au = NullCodec.encode(arr, pts=int(pts))
+        with self._enc_lock:
+            if self.use_h264 and self._enc is None:
+                return []  # sink closed while a frame sat on the worker
+            if self.use_h264 and arr.shape[:2] != self._wh:
+                # the pipeline's output geometry is the model's, which a
+                # real-SDP answer cannot know up front — restart the encoder
+                # at the true size (new SPS; decoders re-sync on it)
+                logger.info(
+                    "encode geometry %s != configured %s — restarting encoder",
+                    arr.shape[:2],
+                    self._wh,
+                )
+                self._enc.close()
+                self._wh = (arr.shape[0], arr.shape[1])
+                self._enc = H264Encoder(arr.shape[1], arr.shape[0], self._fps)
+            if self.use_h264:
+                au = self._enc.encode(arr, pts=int(pts))
+            else:
+                au = NullCodec.encode(arr, pts=int(pts))
         now = time.monotonic()
         self.stats.record_stage("encode", now - t0)
         if wall is not None:
@@ -294,15 +306,22 @@ class H264Sink:
 
     def force_keyframe(self):
         """Next consumed frame encodes as an IDR (PLI recovery — safe from
-        any thread: the native side just latches a flag)."""
-        if self._enc is not None:
-            self._enc.force_keyframe()
+        any thread: the lock serializes against the geometry-change
+        encoder swap in consume())."""
+        with self._enc_lock:
+            if self._enc is not None:
+                self._enc.force_keyframe()
 
     def flush(self) -> bytes:
-        return self._enc.flush() if self.use_h264 else b""
+        with self._enc_lock:
+            if not self.use_h264 or self._enc is None:
+                return b""
+            return self._enc.flush()
 
     def close(self):
-        if self._enc:
-            self._enc.close()
-        if self._pkt:
-            self._pkt.close()
+        with self._enc_lock:
+            if self._enc:
+                self._enc.close()
+                self._enc = None
+            if self._pkt:
+                self._pkt.close()

@@ -7,6 +7,7 @@ aiortc fork's RTP stack (SURVEY.md L3).
 from __future__ import annotations
 
 import ctypes
+import struct
 
 import numpy as np
 
@@ -159,12 +160,22 @@ def make_pli(sender_ssrc: int = 0, media_ssrc: int = 0) -> bytes:
 
 
 def is_pli(data: bytes) -> bool:
-    """True for an RTCP PSFB/PLI packet (cheap disambiguation from RTP:
-    the payload-type byte 206 can never appear there because RTP with
-    marker bit would read 206 only for PT=78, and we only send PT 96-127)."""
-    return (
-        len(data) >= 12
-        and (data[0] >> 6) == 2  # RTCP version
-        and (data[0] & 0x1F) == 1  # FMT 1 = PLI
-        and data[1] == PLI_PT
-    )
+    """True when an RTCP datagram CONTAINS a PSFB/PLI packet.
+
+    Browsers send compound RTCP (RFC 3550 mandates the compound start with
+    SR/RR), so a Chrome PLI typically arrives as RR+PSFB — walk the
+    compound instead of testing only the first packet (code-review r4)."""
+    off = 0
+    while off + 8 <= len(data):
+        b0, pt = data[off], data[off + 1]
+        # every chunk must look like RTCP: version 2 AND payload type in
+        # the RTCP range.  RTP can never satisfy the PT gate (our PTs are
+        # 96-127, or 224-255 with the marker bit), so the walk cannot
+        # wander into compressed video payload bytes and false-positive.
+        if (b0 >> 6) != 2 or not (200 <= pt <= 206):
+            return False
+        if pt == PLI_PT and (b0 & 0x1F) == 1 and off + 12 <= len(data):
+            return True
+        length_words = struct.unpack_from("!H", data, off + 2)[0]
+        off += (length_words + 1) * 4
+    return False

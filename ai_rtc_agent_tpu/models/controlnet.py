@@ -124,7 +124,7 @@ def apply_controlnet(
     c = silu(c)
     for blk in p["cond_embedding"]["blocks"]:
         c = silu(conv2d(blk["conv1"], c))
-        c = silu(conv2d(blk["conv2"], c, stride=2))
+        c = silu(conv2d(blk["conv2"], c, stride=2, padding=1))
     c = conv2d(p["cond_embedding"]["conv_out"], c)
 
     h = conv2d(p["conv_in"], x) + c
@@ -138,7 +138,7 @@ def apply_controlnet(
                 )
             outs.append(h)
         if blk["downsample"] is not None:
-            h = conv2d(blk["downsample"], h, stride=2)
+            h = conv2d(blk["downsample"], h, stride=2, padding=1)
             outs.append(h)
 
     mb = p["mid_block"]

@@ -79,8 +79,18 @@ def linear(p, x):
 
 
 def conv2d(p, x, stride: int = 1, padding="SAME"):
-    """NHWC conv, HWIO kernel."""
+    """NHWC conv, HWIO kernel.
+
+    ``padding`` accepts an int for torch-style SYMMETRIC padding.  This
+    matters at stride 2: XLA's "SAME" pads asymmetrically (bottom/right
+    only for a 3x3), while the HF checkpoints' torch convs pad 1 on every
+    edge — the two produce different values on every downsample, so
+    stride-2 call sites must pass the torch number, not "SAME" (pinned by
+    tests/test_loader_value_pin.py::test_conv_strided_values_match_torch).
+    At stride 1 with odd kernels the two agree."""
     w = _kernel(p, x.dtype)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
     y = jax.lax.conv_general_dilated(
         x,
         w,

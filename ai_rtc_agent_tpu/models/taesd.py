@@ -79,7 +79,7 @@ def encode(p, x, cfg: TAESDConfig = TAESDConfig()):
     h = conv2d(p["conv_in"], x)
     h = _block(p["block_in"], h)
     for stage in p["stages"]:
-        h = conv2d(stage["down"], h, stride=2)
+        h = conv2d(stage["down"], h, stride=2, padding=1)
         h = _block_list(stage["blocks"], h)
     return conv2d(p["conv_out"], h)
 

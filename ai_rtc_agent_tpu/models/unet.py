@@ -422,7 +422,7 @@ def apply_unet(
                 )
             skips.append(h)
         if blk["downsample"] is not None:
-            h = conv2d(blk["downsample"], h, stride=2)
+            h = conv2d(blk["downsample"], h, stride=2, padding=1)
             skips.append(h)
 
     if down_residuals is not None:

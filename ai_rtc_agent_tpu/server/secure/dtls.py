@@ -127,7 +127,13 @@ def generate_certificate(common_name: str = "ai-rtc-agent-tpu") -> DtlsCertifica
 
 
 class DtlsError(Exception):
-    pass
+    """Fatal protocol violation by the peer — alert + dead association."""
+
+
+class DtlsDiscard(Exception):
+    """Invalid record that must be SILENTLY dropped (RFC 6347 s4.1.2.7):
+    decrypt failures and malformed structure are spoofable by any off-path
+    sender, so treating them as fatal would be a one-datagram DoS."""
 
 
 class _RecordCipher:
@@ -155,7 +161,7 @@ class _RecordCipher:
         try:
             return self.aead.decrypt(nonce, ct, aad)
         except Exception as e:  # InvalidTag
-            raise DtlsError(f"record decrypt failed: {e}")
+            raise DtlsDiscard(f"record decrypt failed: {e}")
 
 
 def _hs_header(msg_type: int, length: int, msg_seq: int) -> bytes:
@@ -275,21 +281,30 @@ class DtlsEndpoint:
                 break  # truncated datagram
             try:
                 out.extend(self._handle_record(ctype, epoch, seq6, frag))
+            except DtlsDiscard as e:
+                logger.debug("dtls %s: discarding record (%s)", self.role, e)
+                continue
             except DtlsError as e:
+                # content-level protocol violation from the (sequenced) peer
+                # conversation — fatal (bad Finished, fingerprint mismatch,
+                # no common cipher, missing CertificateVerify)
                 logger.warning("dtls %s: %s", self.role, e)
                 self.failed = str(e)
                 out.append(self._alert_datagram(2, 40))  # fatal handshake_failure
                 return out
             except Exception as e:
-                # malformed bodies must never crash the UDP receive loop:
-                # a truncated ClientKeyExchange, a bogus key share, etc. are
-                # hostile input, not programming errors reachable only here
-                logger.warning(
-                    "dtls %s: malformed input (%s: %s)", self.role, type(e).__name__, e
+                # malformed structure (truncated CKE, bogus key share…) is
+                # unauthenticated at epoch 0 and therefore SPOOFABLE by any
+                # off-path sender: silently discard the record (RFC 6347
+                # s4.1.2.7) instead of handing a one-datagram kill switch to
+                # whoever can hit this port.  The real peer retransmits.
+                logger.debug(
+                    "dtls %s: dropping malformed record (%s: %s)",
+                    self.role,
+                    type(e).__name__,
+                    e,
                 )
-                self.failed = f"malformed peer message: {type(e).__name__}"
-                out.append(self._alert_datagram(2, 50))  # fatal decode_error
-                return out
+                continue
         if self._dup_seen and not out and self._last_flight:
             # the peer retransmitted a flight we already processed — our
             # answering flight was lost; resend it (once per datagram)
@@ -385,7 +400,10 @@ class DtlsEndpoint:
         if ctype == CT_ALERT:
             if len(frag) >= 2:
                 self.alert_received = (frag[0], frag[1])
-                if frag[0] == 2:
+                # only AUTHENTICATED (epoch-1) fatal alerts may kill the
+                # association — an epoch-0 alert is one spoofed datagram
+                # away from anyone who can reach the port
+                if frag[0] == 2 and epoch > 0:
                     self.failed = f"peer fatal alert {frag[1]}"
             return []
         if ctype == CT_APPDATA:
@@ -463,7 +481,16 @@ class DtlsEndpoint:
                 del self._reassembly[self._recv_next_seq]
                 seq = self._recv_next_seq
                 self._recv_next_seq += 1
-                out.extend(self._process_handshake(mtype, bytes(mbody), seq))
+                try:
+                    out.extend(self._process_handshake(mtype, bytes(mbody), seq))
+                except (DtlsError, DtlsDiscard):
+                    raise
+                except Exception:
+                    # malformed message (possibly spoofed into this seq
+                    # slot): rewind so the real peer's retransmission is
+                    # not dup-dropped, then discard via the outer handler
+                    self._recv_next_seq = seq
+                    raise
         return out
 
     def _transcribe(self, msg_type: int, body: bytes, msg_seq: int) -> None:

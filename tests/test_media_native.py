@@ -263,3 +263,24 @@ def test_h264_rate_control_bounds(native_lib, monkeypatch):
     assert decoded >= 1
     enc.close()
     dec.close()
+
+
+def test_is_pli_walks_compound_rtcp():
+    """Browsers send PLI inside compound RTCP (RR first, RFC 3550) — the
+    detector must walk the compound, not just test the first packet
+    (code-review r4)."""
+    import struct
+
+    from ai_rtc_agent_tpu.media import rtp as R
+
+    pli = R.make_pli()
+    assert R.is_pli(pli)
+    # RR (PT 201, no report blocks) prepended — the Chrome shape
+    rr = struct.pack("!BBH", 0x80, 201, 1) + struct.pack("!I", 0xAAA)
+    assert R.is_pli(rr + pli)
+    # compound without a PLI
+    sdes = struct.pack("!BBH", 0x81, 202, 1) + b"\x00" * 4
+    assert not R.is_pli(rr + sdes)
+    # plain RTP must never read as PLI
+    rtp_pkt = struct.pack("!BBHII", 0x80, 96, 7, 0, 0x1234) + b"\x00" * 20
+    assert not R.is_pli(rtp_pkt)

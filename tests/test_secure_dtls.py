@@ -153,10 +153,11 @@ class TestInMemoryHandshake:
         for _ in range(50):
             server.handle_datagram(os.urandom(64))
 
-    def test_malformed_handshake_bodies_alert_not_crash(self):
+    def test_malformed_handshake_bodies_discarded_not_crash(self):
         """Crafted truncated handshake messages (empty ClientKeyExchange,
-        truncated ClientHello, bogus key share) must produce a fatal alert,
-        never an uncaught exception out of handle_datagram."""
+        truncated ClientHello, bogus key share) are spoofable pre-auth —
+        they must be SILENTLY DISCARDED (RFC 6347 s4.1.2.7): no uncaught
+        exception, and no one-datagram kill of the association."""
         import struct as _s
 
         def record(hs_type, body, msg_seq=0, seq=0):
@@ -185,6 +186,38 @@ class TestInMemoryHandshake:
             server = DtlsEndpoint("server")
             out = server.handle_datagram(record(hs_type, body))
             assert isinstance(out, list)  # returned, didn't raise
+            assert server.failed is None  # association NOT killed
+
+    def test_spoofed_garbage_does_not_brick_pending_handshake(self):
+        """A hostile datagram (DTLS content type, garbage body) hitting the
+        socket BEFORE the real client's handshake must not prevent that
+        handshake from completing (code-review r4: one-datagram DoS)."""
+        server = DtlsEndpoint("server")
+        client = DtlsEndpoint("client")
+        # 20 hostile datagrams first: DTLS-classified garbage + a spoofed
+        # plaintext fatal alert
+        import struct as _s
+
+        for i in range(20):
+            noise = (
+                _s.pack("!BH", 22, 0xFEFD)
+                + _s.pack("!H", 0)
+                + (1000 + i).to_bytes(6, "big")
+                + _s.pack("!H", 30)
+                + os.urandom(30)
+            )
+            server.handle_datagram(noise)
+        spoofed_alert = (
+            _s.pack("!BH", 21, 0xFEFF)
+            + _s.pack("!H", 0)
+            + (999).to_bytes(6, "big")
+            + _s.pack("!H", 2)
+            + b"\x02\x28"
+        )
+        server.handle_datagram(spoofed_alert)
+        assert server.failed is None
+        run_handshake(server, client)
+        assert server.established and client.established
 
     def test_plaintext_records_dropped_after_handshake(self):
         """A spoofed unencrypted epoch-0 alert must not kill an established
