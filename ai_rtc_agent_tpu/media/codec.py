@@ -49,18 +49,32 @@ class H264Encoder:
         self._lib = lib
         # each ENC_* accepts the reference's NVENC_* spelling as a lazy
         # migration alias (ref docs/environment.md:17-25)
-        bitrate = bitrate or env.get_int_aliased(
-            "ENC_DEFAULT_BITRATE", "NVENC_DEFAULT_BITRATE", 3_000_000
-        )
-        preset = preset or env.get_str_aliased(
-            "ENC_PRESET", "NVENC_PRESET", "ultrafast"
-        )
-        tune = tune or env.get_str_aliased(
-            "ENC_TUNING_INFO", "NVENC_TUNING_INFO", "zerolatency"
-        )
+        # `is None` (not `or`): an EXPLICIT bitrate=0 / preset="" argument
+        # must not silently fall through to the env/default lookup
+        if bitrate is None:
+            bitrate = env.get_int_aliased(
+                "ENC_DEFAULT_BITRATE", "NVENC_DEFAULT_BITRATE", 3_000_000
+            )
+        if preset is None:
+            preset = env.get_str_aliased(
+                "ENC_PRESET", "NVENC_PRESET", "ultrafast"
+            )
+        if tune is None:
+            tune = env.get_str_aliased(
+                "ENC_TUNING_INFO", "NVENC_TUNING_INFO", "zerolatency"
+            )
         # rate-control bounds as x264 VBV
         min_rate = env.get_int_aliased("ENC_MIN_BITRATE", "NVENC_MIN_BITRATE", 0)
         max_rate = env.get_int_aliased("ENC_MAX_BITRATE", "NVENC_MAX_BITRATE", 0)
+        if min_rate and not max_rate:
+            # x264 honors minrate only under CBR/nal-hrd; a floor with no
+            # ceiling is advisory — the operator who set one should know
+            # (mirrors the missing-rc-export warning below)
+            logger.warning(
+                "ENC_MIN_BITRATE set without ENC_MAX_BITRATE: x264 treats a "
+                "floor-only bound as advisory (minrate applies under "
+                "CBR/nal-hrd); set ENC_MAX_BITRATE to enforce a band"
+            )
         if (min_rate or max_rate) and hasattr(lib, "tr_h264_encoder_create_rc"):
             self._enc = lib.tr_h264_encoder_create_rc(
                 width, height, fps, 1, bitrate, min_rate, max_rate, gop,

@@ -65,11 +65,12 @@ class H264RingSource:
         self._dec = H264Decoder() if self.use_h264 else None
         self._ring = FrameRing((height, width, 3), n_slots=ring_slots)
         self._ring_slots = ring_slots
-        # rings replaced by a geometry change are RETIRED, not freed: the
-        # consumer thread reads self._ring without a lock, so an immediate
-        # native destroy would race a concurrent pop() (use-after-free);
-        # close() reaps them when the consumer is provably gone
-        self._retired_rings: list = []
+        # serializes ring REPLACEMENT (geometry change, decode thread)
+        # against the consumer's pop (asyncio thread): freeing the old
+        # native ring without this would race a concurrent pop
+        # (use-after-free).  Nanoseconds per acquire; both sides are
+        # microseconds-long critical sections.
+        self._ring_lock = threading.Lock()
         self._dropped_before_resize = 0
         self._depkt = RtpDepacketizer() if native.load() else None
         self._reorder = RtpReorderBuffer()
@@ -91,7 +92,8 @@ class H264RingSource:
     def poll(self):
         """Non-blocking pop of the newest decoded frame: (frame, pts) or
         None — the sync-consumer counterpart of the async recv()."""
-        return self._ring.pop()
+        with self._ring_lock:
+            return self._ring.pop()
 
     def depacketize(self, packet: bytes) -> list:
         """One RTP packet -> list of completed (AU bytes, ts).  Runs the
@@ -160,14 +162,11 @@ class H264RingSource:
                     frame.shape,
                     self._ring.frame_shape,
                 )
-                self._dropped_before_resize += self._ring.dropped
-                self._retired_rings.append(self._ring)
-                self._ring = FrameRing(frame.shape, n_slots=self._ring_slots)
-                # bound the graveyard: a ring retired two generations ago
-                # cannot still be inside a (microseconds-long) pop — free it
-                # rather than letting a geometry-flapping sender grow memory
-                while len(self._retired_rings) > 2:
-                    self._retired_rings.pop(0).close()
+                with self._ring_lock:
+                    self._dropped_before_resize += self._ring.dropped
+                    old = self._ring
+                    self._ring = FrameRing(frame.shape, n_slots=self._ring_slots)
+                    old.close()
             self._ring.push_latest(frame, meta=int(out_pts))
         if self._loop is not None and self._frame_event is not None:
             try:
@@ -182,7 +181,7 @@ class H264RingSource:
             self._loop = asyncio.get_running_loop()
             self._frame_event = asyncio.Event()
         while True:
-            got = self._ring.pop()
+            got = self.poll()  # ring-lock-protected pop (geometry swaps)
             if got is not None:
                 arr, pts = got
                 vf = VideoFrame.from_ndarray(arr)
@@ -220,10 +219,8 @@ class H264RingSource:
     def close(self):
         with self._io_lock:  # never free the decoder under an active decode
             self._closed = True
-            self._ring.close()
-            for ring in self._retired_rings:  # geometry-change leftovers
-                ring.close()
-            self._retired_rings.clear()
+            with self._ring_lock:
+                self._ring.close()
             if self._dec:
                 self._dec.close()
             if self._depkt:
@@ -251,6 +248,7 @@ class H264Sink:
         self._enc = H264Encoder(width, height, fps) if self.use_h264 else None
         self._wh = (height, width)
         self._fps = fps
+        self._closed = False
         # consume() runs on a worker thread while force_keyframe()/close()
         # arrive from the event loop (PLI path) — the encoder swap on a
         # geometry change must not free a handle another thread is using
@@ -300,9 +298,10 @@ class H264Sink:
             self.stats.record_stage("glass", now - wall)
         if not au:
             return []
-        if self._pkt is None:
-            return [au]
-        return self._pkt.packetize(au, int(pts))
+        with self._enc_lock:  # close() frees the native packetizer too
+            if self._pkt is None:
+                return [au] if not self._closed else []
+            return self._pkt.packetize(au, int(pts))
 
     def force_keyframe(self):
         """Next consumed frame encodes as an IDR (PLI recovery — safe from
@@ -320,8 +319,10 @@ class H264Sink:
 
     def close(self):
         with self._enc_lock:
+            self._closed = True
             if self._enc:
                 self._enc.close()
                 self._enc = None
             if self._pkt:
                 self._pkt.close()
+                self._pkt = None

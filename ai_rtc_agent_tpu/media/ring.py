@@ -64,7 +64,10 @@ class FrameRing:
         return True
 
     def pop(self):
-        """-> (frame [*shape] uint8, meta) or None."""
+        """-> (frame [*shape] uint8, meta) or None (always None once
+        closed — a late consumer must get an empty answer, not a crash)."""
+        if getattr(self, "_destroyed", False):
+            return None
         if self._ring:
             out = np.empty(self.slot_bytes, np.uint8)
             meta = ctypes.c_int64(0)
@@ -95,6 +98,7 @@ class FrameRing:
         return self._dropped
 
     def close(self):
+        self._destroyed = True
         if self._ring:
             self._lib.tr_ring_destroy(self._ring)
             self._ring = None

@@ -481,15 +481,33 @@ class DtlsEndpoint:
                 del self._reassembly[self._recv_next_seq]
                 seq = self._recv_next_seq
                 self._recv_next_seq += 1
+                # snapshot BEFORE processing: a malformed (possibly spoofed)
+                # message may have been transcribed and half-parsed before
+                # its body raised — without a full rewind the real peer's
+                # retransmission would be transcribed a second time and the
+                # Finished hashes could never match again
+                t_len = len(self._session_hash_input)
+                snap = (
+                    self._peer_key_share,
+                    self._pre_master,
+                    self._session_hash,
+                    self._expect_cert_verify,
+                    self.peer_cert_der,
+                )
                 try:
                     out.extend(self._process_handshake(mtype, bytes(mbody), seq))
                 except (DtlsError, DtlsDiscard):
                     raise
                 except Exception:
-                    # malformed message (possibly spoofed into this seq
-                    # slot): rewind so the real peer's retransmission is
-                    # not dup-dropped, then discard via the outer handler
                     self._recv_next_seq = seq
+                    del self._session_hash_input[t_len:]
+                    (
+                        self._peer_key_share,
+                        self._pre_master,
+                        self._session_hash,
+                        self._expect_cert_verify,
+                        self.peer_cert_der,
+                    ) = snap
                     raise
         return out
 

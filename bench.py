@@ -382,6 +382,31 @@ def _fresh_lock(lock: str) -> bool:
         return False
 
 
+_PAUSED_WATCHER_STOPFILE: str | None = None
+
+
+def _clear_watcher_pause() -> None:
+    """Remove the pause file _yield_watcher_claim wrote so the watcher
+    resumes its queue (advisor r3: a one-off bench must not permanently end
+    the round's background measurement)."""
+    global _PAUSED_WATCHER_STOPFILE
+    if _PAUSED_WATCHER_STOPFILE:
+        import os
+
+        try:
+            # only reap OUR OWN pause (the O_EXCL create means the content
+            # is ours unless someone replaced the file since)
+            with open(_PAUSED_WATCHER_STOPFILE) as f:
+                first = f.readline().split()
+            if len(first) >= 2 and first[0] == "pause" and first[1] == str(
+                os.getpid()
+            ):
+                os.remove(_PAUSED_WATCHER_STOPFILE)
+        except OSError:
+            pass
+        _PAUSED_WATCHER_STOPFILE = None
+
+
 def _yield_watcher_claim(result: dict) -> bool:
     """Coordinate with the opportunistic watcher (scripts/tpu_watch.sh):
     two processes claiming the single tunneled chip is the observed wedge
@@ -403,10 +428,22 @@ def _yield_watcher_claim(result: dict) -> bool:
     except (OSError, ValueError):
         return True  # no live watcher -> nothing to coordinate with
     lock = os.getenv("TPU_ITEM_LOCK", "/tmp/tpu_item.lock")
-    try:  # stand the watcher down before we claim
+    try:  # stand the watcher down before we claim (PAUSE protocol: the
+        # watcher waits for this file to disappear instead of exiting —
+        # _clear_watcher_pause() removes it when the bench is done, so a
+        # one-off bench no longer ends background measurement for the round)
         stop = os.getenv("TPU_WATCH_STOP", "/tmp/tpu_watch_stop")
-        with open(stop, "w") as f:
-            f.write("non-watcher bench taking the claim\n")
+        # NEVER overwrite an existing stop file: a manual operator stop
+        # must survive us, and another bench's pause must not be clobbered
+        # (we'd remove it under them and resurrect the two-claimants wedge)
+        fd = os.open(stop, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644)
+        with os.fdopen(fd, "w") as f:
+            f.write(f"pause {os.getpid()} non-watcher bench taking the claim\n")
+        global _PAUSED_WATCHER_STOPFILE
+        _PAUSED_WATCHER_STOPFILE = stop
+    except FileExistsError:
+        logger.info("stop file already present (manual stop or another "
+                    "bench's pause) — leaving it untouched")
     except OSError:
         pass
     budget = int(os.getenv("BENCH_CLAIM_WAIT_S", "900"))
@@ -440,7 +477,7 @@ def _yield_watcher_claim(result: dict) -> bool:
     return False
 
 
-def _run_measurement_child(result: dict):
+def _run_measurement_child(result: dict, config: str = "turbo512"):
     """Run the actual measurement in a CHILD process and return its contract
     line to emit verbatim (or None with result['error'] set — the caller's
     finally block then replays a committed number).
@@ -460,7 +497,13 @@ def _run_measurement_child(result: dict):
 
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
-    tmo = int(os.getenv("BENCH_CHILD_TIMEOUT_S", "1500"))
+    # default child budget scales with the config: the heavy families'
+    # FIRST compile can legitimately exceed 1500s outside the watcher
+    # (whose per-row budgets already pass BENCH_CHILD_TIMEOUT_S explicitly)
+    heavy_defaults = {"sdxl1024": 3600, "controlnet512": 2700, "lcm4x512": 2700}
+    tmo = int(
+        os.getenv("BENCH_CHILD_TIMEOUT_S", str(heavy_defaults.get(config, 1500)))
+    )
     cmd = [sys.executable, "-u", os.path.abspath(__file__), *sys.argv[1:]]
 
     def _die_with_parent():
@@ -578,7 +621,7 @@ def main():
             logger.info("backend probe ok: %s", info)
 
         if not is_child and os.getenv("BENCH_NO_CHILD", "") not in ("1", "true"):
-            line = _run_measurement_child(result)
+            line = _run_measurement_child(result, config=args.config)
             if line is not None:
                 print(line)
                 sys.stdout.flush()
@@ -632,6 +675,7 @@ def main():
         logger.exception("bench failed")
         result["error"] = f"{type(e).__name__}: {e}"
     finally:
+        _clear_watcher_pause()
         if not emitted:  # child-success path already printed its line
             print(json.dumps(_maybe_replay(result)))
             sys.stdout.flush()

@@ -33,6 +33,28 @@ trap 'rm -f "$ITEM_LOCK"' EXIT
 
 note() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
 
+# Stop-file protocol (advisor r3): a file starting "pause <pid>" is a
+# NON-WATCHER BENCH holding the claim — wait for it to finish (file
+# removed, or its pid dies and we reap the stale file) instead of exiting;
+# anything else is a manual stop -> exit.  Returns only when clear to run.
+check_stop() {
+  while [ -e "$STOP" ]; do
+    local first pid
+    read -r first pid _ < "$STOP" 2>/dev/null || first=""
+    if [ "$first" != "pause" ]; then
+      note "stop file present — exiting"
+      exit 0
+    fi
+    if [ -n "$pid" ] && ! kill -0 "$pid" 2>/dev/null; then
+      note "stale pause file (bench pid $pid gone) — reaping and resuming"
+      rm -f "$STOP"
+      break
+    fi
+    note "paused: non-watcher bench (pid ${pid:-?}) holds the claim"
+    sleep 15
+  done
+}
+
 # did the last run_item's output line come from a CPU fallback?  That means
 # the tunnel flapped between the backend probe and the item — NOT evidence
 # against the item itself (vs. an empty/partial line: timeout/KILL, a real
@@ -69,7 +91,7 @@ EOF
 
 run_item() {  # $1=label  $2=timeout-seconds  rest=command
   local label="$1" tmo="$2"; shift 2
-  [ -e "$STOP" ] && { note "stop file present — exiting"; exit 0; }
+  check_stop
   note "run: $label"
   local out line
   # -k: a remote call blocked in C never lets the Python SIGTERM handler
@@ -107,7 +129,7 @@ START_EPOCH=$(date +%s)
 TTL_S=${TPU_WATCH_TTL_S:-86400}  # don't poll into the next round forever
 
 while true; do
-  [ -e "$STOP" ] && { note "stop file present — exiting"; exit 0; }
+  check_stop
   if [ $(( $(date +%s) - START_EPOCH )) -gt "$TTL_S" ]; then
     note "TTL expired — exiting"
     exit 0

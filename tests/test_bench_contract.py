@@ -182,7 +182,9 @@ def test_bench_yields_to_watcher_item_lock(tmp_path):
     assert r.returncode == 0, r.stderr[-800:]
     d = _contract_line(r.stdout)
     assert "unreachable" in d["error"]  # proceeded after release
-    assert stop.exists()  # asked the watcher to stand down
+    # PAUSE protocol (advisor r3): the stand-down file is written during
+    # the run and REAPED in the bench's finally so the watcher resumes
+    assert not stop.exists()
     assert not lock.exists()  # proceeded only after the release
     assert "claim_contention" not in d
 
@@ -218,4 +220,109 @@ def test_bench_refuses_to_contend_with_unreleased_claim(tmp_path):
     d = _contract_line(r.stdout)
     assert d["value"] == 0.0
     assert "not contending" in d["error"]
+    assert not stop.exists()  # pause file reaped even on the refusal path
+
+
+def test_unet_cache_prefix_validated():
+    """advisor r3: 'foo:3' must not parse as a valid UNET_CACHE spelling."""
+    import pytest
+
+    from ai_rtc_agent_tpu.models import registry
+
+    import os
+    os.environ["UNET_CACHE"] = "foo:3"
+    try:
+        with pytest.raises(ValueError, match="deepcache"):
+            registry.default_stream_config("tiny-test")
+    finally:
+        del os.environ["UNET_CACHE"]
+    os.environ["UNET_CACHE"] = "deepcache:3"
+    try:
+        assert registry.default_stream_config("tiny-test").unet_cache_interval == 3
+    finally:
+        del os.environ["UNET_CACHE"]
+
+
+def test_bench_child_timeout_scales_with_config(monkeypatch):
+    """advisor r3: heavy configs get a bigger default child budget."""
+    import sys
+
+    import bench
+
+    monkeypatch.delenv("BENCH_CHILD_TIMEOUT_S", raising=False)
+    captured = {}
+
+    class _P:
+        returncode = 0
+
+        def communicate(self, timeout=None):
+            captured["tmo"] = timeout
+            return '{"ok": true}', ""
+
+    # _run_measurement_child imports subprocess locally — patch via module
+    import subprocess as _sp
+
+    monkeypatch.setattr(_sp, "Popen", lambda *a, **k: _P())
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--config", "x", "--frames", "3"]
+    )
+    for cfg, expect in [("turbo512", 1500), ("sdxl1024", 3600)]:
+        bench._run_measurement_child({}, config=cfg)
+        assert captured["tmo"] == expect, (cfg, captured["tmo"])
+
+
+def test_clear_watcher_pause_removes_file(tmp_path):
+    """advisor r3: a one-off bench pauses (not kills) the watcher — the
+    pause file must be reaped in the bench's finally."""
+    import bench
+
+    import os as _os
+
+    stop = tmp_path / "stopfile"
+    stop.write_text(f"pause {_os.getpid()} test\n")
+    bench._PAUSED_WATCHER_STOPFILE = str(stop)
+    bench._clear_watcher_pause()
+    assert not stop.exists()
+    assert bench._PAUSED_WATCHER_STOPFILE is None
+    bench._clear_watcher_pause()  # idempotent
+
+    # someone else's pause (or a manual stop) is NEVER reaped by us
+    stop.write_text("pause 999999 other bench\n")
+    bench._PAUSED_WATCHER_STOPFILE = str(stop)
+    bench._clear_watcher_pause()
     assert stop.exists()
+
+
+def test_watcher_check_stop_protocol(tmp_path):
+    """The shell side: 'pause <dead-pid>' reaps and resumes; a manual stop
+    file exits."""
+    import subprocess
+
+    harness = r'''
+STOP="$1"
+LOG=/dev/null
+note() { :; }
+'''
+    # extract check_stop from the watcher script verbatim so the test pins
+    # the real code
+    src = open("scripts/tpu_watch.sh").read()
+    start = src.index("check_stop() {")
+    end = src.index("\n}", start) + 2
+    harness += src[start:end] + "\ncheck_stop\necho RESUMED\n"
+
+    stop = tmp_path / "stop"
+    # dead pid -> reap and resume
+    stop.write_text("pause 999999 bench\n")
+    out = subprocess.run(
+        ["bash", "-c", harness, "bash", str(stop)],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert "RESUMED" in out.stdout
+    assert not stop.exists()
+    # manual stop -> exit without resuming
+    stop.write_text("manual stop\n")
+    out = subprocess.run(
+        ["bash", "-c", harness, "bash", str(stop)],
+        capture_output=True, text=True, timeout=30,
+    )
+    assert "RESUMED" not in out.stdout

@@ -214,7 +214,8 @@ def test_prewarm_buckets_compiles_and_survives_aot(bundle, tmp_path):
     assert mp._prewarmed
     assert mp._bucket_for(1) == 1  # prewarmed buckets win again
     # the prewarmed object is a compiled executable, not a lazy jit wrapper
-    assert not hasattr(mp._bucket_steps[1], "lower")
+    # (bucket steps are keyed (size, variant) since buckets x DeepCache)
+    assert not hasattr(mp._bucket_steps[(1, "full")], "lower")
     frames = np.zeros((4, 64, 64, 3), np.uint8)
     out = mp.step_all(frames)
     assert out.shape == (4, 64, 64, 3)

@@ -343,6 +343,6 @@ def test_coordinator_below_capacity_uses_bucket_path(rng):
         frame = rng.integers(0, 256, (mp.height, mp.width, 3), dtype=np.uint8)
         out = peer(frame)
         assert out.shape == frame.shape and out.dtype == np.uint8
-        assert 1 in mp.engine._bucket_steps  # the k=1 variant actually ran
+        assert (1, "full") in mp.engine._bucket_steps  # k=1 variant ran
     finally:
         mp.close()
