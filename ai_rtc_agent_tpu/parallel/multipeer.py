@@ -279,11 +279,6 @@ class MultiPeerEngine:
         """
         if self.mesh is not None and np.prod(list(self.mesh.shape.values())) > 1:
             return False
-        if self._cache_interval:
-            # the multipeer DeepCache pair keeps the plain jit path (the
-            # single-stream engine ships pair adoption; the multipeer
-            # export would need both variants serialized per peer count)
-            return False
         if self.states is None:
             raise RuntimeError("call start() first (states define the signature)")
         from ..aot.cache import EngineCache
@@ -291,21 +286,38 @@ class MultiPeerEngine:
 
         # the single-peer key recipe (incl. cnet/fused/attn graph flags)
         # plus the peer dimension — one recipe, no drift between the two
-        # serving modes' cache slots
-        key = stream_engine_key(model_id, self.cfg, peers=self.max_peers)
+        # serving modes' cache slots.  With DeepCache: BOTH variants
+        # serialized per peer count, adopted atomically (a half-adopted
+        # pair would mix an AOT step with a cold jit step mid-cadence —
+        # same policy as StreamEngine.use_aot_cache).
         cache = EngineCache(cache_dir)
         frame_spec = jax.ShapeDtypeStruct(
             (self.max_peers, self.cfg.height, self.cfg.width, 3), jnp.uint8
         )
         args = (self.params, self.states, frame_spec)
-        if not build_on_miss and not cache.has(key, args):
+        if self._cache_interval:
+            plan = [
+                (self._vstep, {"variant": "capture"}, "_step"),
+                (self._vstep_cached, {"variant": "cached"}, "_step_cached"),
+            ]
+        else:
+            plan = [(self._vstep, {}, "_step")]
+        keys = [
+            stream_engine_key(model_id, self.cfg, peers=self.max_peers, **extra)
+            for _, extra, _ in plan
+        ]
+        if not build_on_miss and not all(cache.has(k, args) for k in keys):
             return False
-        call = cache.load_or_build(
-            key, self._vstep, args, donate_argnums=(1,), build=build_on_miss
-        )
-        if call is None:
-            return False
-        self._step = call
+        calls = []
+        for (vfn, _, _), k in zip(plan, keys):
+            call = cache.load_or_build(
+                k, vfn, args, donate_argnums=(1,), build=build_on_miss
+            )
+            if call is None:
+                return False
+            calls.append(call)
+        for (_, _, attr), call in zip(plan, calls):
+            setattr(self, attr, call)
         self._aot_adopted = True  # full-batch cold-start path wins buckets
         return True
 

@@ -73,6 +73,7 @@ class SdpOffer:
     fingerprint: str | None = None  # value only (colon-hex)
     fingerprint_algo: str | None = None  # e.g. "sha-256"
     setup: str | None = None  # actpass | active | passive
+    bundle: list | None = None  # a=group:BUNDLE mids (browser offers)
 
     def is_secure(self) -> bool:
         """A browser/OBS WebRTC offer: DTLS fingerprint present (the
@@ -98,6 +99,7 @@ def parse(text: str) -> SdpOffer:
     fingerprint = None
     fingerprint_algo = None
     setup = None
+    bundle = None
     media: list = []
     cur: MediaSection | None = None
 
@@ -148,7 +150,10 @@ def parse(text: str) -> SdpOffer:
                 cur.connection = addr
         elif key == "a":
             if cur is None:
-                _secure_attr(val)
+                if val.startswith("group:BUNDLE"):
+                    bundle = val.split()[1:]
+                else:
+                    _secure_attr(val)
                 continue
             cur.attrs.append(val)
             _secure_attr(val)
@@ -175,6 +180,7 @@ def parse(text: str) -> SdpOffer:
         fingerprint=fingerprint,
         fingerprint_algo=fingerprint_algo,
         setup=setup,
+        bundle=bundle,
     )
 
 
@@ -213,6 +219,17 @@ def build_answer(
     ]
     if secure is not None:
         lines.append("a=ice-lite")
+    if offer.bundle:
+        # echo the BUNDLE group for the mids we ACCEPT (RFC 9143 s7.3:
+        # rejected m-lines leave the group) — browsers with
+        # bundlePolicy=max-bundle refuse an answer that drops the group
+        accepted = [
+            m.mid
+            for m in offer.media
+            if m.kind == "video" and m.mid is not None and m.mid in offer.bundle
+        ]
+        if accepted:
+            lines.append("a=group:BUNDLE " + " ".join(accepted))
     for m in offer.media:
         if m.kind != "video":
             # rejected section: port 0, mirror the proto + first payload

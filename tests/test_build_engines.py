@@ -6,7 +6,7 @@ from ai_rtc_agent_tpu.assets.build_engines import build
 
 
 def test_build_engine_tiny(tmp_path, monkeypatch):
-    (key,) = build("tiny-test", cache_dir=str(tmp_path))
+    (key,), _ = build("tiny-test", cache_dir=str(tmp_path))
     d = os.path.join(tmp_path, key)
     assert os.path.isdir(d)
     blobs = [f for f in os.listdir(d) if f.endswith(".jaxexport")]
@@ -53,8 +53,8 @@ def test_no_adoption_without_prebuilt_engine(tmp_path, monkeypatch):
 def test_build_controlnet_engine_variant(tmp_path):
     """ControlNet engine variant gets its own cache key (reference compiles a
     separate UNet+ControlNet engine, lib/wrapper.py:870-877)."""
-    (key_plain,) = build("tiny-test", cache_dir=str(tmp_path))
-    (key_cnet,) = build("tiny-test", cache_dir=str(tmp_path), controlnet="tiny-cnet")
+    (key_plain,), _ = build("tiny-test", cache_dir=str(tmp_path))
+    (key_cnet,), _ = build("tiny-test", cache_dir=str(tmp_path), controlnet="tiny-cnet")
     assert key_plain != key_cnet
     assert os.path.isdir(os.path.join(tmp_path, key_cnet))
 
@@ -63,10 +63,33 @@ def test_build_deepcache_pair(tmp_path, monkeypatch):
     """UNET_CACHE config builds BOTH variants (capture + cached) with
     distinct keys — serve-time adoption is pair-atomic."""
     monkeypatch.setenv("UNET_CACHE", "2")
-    keys = build("tiny-test", cache_dir=str(tmp_path))
+    keys, _ = build("tiny-test", cache_dir=str(tmp_path))
     assert len(keys) == 2 and keys[0] != keys[1]
     assert any("capture" in k for k in keys)
     assert any("cached" in k for k in keys)
     for k in keys:
         d = os.path.join(tmp_path, k)
         assert [f for f in os.listdir(d) if f.endswith(".jaxexport")]
+
+
+def test_build_engines_peers_flag(tmp_path, monkeypatch):
+    """--peers N prebuilds the multipeer engine through the serving
+    adoption path (keys can't drift); a fresh MultiPeerEngine then loads
+    without building."""
+    from ai_rtc_agent_tpu.assets import build_engines
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.parallel.multipeer import MultiPeerEngine
+
+    build_engines.main([
+        "--model-id", "tiny-test", "--cache-dir", str(tmp_path),
+        "--peers", "2",
+    ])
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test")
+    mp = MultiPeerEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_peers=2,
+    ).start("adopt prebuilt")
+    assert mp.use_aot_cache(
+        "tiny-test", cache_dir=str(tmp_path), build_on_miss=False
+    )

@@ -210,16 +210,28 @@ def test_trainer_checkpoint_roundtrip(rng, tmp_path):
     tr.step(batch, jax.random.PRNGKey(0))
     ckpt = str(tmp_path / "ckpts")
     tr.save(ckpt)
-    l_continue = tr.step(batch, jax.random.PRNGKey(7))
 
-    # fresh trainer restores and reproduces the exact continuation
+    # fresh trainer restores BITWISE-identical state (the checkpoint
+    # guarantee that is actually deterministic)
     tr2 = ShardedTrainer(
         unet_apply, S.make_schedule(), m, params, TrainerConfig(learning_rate=1e-3)
     )
     assert tr2.restore(ckpt)
     assert int(np.asarray(tr2.state["step"])) == 1
+    for a, b in zip(jax.tree.leaves(tr.state), jax.tree.leaves(tr2.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    l_continue = tr.step(batch, jax.random.PRNGKey(7))
     l_resumed = tr2.step(batch, jax.random.PRNGKey(7))
-    assert l_resumed == l_continue
+    # the continuation itself is NOT guaranteed bitwise: orbax-restored
+    # arrays can carry different device layouts than step-produced ones,
+    # so XLA may compile a second executable whose reduction order drifts
+    # at float32 ulp scale (observed 6e-8 after an unrelated conv-padding
+    # change re-fused the graph).  Identical state + tight tolerance is
+    # the honest contract.
+    np.testing.assert_allclose(
+        float(l_resumed), float(l_continue), rtol=0, atol=5e-6
+    )
     # restored leaves keep the mesh placement
     some_leaf = jax.tree.leaves(tr2.state["params"])[0]
     assert some_leaf.sharding.mesh.shape == m.shape

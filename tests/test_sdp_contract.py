@@ -260,3 +260,22 @@ def test_whip_whep_fuzz_never_500(monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_bundle_group_echoed_for_accepted_mid():
+    """Browsers offer a=group:BUNDLE; max-bundle policies refuse an answer
+    that drops the group (RFC 9143 s7.3) — the accepted video mid must be
+    echoed, rejected sections leave the group."""
+    offer = sdp.parse(fixture("browser_whip_offer.sdp"))
+    assert offer.bundle == ["0"]
+    answer = sdp.build_answer(offer, host="127.0.0.1", video_port=4000)
+    assert "a=group:BUNDLE 0" in answer
+
+    # an offer without BUNDLE gets no group line
+    text = fixture("browser_whip_offer.sdp").replace(
+        "a=group:BUNDLE 0\n", ""
+    )
+    answer2 = sdp.build_answer(
+        sdp.parse(text), host="127.0.0.1", video_port=4000
+    )
+    assert "BUNDLE" not in answer2

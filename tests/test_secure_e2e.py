@@ -247,6 +247,138 @@ def test_secure_e2e_encrypted_media_roundtrip(native_lib, monkeypatch):
     asyncio.run(go())
 
 
+def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
+    """The send-only (WHEP viewer) secure path: a recvonly offer with a
+    fingerprint still gets the demuxed socket (ICE checks + DTLS have to
+    run somewhere), and the processed stream arrives SRTP-protected after
+    the handshake — no plain-RTP fallback."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    use_h264 = native.h264_available()
+    w = h = 64
+
+    async def go():
+        provider = NativeRtpProvider(
+            default_width=w, default_height=h, use_h264=use_h264
+        )
+        app = build_app(pipeline=InvertPipeline(), provider=provider)
+        http = TestClient(TestServer(app))
+        await http.start_server()
+        loop = asyncio.get_event_loop()
+        recv_q: asyncio.Queue = asyncio.Queue()
+
+        class _ClientRecv(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                recv_q.put_nowait(data)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _ClientRecv, local_addr=("127.0.0.1", 0)
+        )
+        pub_sink = H264Sink(w, h, use_h264=use_h264)
+        back_src = H264RingSource(w, h, use_h264=use_h264)
+        try:
+            # publisher: plain JSON envelope (LAN tier)
+            r = await http.post(
+                "/whip",
+                data=json.dumps(
+                    {"native_rtp": True, "video": True, "width": w, "height": h}
+                ),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            pub_port = json.loads(await r.text())["server_port"]
+
+            # secure viewer: browser-shaped recvonly offer w/ fingerprint
+            cert = generate_certificate("secure-whep-viewer")
+            offer_sdp = _client_offer(
+                cert.fingerprint, "view", "viewerpwd0123456789abc", "recvonly"
+            )
+            r = await http.post(
+                "/whep",
+                data=offer_sdp,
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            answer = await r.text()
+            assert "a=setup:passive" in answer and "a=sendonly" in answer
+            server_ufrag = _sdp_attr(answer, "ice-ufrag")
+            server_pwd = _sdp_attr(answer, "ice-pwd")
+            server_fp = _sdp_attr(answer, "fingerprint").split(" ", 1)[1]
+            m = re.search(r"^m=video (\d+) UDP/TLS/RTP/SAVPF", answer, re.M)
+            assert m, answer
+            server_addr = ("127.0.0.1", int(m.group(1)))
+
+            # ICE + DTLS from the viewer socket
+            req = StunMessage(stun_mod.BINDING_REQUEST)
+            req.attributes.append(
+                (stun_mod.ATTR_USERNAME, f"{server_ufrag}:view".encode())
+            )
+            req.attributes.append((stun_mod.ATTR_USE_CANDIDATE, b""))
+            transport.sendto(
+                req.encode(integrity_key=server_pwd.encode()), server_addr
+            )
+            await asyncio.wait_for(recv_q.get(), 5)
+            dtls = DtlsEndpoint("client", cert, verify_fingerprint=server_fp)
+            for d in dtls.start():
+                transport.sendto(d, server_addr)
+            deadline = loop.time() + 15
+            while not dtls.established and loop.time() < deadline:
+                try:
+                    data = await asyncio.wait_for(recv_q.get(), 3)
+                except asyncio.TimeoutError:
+                    for d in dtls.retransmit():
+                        transport.sendto(d, server_addr)
+                    continue
+                assert dtls.failed is None, dtls.failed
+                for d in dtls.handle_datagram(data):
+                    transport.sendto(d, server_addr)
+            assert dtls.established, dtls.failed
+            _, rx = derive_srtp_contexts(
+                dtls.export_srtp_keying_material(), is_server=False
+            )
+
+            # drive the publisher; expect encrypted frames at the viewer
+            pub_sock, _ = await loop.create_datagram_endpoint(
+                asyncio.DatagramProtocol,
+                remote_addr=("127.0.0.1", pub_port),
+            )
+            decoded = []
+            val = 60
+            try:
+                for i in range(40):
+                    f = VideoFrame.from_ndarray(
+                        np.full((h, w, 3), val, np.uint8)
+                    )
+                    f.pts = i * 3000
+                    for pkt in pub_sink.consume(f):
+                        pub_sock.sendto(pkt)
+                    await asyncio.sleep(0.05)
+                    try:
+                        while True:
+                            wire = recv_q.get_nowait()
+                            try:
+                                back_src.feed_packet(rx.unprotect(wire))
+                            except ValueError:
+                                pass
+                    except asyncio.QueueEmpty:
+                        pass
+                    while (item := back_src._ring.pop()) is not None:
+                        decoded.append(item[0])
+                    if decoded:
+                        break
+            finally:
+                pub_sock.close()
+            assert decoded, "secure WHEP viewer got no frames"
+            mean = float(decoded[-1].astype(np.float32).mean())
+            assert abs(mean - (255 - val)) < 25, mean
+        finally:
+            pub_sink.close()
+            back_src.close()
+            transport.close()
+            await http.close()
+
+    asyncio.run(go())
+
+
 def test_sha384_fingerprint_offer_rejected(native_lib):
     """Non-sha-256 fingerprints are refused with a 400 (code-review r4):
     better than every connection dying mid-handshake with a misleading
