@@ -294,6 +294,7 @@ class NativeRtpPeerConnection:
                     certificate=self._provider.dtls_certificate,
                     remote_fingerprint=offer.fingerprint,
                     remote_ufrag=offer.ice_ufrag,
+                    stats=self._provider.stats,
                 )
         else:
             try:

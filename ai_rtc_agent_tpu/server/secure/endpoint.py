@@ -55,7 +55,9 @@ class SecureMediaSession:
         remote_ufrag: str | None = None,
         ice_ufrag: str | None = None,
         ice_pwd: str | None = None,
+        stats=None,
     ):
+        self.stats = stats  # FrameStats: secure counters land in /metrics
         self.cert = certificate or generate_certificate()
         self.ice = IceLiteResponder(ufrag=ice_ufrag, pwd=ice_pwd)
         self.ice.set_remote(remote_ufrag, None)
@@ -110,6 +112,8 @@ class SecureMediaSession:
                 except ValueError as e:
                     logger.debug("srtp drop: %s", e)
                     kind = "drop"
+                    if self.stats is not None:
+                        self.stats.count("srtp_drops")
             else:
                 kind = "drop"  # media before keys — never pass unprotected
         elif kind == "rtcp":
@@ -119,6 +123,8 @@ class SecureMediaSession:
                 except ValueError as e:
                     logger.debug("srtcp drop: %s", e)
                     kind = "drop"
+                    if self.stats is not None:
+                        self.stats.count("srtp_drops")
             else:
                 kind = "drop"
         return out, kind, payload
@@ -137,6 +143,8 @@ class SecureMediaSession:
             "DTLS-SRTP established (peer fp %s…)",
             (self.dtls.peer_fingerprint() or "none")[:23],
         )
+        if self.stats is not None:
+            self.stats.count("secure_sessions")
         if self._handshake_done_cb is not None:
             self._handshake_done_cb()
 

@@ -29,6 +29,7 @@ class FrameStats:
         self._stages: dict = {}
         self._window = window
         self._lock = threading.Lock()
+        self._counts: dict = {}
         self.frames_total = 0
 
     def record(self, latency_s: float, t: float | None = None):
@@ -43,6 +44,12 @@ class FrameStats:
             if q is None:
                 q = self._stages[stage] = collections.deque(maxlen=self._window)
             q.append(seconds)
+
+    def count(self, name: str, n: int = 1):
+        """Monotonic event counter (secure handshakes, SRTP drops, …) —
+        lands in the snapshot as ``<name>_total``."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
 
     def timed(self):
         """Context manager: with stats.timed(): process(frame)."""
@@ -64,6 +71,7 @@ class FrameStats:
             lat = sorted(self._lat)
             times = list(self._times)
             stages = {k: sorted(q) for k, q in self._stages.items()}
+            counts = dict(self._counts)
         out = {
             "frames_total": self.frames_total,
             "fps": 0.0,
@@ -81,6 +89,8 @@ class FrameStats:
             if q:
                 out[f"{name}_p50_ms"] = 1e3 * q[len(q) // 2]
                 out[f"{name}_p90_ms"] = 1e3 * q[min(len(q) - 1, int(len(q) * 0.9))]
+        for name, n in counts.items():
+            out[f"{name}_total"] = n
         return out
 
 

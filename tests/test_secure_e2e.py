@@ -238,11 +238,46 @@ def test_secure_e2e_encrypted_media_roundtrip(native_lib, monkeypatch):
             assert decoded, "no SRTP-protected frames made it back"
             mean = float(decoded[-1].astype(np.float32).mean())
             assert abs(mean - (255 - val)) < 20, mean
+
+            # the secure handshake is observable at /metrics
+            m = await http.get("/metrics")
+            snap = await m.json()
+            assert snap.get("secure_sessions_total", 0) >= 1
         finally:
             out_sink.close()
             back_src.close()
             transport.close()
             await http.close()
+
+    asyncio.run(go())
+
+
+def test_obs_whip_offer_gets_secure_answer_with_bundle(native_lib):
+    """OBS's WHIP offer carries a DTLS fingerprint + BUNDLE group too — it
+    must route through the secure tier and get the group echoed."""
+    with open("tests/fixtures/sdp/obs_whip_offer.sdp") as f:
+        offer_sdp = f.read()
+
+    async def go():
+        provider = NativeRtpProvider(use_h264=native.h264_available())
+        app = build_app(pipeline=InvertPipeline(), provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/whip",
+                data=offer_sdp,
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            answer = await r.text()
+            assert "UDP/TLS/RTP/SAVPF" in answer
+            assert "a=ice-lite" in answer
+            assert _sdp_attr(answer, "fingerprint")
+            assert "a=setup:passive" in answer
+            assert "a=group:BUNDLE video0" in answer
+        finally:
+            await client.close()
 
     asyncio.run(go())
 
