@@ -58,6 +58,12 @@ class SecureMediaSession:
         stats=None,
     ):
         self.stats = stats  # FrameStats: secure counters land in /metrics
+        if stats is not None:
+            # pre-register at 0 so monitoring sees the gauges from the
+            # first scrape — "key missing" must not be confusable with
+            # "secure tier not wired" (docs/security.md)
+            stats.count("secure_sessions", 0)
+            stats.count("srtp_drops", 0)
         self.cert = certificate or generate_certificate()
         self.ice = IceLiteResponder(ufrag=ice_ufrag, pwd=ice_pwd)
         self.ice.set_remote(remote_ufrag, None)

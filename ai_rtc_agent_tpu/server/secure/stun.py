@@ -118,8 +118,10 @@ class StunMessage:
         mtype, length, cookie = struct.unpack_from("!HHI", data, 0)
         if cookie != MAGIC_COOKIE:
             raise ValueError("bad magic cookie")
-        if HEADER_LEN + length > len(data):
-            raise ValueError("truncated STUN message")
+        if HEADER_LEN + length != len(data):
+            # exact-size only: on UDP a datagram IS one message; trailing
+            # bytes would ride outside every integrity computation
+            raise ValueError("STUN length mismatch")
         txid = data[4 + 4 : HEADER_LEN]
         attrs: list = []
         off = HEADER_LEN
@@ -221,6 +223,17 @@ class IceLiteResponder:
             return None
         if msg.message_type != BINDING_REQUEST:
             return None  # ICE-lite: we never sent a request, ignore responses
+        fp = msg.get(ATTR_FINGERPRINT)
+        if fp is not None:
+            # RFC 5389 s7.3: a present FINGERPRINT must validate — it is
+            # the only attribute outside MESSAGE-INTEGRITY's coverage, so
+            # skipping the check would let corrupted/forged trailers ride
+            # an otherwise-authenticated message (found by fuzzing)
+            expect = (
+                zlib.crc32(datagram[: len(datagram) - 8]) & 0xFFFFFFFF
+            ) ^ FINGERPRINT_XOR
+            if len(fp) != 4 or struct.unpack("!I", fp)[0] != expect:
+                return None
         username = msg.get(ATTR_USERNAME)
         authenticated = False
         if username is not None:

@@ -224,6 +224,10 @@ while true; do
   # -> nearly free after dc3 when the persistent compile cache held; same
   # full budget as other rows in case it was dropped (fresh-process compile)
   run_item "turbo512_dc5" 2400 python -u bench.py --config turbo512 --frames 60 --unet-cache 5
+  # DeepCache QUALITY at real geometry on hardware (PERF.md table is
+  # hermetic-tiny; this banks the 512^2 PSNR/SSIM + fps curve in one row)
+  run_item "deepcache_quality512" 3000 python -u scripts/deepcache_quality.py \
+      --model-id stabilityai/sd-turbo --size 512 --frames 36
   # 4. full-step cross-check (pallas vs xla, bf16 gauge): 3 more compiles
   run_item "numerics_full" 3600 python -u scripts/tpu_numerics_check.py --full
   # 5. AOT cache on hardware: build+serve, then fresh-process reload
