@@ -75,6 +75,14 @@ GROUP_X25519 = 0x001D
 SIG_ECDSA_SECP256R1_SHA256 = 0x0403
 
 SRTP_AES128_CM_HMAC_SHA1_80 = 0x0001
+SRTP_AEAD_AES_128_GCM = 0x0007
+
+# our preference order: the CM profile is end-to-end validated against
+# openssl's exported keying material; the AEAD profile (RFC 7714) is
+# implemented but its KDF interpretation lacks an independent
+# cross-validation in this image (see srtp.py), so it negotiates only
+# when the peer does not offer the CM profile
+DEFAULT_SRTP_PROFILES = (SRTP_AES128_CM_HMAC_SHA1_80, SRTP_AEAD_AES_128_GCM)
 
 MASTER_SECRET_LEN = 48
 VERIFY_DATA_LEN = 12
@@ -192,7 +200,7 @@ class DtlsEndpoint:
         self,
         role: str,
         certificate: DtlsCertificate | None = None,
-        srtp_profiles: tuple = (SRTP_AES128_CM_HMAC_SHA1_80,),
+        srtp_profiles: tuple = DEFAULT_SRTP_PROFILES,
         request_client_cert: bool = False,
         verify_fingerprint: str | None = None,
     ):
@@ -324,10 +332,21 @@ class DtlsEndpoint:
         out, self._appdata = self._appdata, []
         return out
 
-    def export_srtp_keying_material(self, length: int = 60) -> bytes:
-        """RFC 5705 exporter, label "EXTRACTOR-dtls_srtp" (RFC 5764 s4.2)."""
+    def export_srtp_keying_material(self, length: int | None = None) -> bytes:
+        """RFC 5705 exporter, label "EXTRACTOR-dtls_srtp" (RFC 5764 s4.2).
+        Length defaults to the negotiated profile's 2*(key+salt)."""
         if self._master_secret is None:
             raise DtlsError("handshake incomplete")
+        if length is None:
+            from .srtp import PROFILE_KEYING, keying_material_length
+
+            if self.srtp_profile not in PROFILE_KEYING:
+                raise DtlsError(
+                    f"no supported SRTP profile negotiated "
+                    f"({self.srtp_profile!r}) — pass an explicit length "
+                    "for non-SRTP exporter uses"
+                )
+            length = keying_material_length(self.srtp_profile)
         return p_sha256(
             self._master_secret,
             b"EXTRACTOR-dtls_srtp",
@@ -895,7 +914,13 @@ class DtlsEndpoint:
             self._ems = EXT_EXTENDED_MASTER_SECRET in exts
             srtp = exts.get(EXT_USE_SRTP)
             if srtp and len(srtp) >= 4:
-                self.srtp_profile = struct.unpack_from("!H", srtp, 2)[0]
+                chosen = struct.unpack_from("!H", srtp, 2)[0]
+                if chosen not in self.srtp_profiles:
+                    # a server may only echo something WE offered
+                    raise DtlsError(
+                        f"server chose unoffered SRTP profile {chosen:#06x}"
+                    )
+                self.srtp_profile = chosen
             return []
         if msg_type == HT_CERTIFICATE:
             self._transcribe(msg_type, body, msg_seq)

@@ -16,7 +16,7 @@ from __future__ import annotations
 import logging
 
 from .dtls import DtlsEndpoint, DtlsCertificate, generate_certificate
-from .srtp import derive_srtp_contexts
+from .srtp import PROFILE_KEYING, derive_srtp_contexts
 from .stun import IceLiteResponder, is_stun
 
 logger = logging.getLogger(__name__)
@@ -137,14 +137,16 @@ class SecureMediaSession:
 
     def _derive_srtp(self) -> None:
         profile = self.dtls.srtp_profile
-        if profile != 0x0001:
+        if profile not in PROFILE_KEYING:
             logger.warning(
                 "dtls done but no usable SRTP profile (%s) — media stays off",
                 profile,
             )
             return
-        km = self.dtls.export_srtp_keying_material()
-        self.tx_srtp, self.rx_srtp = derive_srtp_contexts(km, is_server=True)
+        km = self.dtls.export_srtp_keying_material()  # profile-sized
+        self.tx_srtp, self.rx_srtp = derive_srtp_contexts(
+            km, is_server=True, profile=profile
+        )
         logger.info(
             "DTLS-SRTP established (peer fp %s…)",
             (self.dtls.peer_fingerprint() or "none")[:23],

@@ -132,7 +132,8 @@ async def run(agent: str, size: int, frames: int, room: str) -> int:
         print(f"DTLS ok: profile={dtls.srtp_profile} "
               f"server fp verified ({server_fp[:23]}…)")
         tx, rx = derive_srtp_contexts(
-            dtls.export_srtp_keying_material(), is_server=False
+            dtls.export_srtp_keying_material(), is_server=False,
+            profile=dtls.srtp_profile,
         )
 
         use_h264 = native.h264_available()

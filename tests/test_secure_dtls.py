@@ -140,11 +140,48 @@ class TestInMemoryHandshake:
         assert s_rx.unprotect(c_tx.protect(pkt)) == pkt
 
     def test_no_common_srtp_profile_leaves_none(self):
-        server = DtlsEndpoint("server", srtp_profiles=(0x0007,))
-        client = DtlsEndpoint("client")  # offers profile 1 only
+        server = DtlsEndpoint("server", srtp_profiles=(0x0042,))  # unknown
+        client = DtlsEndpoint("client")
         run_handshake(server, client)
         assert server.established
         assert server.srtp_profile is None
+
+    def test_aead_profile_negotiated_when_cm_not_offered(self):
+        """A peer offering ONLY RFC 7714 AEAD gets it; the exporter sizes
+        itself to the profile (2*(16+12)=56); SRTP contexts interoperate."""
+        from ai_rtc_agent_tpu.server.secure.srtp import (
+            PROFILE_AEAD_AES_128_GCM,
+        )
+
+        server = DtlsEndpoint("server")
+        client = DtlsEndpoint(
+            "client", srtp_profiles=(PROFILE_AEAD_AES_128_GCM,)
+        )
+        run_handshake(server, client)
+        assert server.established
+        assert server.srtp_profile == PROFILE_AEAD_AES_128_GCM
+        km_s = server.export_srtp_keying_material()
+        km_c = client.export_srtp_keying_material()
+        assert km_s == km_c and len(km_s) == 56
+        s_tx, s_rx = derive_srtp_contexts(
+            km_s, is_server=True, profile=PROFILE_AEAD_AES_128_GCM
+        )
+        c_tx, c_rx = derive_srtp_contexts(
+            km_c, is_server=False, profile=PROFILE_AEAD_AES_128_GCM
+        )
+        import struct
+
+        pkt = struct.pack("!BBHII", 0x80, 96, 1, 0, 0xAA) + b"x" * 64
+        assert c_rx.unprotect(s_tx.protect(pkt)) == pkt
+        assert s_rx.unprotect(c_tx.protect(pkt)) == pkt
+
+    def test_cm_profile_preferred_when_both_offered(self):
+        """Until the AEAD KDF is validated against a real peer, the
+        openssl-keymat-validated CM profile wins (docs/security.md)."""
+        server = DtlsEndpoint("server")
+        client = DtlsEndpoint("client")  # default: offers both
+        run_handshake(server, client)
+        assert server.srtp_profile == 0x0001
 
     def test_garbage_datagram_ignored(self):
         server = DtlsEndpoint("server")
@@ -335,63 +372,76 @@ def _serve_one_handshake(sock, ep, result):
         result["error"] = f"{type(e).__name__}: {e}"
 
 
+def _openssl_s_client_interop(profile_name: str, keymatlen: int):
+    """Shared harness: our DTLS server vs `openssl s_client` offering
+    ``profile_name``.  Returns (server result dict, openssl stdout,
+    exported-keymat candidate strings parsed from the output)."""
+    ep = DtlsEndpoint("server", generate_certificate())
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(20)
+    port = sock.getsockname()[1]
+    result: dict = {}
+    t = threading.Thread(target=_serve_one_handshake, args=(sock, ep, result))
+    t.start()
+    proc = subprocess.run(
+        [
+            OPENSSL, "s_client", "-dtls1_2",
+            "-connect", f"127.0.0.1:{port}",
+            "-use_srtp", profile_name,
+            "-keymatexport", "EXTRACTOR-dtls_srtp",
+            "-keymatexportlen", str(keymatlen),
+        ],
+        input=b"Q\n",
+        capture_output=True,
+        timeout=30,
+    )
+    t.join(timeout=25)
+    sock.close()
+    out = proc.stdout.decode("utf-8", "replace")
+    lines = [ln.strip() for ln in out.splitlines()]
+    # openssl prints the keymat either on the label line or the next one
+    candidates = [
+        lines[i + 1]
+        for i, ln in enumerate(lines)
+        if ln.startswith("Keying material:") and i + 1 < len(lines)
+    ] + [
+        ln.split("Keying material:", 1)[1].strip()
+        for ln in lines
+        if ln.startswith("Keying material:") and ln != "Keying material:"
+    ]
+    return result, out, candidates
+
+
 @pytest.mark.skipif(OPENSSL is None, reason="openssl CLI not available")
 class TestOpensslInterop:
-    def test_openssl_s_client_full_handshake_srtp_keymat(self, tmp_path):
+    def test_openssl_s_client_full_handshake_srtp_keymat(self):
         """The gold-standard artifact: a stock OpenSSL DTLS client (the
         browser-shaped peer) completes the handshake against our server and
         both sides export identical SRTP keying material."""
-        ep = DtlsEndpoint("server", generate_certificate())
-        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        sock.bind(("127.0.0.1", 0))
-        sock.settimeout(20)
-        port = sock.getsockname()[1]
-        result: dict = {}
-        t = threading.Thread(
-            target=_serve_one_handshake, args=(sock, ep, result)
+        result, out, candidates = _openssl_s_client_interop(
+            "SRTP_AES128_CM_SHA1_80", 60
         )
-        t.start()
-        proc = subprocess.run(
-            [
-                OPENSSL,
-                "s_client",
-                "-dtls1_2",
-                "-connect",
-                f"127.0.0.1:{port}",
-                "-use_srtp",
-                "SRTP_AES128_CM_SHA1_80",
-                "-keymatexport",
-                "EXTRACTOR-dtls_srtp",
-                "-keymatexportlen",
-                "60",
-            ],
-            input=b"Q\n",
-            capture_output=True,
-            timeout=30,
-        )
-        t.join(timeout=25)
-        sock.close()
-        out = proc.stdout.decode("utf-8", "replace")
         assert "error" not in result, result
         assert result.get("profile") == 1
         assert "Cipher is ECDHE-ECDSA-AES128-GCM-SHA256" in out
         assert "SRTP Extension negotiated, profile=SRTP_AES128_CM_SHA1_80" in out
-        # openssl prints the exported keymat as one hex line after the label
-        lines = [ln.strip() for ln in out.splitlines()]
-        km_lines = [
-            lines[i + 1]
-            for i, ln in enumerate(lines)
-            if ln.startswith("Keying material:")
-        ]
-        km_inline = [
-            ln.split("Keying material:", 1)[1].strip()
-            for ln in lines
-            if ln.startswith("Keying material:") and ln != "Keying material:"
-        ]
-        candidates = km_inline + km_lines
         assert any(
             c.lower() == result["keymat"] for c in candidates if c
         ), f"openssl keymat {candidates} != ours {result['keymat'][:20]}…"
+
+    def test_openssl_s_client_negotiates_aead_profile(self):
+        """openssl offering only SRTP_AEAD_AES_128_GCM negotiates it and
+        exports the 56-byte keying material identically."""
+        result, out, candidates = _openssl_s_client_interop(
+            "SRTP_AEAD_AES_128_GCM", 56
+        )
+        assert "error" not in result, result
+        assert result.get("profile") == 0x0007
+        assert "SRTP Extension negotiated, profile=SRTP_AEAD_AES_128_GCM" in out
+        assert any(
+            c.lower() == result["keymat"] for c in candidates if c
+        ), f"openssl keymat mismatch: {candidates}"
 
     def test_our_client_against_openssl_s_server(self, tmp_path):
         """Reverse direction: our DTLS client handshakes with a stock

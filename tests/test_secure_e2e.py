@@ -196,7 +196,8 @@ def test_secure_e2e_encrypted_media_roundtrip(native_lib, monkeypatch):
             assert dtls.established, dtls.failed
             assert dtls.srtp_profile == 1
             tx, rx = derive_srtp_contexts(
-                dtls.export_srtp_keying_material(), is_server=False
+                dtls.export_srtp_keying_material(), is_server=False,
+                profile=dtls.srtp_profile,
             )
 
             # --- media: SRTP up, processed SRTP back ---
@@ -368,7 +369,8 @@ def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
                     transport.sendto(d, server_addr)
             assert dtls.established, dtls.failed
             _, rx = derive_srtp_contexts(
-                dtls.export_srtp_keying_material(), is_server=False
+                dtls.export_srtp_keying_material(), is_server=False,
+                profile=dtls.srtp_profile,
             )
 
             # drive the publisher; expect encrypted frames at the viewer

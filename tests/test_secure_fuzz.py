@@ -104,7 +104,8 @@ def test_fuzz_established_association_survives():
             break
     assert sess.established
     tx, rx = derive_srtp_contexts(
-        client.export_srtp_keying_material(), is_server=False
+        client.export_srtp_keying_material(), is_server=False,
+        profile=client.srtp_profile,
     )
 
     import struct

@@ -159,3 +159,73 @@ def test_derive_srtp_contexts_roles_mirror():
     assert srv_rx.unprotect(cli_tx.protect(pkt)) == pkt
     with pytest.raises(ValueError):
         srtp.derive_srtp_contexts(km[:30], is_server=True)
+
+
+class TestAeadSrtp:
+    """RFC 7714 AEAD AES-128-GCM profile (single-pass; Chrome's preferred
+    family).  KDF caveat documented in srtp.py/docs/security.md."""
+
+    def _pair(self):
+        from ai_rtc_agent_tpu.server.secure.srtp import AeadSrtpContext
+
+        key, salt = b"K" * 16, b"S" * 12
+        return AeadSrtpContext(key, salt), AeadSrtpContext(key, salt)
+
+    def test_roundtrip_and_header_in_clear(self):
+        tx, rx = self._pair()
+        pkt = _rtp_packet(9)
+        wire = tx.protect(pkt)
+        assert wire[:12] == pkt[:12]
+        assert len(wire) == len(pkt) + 16  # GCM tag
+        assert rx.unprotect(wire) == pkt
+
+    def test_tamper_header_detected(self):
+        """AEAD covers the HEADER too (AAD) — a flipped header bit fails,
+        which plain CM+HMAC also catches but via the separate tag."""
+        tx, rx = self._pair()
+        wire = bytearray(tx.protect(_rtp_packet(9)))
+        wire[4] ^= 0x01  # timestamp bit
+        with pytest.raises(ValueError, match="auth"):
+            rx.unprotect(bytes(wire))
+
+    def test_tamper_payload_detected(self):
+        tx, rx = self._pair()
+        wire = bytearray(tx.protect(_rtp_packet(9)))
+        wire[-1] ^= 0x01
+        with pytest.raises(ValueError, match="auth"):
+            rx.unprotect(bytes(wire))
+
+    def test_replay_rejected(self):
+        tx, rx = self._pair()
+        wire = tx.protect(_rtp_packet(3))
+        rx.unprotect(wire)
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect(wire)
+
+    def test_rollover_and_distinct_ssrc(self):
+        tx, rx = self._pair()
+        for seq in (65534, 65535, 0, 1):
+            pkt = _rtp_packet(seq)
+            assert rx.unprotect(tx.protect(pkt)) == pkt
+        for ssrc in (0x1, 0x2):
+            pkt = _rtp_packet(50, ssrc=ssrc)
+            assert rx.unprotect(tx.protect(pkt)) == pkt
+
+    def test_rtcp_roundtrip_and_replay(self):
+        tx, rx = self._pair()
+        pkt = struct.pack("!BBHII", 0x81, 206, 2, 0xAAA, 0xBBB)
+        wire = tx.protect_rtcp(pkt)
+        assert wire[:8] == pkt[:8]
+        assert rx.unprotect_rtcp(wire) == pkt
+        with pytest.raises(ValueError, match="replay"):
+            rx.unprotect_rtcp(wire)
+
+    def test_keying_lengths(self):
+        from ai_rtc_agent_tpu.server.secure.srtp import (
+            PROFILE_AEAD_AES_128_GCM,
+            PROFILE_AES128_CM_SHA1_80,
+            keying_material_length,
+        )
+
+        assert keying_material_length(PROFILE_AES128_CM_SHA1_80) == 60
+        assert keying_material_length(PROFILE_AEAD_AES_128_GCM) == 56
