@@ -74,15 +74,23 @@ GROUP_X25519 = 0x001D
 
 SIG_ECDSA_SECP256R1_SHA256 = 0x0403
 
-SRTP_AES128_CM_HMAC_SHA1_80 = 0x0001
-SRTP_AEAD_AES_128_GCM = 0x0007
+# profile ids live in srtp.py (one registry: PROFILE_KEYING drives both
+# negotiation here and key derivation there)
+from .srtp import (  # noqa: E402
+    PROFILE_AEAD_AES_128_GCM,
+    PROFILE_AES128_CM_SHA1_80,
+)
+
+SRTP_AES128_CM_HMAC_SHA1_80 = PROFILE_AES128_CM_SHA1_80
 
 # our preference order: the CM profile is end-to-end validated against
 # openssl's exported keying material; the AEAD profile (RFC 7714) is
 # implemented but its KDF interpretation lacks an independent
-# cross-validation in this image (see srtp.py), so it negotiates only
-# when the peer does not offer the CM profile
-DEFAULT_SRTP_PROFILES = (SRTP_AES128_CM_HMAC_SHA1_80, SRTP_AEAD_AES_128_GCM)
+# cross-validation in this image (no RFC 7714 s16/17 vector source on
+# disk, no second SRTP implementation — adding those vectors is the
+# closure when a source exists), so it negotiates only when the peer
+# does not offer the CM profile
+DEFAULT_SRTP_PROFILES = (PROFILE_AES128_CM_SHA1_80, PROFILE_AEAD_AES_128_GCM)
 
 MASTER_SECRET_LEN = 48
 VERIFY_DATA_LEN = 12

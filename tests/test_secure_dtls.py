@@ -14,6 +14,9 @@ import threading
 import pytest
 
 from ai_rtc_agent_tpu.server.secure.dtls import (
+    DTLS_12,
+    GROUP_X25519,
+    HS_HEADER_LEN,
     DtlsEndpoint,
     DtlsError,
     generate_certificate,
@@ -182,6 +185,77 @@ class TestInMemoryHandshake:
         client = DtlsEndpoint("client")  # default: offers both
         run_handshake(server, client)
         assert server.srtp_profile == 0x0001
+
+    def test_chrome_shaped_client_hello_tolerated(self):
+        """BoringSSL (Chrome's stack) sends GREASE cipher/extension values,
+        unknown extensions, a non-empty session id, and a 4-profile
+        use_srtp list — all of which must be skipped, not choked on
+        (RFC 8701: unknown values MUST be ignored)."""
+        import os as _os
+        import struct as _s
+
+        server = DtlsEndpoint("server")
+        client_random = _os.urandom(32)
+
+        def chrome_ch(cookie: bytes) -> bytes:
+            exts = b""
+            exts += _s.pack("!HH", 0x3A3A, 1) + b"\x00"  # GREASE ext
+            exts += _s.pack("!HHH", 0x000A, 8, 6) + _s.pack(
+                "!HHH", 0x7A7A, 0x001D, 0x0017  # GREASE group first
+            )
+            exts += _s.pack("!HH", 0x000B, 2) + b"\x01\x00"
+            exts += _s.pack("!HHH", 0x000D, 6, 4) + _s.pack(
+                "!HH", 0x0403, 0x0804
+            )
+            profiles = _s.pack("!HHHH", 0x0007, 0x0008, 0x0001, 0x0002)
+            exts += (
+                _s.pack("!HH", 0x000E, len(profiles) + 3)
+                + _s.pack("!H", len(profiles))
+                + profiles
+                + b"\x00"
+            )
+            exts += _s.pack("!HH", 0x0017, 0)
+            exts += _s.pack("!HH", 0x0023, 0)  # session_ticket
+            exts += _s.pack("!HH", 0xFF01, 1) + b"\x00"
+            session_id = _os.urandom(32)  # BoringSSL sends a fake one
+            body = _s.pack("!H", DTLS_12) + client_random
+            body += _s.pack("!B", len(session_id)) + session_id
+            body += _s.pack("!B", len(cookie)) + cookie
+            ciphers = _s.pack(
+                "!HHHH", 0x8A8A, 0xC02B, 0xC02F, 0x00FF  # GREASE first
+            )
+            body += _s.pack("!H", len(ciphers)) + ciphers
+            body += b"\x01\x00"
+            body += _s.pack("!H", len(exts)) + exts
+            hdr = (
+                _s.pack("!B", 1)
+                + len(body).to_bytes(3, "big")
+                + _s.pack("!H", 0 if not cookie else 1)
+                + (0).to_bytes(3, "big")
+                + len(body).to_bytes(3, "big")
+            )
+            payload = hdr + body
+            return (
+                _s.pack("!BH", 22, 0xFEFF)
+                + _s.pack("!H", 0)
+                + (0 if not cookie else 1).to_bytes(6, "big")
+                + _s.pack("!H", len(payload))
+                + payload
+            )
+
+        (hvr,) = server.handle_datagram(chrome_ch(b""))
+        # extract the cookie from the HelloVerifyRequest
+        cookie_len = hvr[13 + HS_HEADER_LEN + 2]
+        cookie = hvr[
+            13 + HS_HEADER_LEN + 3 : 13 + HS_HEADER_LEN + 3 + cookie_len
+        ]
+        flight = server.handle_datagram(chrome_ch(cookie))
+        assert flight, "server did not answer the Chrome-shaped CH2"
+        assert server._state == "WAIT_CLIENT_FLIGHT"
+        # SRTP: our preference (CM) chosen from Chrome's 4-profile list
+        assert server.srtp_profile == 0x0001
+        # the GREASE group was skipped; x25519 won
+        assert server._ecdh_group == GROUP_X25519
 
     def test_garbage_datagram_ignored(self):
         server = DtlsEndpoint("server")
