@@ -81,8 +81,6 @@ from .srtp import (  # noqa: E402
     PROFILE_AES128_CM_SHA1_80,
 )
 
-SRTP_AES128_CM_HMAC_SHA1_80 = PROFILE_AES128_CM_SHA1_80
-
 # our preference order: the CM profile is end-to-end validated against
 # openssl's exported keying material; the AEAD profile (RFC 7714) is
 # implemented but its KDF interpretation lacks an independent

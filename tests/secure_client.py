@@ -1,0 +1,150 @@
+"""Shared browser-shaped secure test client (STUN -> DTLS -> SRTP).
+
+One implementation of the handshake/drain state machine for every secure
+test (test_secure_e2e.py, test_secure_soak.py) — hand-rolled copies of
+this scaffold drifted, so protocol changes now land in exactly one place.
+Not a fixture module: plain helpers, imported explicitly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+
+from ai_rtc_agent_tpu.server.secure import (
+    DtlsEndpoint,
+    StunMessage,
+    derive_srtp_contexts,
+    generate_certificate,
+)
+from ai_rtc_agent_tpu.server.secure import stun as stun_mod
+
+
+def sdp_attr(text: str, name: str) -> str | None:
+    m = re.search(rf"^a={name}:(.*)$", text, re.MULTILINE)
+    return m.group(1).strip() if m else None
+
+
+def secure_offer(
+    fingerprint: str,
+    ufrag: str = "cliu",
+    pwd: str = "clientpwd0123456789abc",
+    direction: str = "sendrecv",
+    pt: int = 102,
+) -> str:
+    """A Chrome-shaped offer (modeled on tests/fixtures/sdp/
+    browser_whip_offer.sdp) carrying a real client DTLS identity."""
+    return (
+        "v=0\r\n"
+        "o=- 4611731400430051336 2 IN IP4 127.0.0.1\r\n"
+        "s=-\r\nt=0 0\r\n"
+        "a=group:BUNDLE 0\r\n"
+        f"m=video 9 UDP/TLS/RTP/SAVPF {pt}\r\n"
+        "c=IN IP4 0.0.0.0\r\n"
+        f"a=ice-ufrag:{ufrag}\r\n"
+        f"a=ice-pwd:{pwd}\r\n"
+        f"a=fingerprint:sha-256 {fingerprint}\r\n"
+        "a=setup:actpass\r\n"
+        "a=mid:0\r\n"
+        f"a={direction}\r\n"
+        "a=rtcp-mux\r\n"
+        f"a=rtpmap:{pt} H264/90000\r\n"
+        f"a=fmtp:{pt} level-asymmetry-allowed=1;packetization-mode=1;"
+        "profile-level-id=42001f\r\n"
+    )
+
+
+class SecureTestPeer:
+    """Owns the client socket + DTLS association for one secure session."""
+
+    def __init__(self, name: str = "test-peer", ufrag: str = "cliu"):
+        self.cert = generate_certificate(name)
+        self.ufrag = ufrag
+        self.q: asyncio.Queue = asyncio.Queue()
+        self.transport = None
+        self.dtls: DtlsEndpoint | None = None
+        self.tx = None
+        self.rx = None
+        self.server_addr = None
+
+    async def open_socket(self):
+        loop = asyncio.get_running_loop()
+        peer = self
+
+        class _Recv(asyncio.DatagramProtocol):
+            def datagram_received(self, data, addr):
+                peer.q.put_nowait(data)
+
+        self.transport, _ = await loop.create_datagram_endpoint(
+            _Recv, local_addr=("127.0.0.1", 0)
+        )
+        return self
+
+    async def establish(self, answer_sdp: str, timeout: float = 20.0):
+        """Authenticated STUN binding + DTLS handshake against the answer's
+        media port; derives the SRTP contexts for the negotiated profile."""
+        m = re.search(r"^m=video (\d+) UDP/TLS/RTP/SAVPF", answer_sdp, re.M)
+        assert m, f"not a secure answer:\n{answer_sdp}"
+        self.server_addr = ("127.0.0.1", int(m.group(1)))
+        server_ufrag = sdp_attr(answer_sdp, "ice-ufrag")
+        server_pwd = sdp_attr(answer_sdp, "ice-pwd")
+        server_fp = sdp_attr(answer_sdp, "fingerprint").split(" ", 1)[1]
+
+        req = StunMessage(stun_mod.BINDING_REQUEST)
+        req.attributes.append(
+            (stun_mod.ATTR_USERNAME, f"{server_ufrag}:{self.ufrag}".encode())
+        )
+        req.attributes.append((stun_mod.ATTR_USE_CANDIDATE, b""))
+        self.transport.sendto(
+            req.encode(integrity_key=server_pwd.encode()), self.server_addr
+        )
+        data = await asyncio.wait_for(self.q.get(), 5)
+        resp = StunMessage.decode(data)
+        assert resp.message_type == stun_mod.BINDING_SUCCESS
+        assert resp.verify_integrity(server_pwd.encode(), data)
+
+        self.dtls = DtlsEndpoint(
+            "client", self.cert, verify_fingerprint=server_fp
+        )
+        for d in self.dtls.start():
+            self.transport.sendto(d, self.server_addr)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while not self.dtls.established and loop.time() < deadline:
+            try:
+                data = await asyncio.wait_for(self.q.get(), 3)
+            except asyncio.TimeoutError:
+                for d in self.dtls.retransmit():
+                    self.transport.sendto(d, self.server_addr)
+                continue
+            assert self.dtls.failed is None, self.dtls.failed
+            for d in self.dtls.handle_datagram(data):
+                self.transport.sendto(d, self.server_addr)
+        assert self.dtls.established, self.dtls.failed
+        self.tx, self.rx = derive_srtp_contexts(
+            self.dtls.export_srtp_keying_material(),
+            is_server=False,
+            profile=self.dtls.srtp_profile,
+        )
+        return self
+
+    def send_rtp(self, packets):
+        for pkt in packets:
+            self.transport.sendto(self.tx.protect(pkt), self.server_addr)
+
+    def drain_into(self, ring_source) -> None:
+        """Unprotect everything queued and feed it to the decode ring
+        (non-RTP / replayed datagrams are skipped)."""
+        try:
+            while True:
+                wire = self.q.get_nowait()
+                try:
+                    ring_source.feed_packet(self.rx.unprotect(wire))
+                except ValueError:
+                    pass
+        except asyncio.QueueEmpty:
+            pass
+
+    def close(self):
+        if self.transport is not None:
+            self.transport.close()

@@ -21,13 +21,8 @@ from ai_rtc_agent_tpu.media.frames import VideoFrame
 from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
 from ai_rtc_agent_tpu.server.agent import build_app
 from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
-from ai_rtc_agent_tpu.server.secure import (
-    DtlsEndpoint,
-    StunMessage,
-    derive_srtp_contexts,
-    generate_certificate,
-)
-from ai_rtc_agent_tpu.server.secure import stun as stun_mod
+from ai_rtc_agent_tpu.server.secure import generate_certificate
+from tests.secure_client import SecureTestPeer, sdp_attr, secure_offer
 
 
 @pytest.fixture(scope="module")
@@ -130,111 +125,44 @@ def test_secure_e2e_encrypted_media_roundtrip(native_lib, monkeypatch):
         app = build_app(pipeline=InvertPipeline(), provider=provider)
         http = TestClient(TestServer(app))
         await http.start_server()
-        loop = asyncio.get_event_loop()
-        recv_q: asyncio.Queue = asyncio.Queue()
-
-        class _ClientRecv(asyncio.DatagramProtocol):
-            def datagram_received(self, data, addr):
-                recv_q.put_nowait(data)
-
-        transport, _ = await loop.create_datagram_endpoint(
-            _ClientRecv, local_addr=("127.0.0.1", 0)
-        )
+        peer = await SecureTestPeer("browser-shaped-client").open_socket()
         out_sink = H264Sink(w, h, use_h264=use_h264, payload_type=102)
         back_src = H264RingSource(w, h, use_h264=use_h264)
         try:
-            cert = generate_certificate("browser-shaped-client")
-            offer_sdp = _client_offer(
-                cert.fingerprint, "cliu", "clientpwd0123456789abc", "sendrecv"
-            )
             r = await http.post(
                 "/offer",
                 json={
                     "room_id": "secure-room",
-                    "offer": {"sdp": offer_sdp, "type": "offer"},
+                    "offer": {
+                        "sdp": secure_offer(peer.cert.fingerprint),
+                        "type": "offer",
+                    },
                 },
             )
             assert r.status == 200
-            body = await r.json()
-            answer = body["sdp"]
-            server_ufrag = _sdp_attr(answer, "ice-ufrag")
-            server_pwd = _sdp_attr(answer, "ice-pwd")
-            server_fp = _sdp_attr(answer, "fingerprint").split(" ", 1)[1]
-            m = re.search(r"^m=video (\d+) UDP/TLS/RTP/SAVPF", answer, re.M)
-            assert m, answer
-            server_addr = ("127.0.0.1", int(m.group(1)))
+            await peer.establish((await r.json())["sdp"])
+            assert peer.dtls.srtp_profile == 1
 
-            # --- ICE: authenticated binding request with USE-CANDIDATE ---
-            req = StunMessage(stun_mod.BINDING_REQUEST)
-            req.attributes.append(
-                (stun_mod.ATTR_USERNAME, f"{server_ufrag}:cliu".encode())
-            )
-            req.attributes.append((stun_mod.ATTR_USE_CANDIDATE, b""))
-            transport.sendto(
-                req.encode(integrity_key=server_pwd.encode()), server_addr
-            )
-            data = await asyncio.wait_for(recv_q.get(), 5)
-            resp = StunMessage.decode(data)
-            assert resp.message_type == stun_mod.BINDING_SUCCESS
-            assert resp.verify_integrity(server_pwd.encode(), data)
-
-            # --- DTLS handshake (we are the active/client side) ---
-            dtls = DtlsEndpoint("client", cert, verify_fingerprint=server_fp)
-            for d in dtls.start():
-                transport.sendto(d, server_addr)
-            deadline = loop.time() + 15
-            while not dtls.established and loop.time() < deadline:
-                try:
-                    data = await asyncio.wait_for(recv_q.get(), 3)
-                except asyncio.TimeoutError:
-                    for d in dtls.retransmit():
-                        transport.sendto(d, server_addr)
-                    continue
-                assert dtls.failed is None, dtls.failed
-                for d in dtls.handle_datagram(data):
-                    transport.sendto(d, server_addr)
-            assert dtls.established, dtls.failed
-            assert dtls.srtp_profile == 1
-            tx, rx = derive_srtp_contexts(
-                dtls.export_srtp_keying_material(), is_server=False,
-                profile=dtls.srtp_profile,
-            )
-
-            # --- media: SRTP up, processed SRTP back ---
             val = 200
             decoded = []
+
+            def pop_all():
+                while (item := back_src.poll()) is not None:
+                    decoded.append(item[0])
+
             for i in range(16):
                 f = VideoFrame.from_ndarray(np.full((h, w, 3), val, np.uint8))
                 f.pts = i * 3000
-                for pkt in out_sink.consume(f):
-                    transport.sendto(tx.protect(pkt), server_addr)
-                try:
-                    while True:
-                        wire = recv_q.get_nowait()
-                        try:
-                            back_src.feed_packet(rx.unprotect(wire))
-                        except ValueError:
-                            pass  # non-RTP (e.g. SRTCP) — ignore here
-                except asyncio.QueueEmpty:
-                    pass
-                while (item := back_src._ring.pop()) is not None:
-                    decoded.append(item[0])
+                peer.send_rtp(out_sink.consume(f))
+                peer.drain_into(back_src)
+                pop_all()
                 await asyncio.sleep(0.05)
             for _ in range(60):
                 if decoded:
                     break
                 await asyncio.sleep(0.05)
-                try:
-                    while True:
-                        wire = recv_q.get_nowait()
-                        try:
-                            back_src.feed_packet(rx.unprotect(wire))
-                        except ValueError:
-                            pass
-                except asyncio.QueueEmpty:
-                    pass
-                while (item := back_src._ring.pop()) is not None:
-                    decoded.append(item[0])
+                peer.drain_into(back_src)
+                pop_all()
 
             assert decoded, "no SRTP-protected frames made it back"
             mean = float(decoded[-1].astype(np.float32).mean())
@@ -247,7 +175,7 @@ def test_secure_e2e_encrypted_media_roundtrip(native_lib, monkeypatch):
         finally:
             out_sink.close()
             back_src.close()
-            transport.close()
+            peer.close()
             await http.close()
 
     asyncio.run(go())
@@ -299,16 +227,8 @@ def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
         app = build_app(pipeline=InvertPipeline(), provider=provider)
         http = TestClient(TestServer(app))
         await http.start_server()
-        loop = asyncio.get_event_loop()
-        recv_q: asyncio.Queue = asyncio.Queue()
-
-        class _ClientRecv(asyncio.DatagramProtocol):
-            def datagram_received(self, data, addr):
-                recv_q.put_nowait(data)
-
-        transport, _ = await loop.create_datagram_endpoint(
-            _ClientRecv, local_addr=("127.0.0.1", 0)
-        )
+        loop = asyncio.get_running_loop()
+        peer = await SecureTestPeer("secure-whep-viewer", ufrag="view").open_socket()
         pub_sink = H264Sink(w, h, use_h264=use_h264)
         back_src = H264RingSource(w, h, use_h264=use_h264)
         try:
@@ -324,54 +244,20 @@ def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
             pub_port = json.loads(await r.text())["server_port"]
 
             # secure viewer: browser-shaped recvonly offer w/ fingerprint
-            cert = generate_certificate("secure-whep-viewer")
-            offer_sdp = _client_offer(
-                cert.fingerprint, "view", "viewerpwd0123456789abc", "recvonly"
-            )
             r = await http.post(
                 "/whep",
-                data=offer_sdp,
+                data=secure_offer(
+                    peer.cert.fingerprint,
+                    ufrag="view",
+                    pwd="viewerpwd0123456789abc",
+                    direction="recvonly",
+                ),
                 headers={"Content-Type": "application/sdp"},
             )
             assert r.status == 201
             answer = await r.text()
             assert "a=setup:passive" in answer and "a=sendonly" in answer
-            server_ufrag = _sdp_attr(answer, "ice-ufrag")
-            server_pwd = _sdp_attr(answer, "ice-pwd")
-            server_fp = _sdp_attr(answer, "fingerprint").split(" ", 1)[1]
-            m = re.search(r"^m=video (\d+) UDP/TLS/RTP/SAVPF", answer, re.M)
-            assert m, answer
-            server_addr = ("127.0.0.1", int(m.group(1)))
-
-            # ICE + DTLS from the viewer socket
-            req = StunMessage(stun_mod.BINDING_REQUEST)
-            req.attributes.append(
-                (stun_mod.ATTR_USERNAME, f"{server_ufrag}:view".encode())
-            )
-            req.attributes.append((stun_mod.ATTR_USE_CANDIDATE, b""))
-            transport.sendto(
-                req.encode(integrity_key=server_pwd.encode()), server_addr
-            )
-            await asyncio.wait_for(recv_q.get(), 5)
-            dtls = DtlsEndpoint("client", cert, verify_fingerprint=server_fp)
-            for d in dtls.start():
-                transport.sendto(d, server_addr)
-            deadline = loop.time() + 15
-            while not dtls.established and loop.time() < deadline:
-                try:
-                    data = await asyncio.wait_for(recv_q.get(), 3)
-                except asyncio.TimeoutError:
-                    for d in dtls.retransmit():
-                        transport.sendto(d, server_addr)
-                    continue
-                assert dtls.failed is None, dtls.failed
-                for d in dtls.handle_datagram(data):
-                    transport.sendto(d, server_addr)
-            assert dtls.established, dtls.failed
-            _, rx = derive_srtp_contexts(
-                dtls.export_srtp_keying_material(), is_server=False,
-                profile=dtls.srtp_profile,
-            )
+            await peer.establish(answer)
 
             # drive the publisher; expect encrypted frames at the viewer
             pub_sock, _ = await loop.create_datagram_endpoint(
@@ -380,6 +266,11 @@ def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
             )
             decoded = []
             val = 60
+
+            def pop_all():
+                while (item := back_src.poll()) is not None:
+                    decoded.append(item[0])
+
             try:
                 for i in range(40):
                     f = VideoFrame.from_ndarray(
@@ -389,17 +280,8 @@ def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
                     for pkt in pub_sink.consume(f):
                         pub_sock.sendto(pkt)
                     await asyncio.sleep(0.05)
-                    try:
-                        while True:
-                            wire = recv_q.get_nowait()
-                            try:
-                                back_src.feed_packet(rx.unprotect(wire))
-                            except ValueError:
-                                pass
-                    except asyncio.QueueEmpty:
-                        pass
-                    while (item := back_src._ring.pop()) is not None:
-                        decoded.append(item[0])
+                    peer.drain_into(back_src)
+                    pop_all()
                     if decoded:
                         break
             finally:
@@ -410,7 +292,7 @@ def test_secure_whep_viewer_receives_encrypted_stream(native_lib, monkeypatch):
         finally:
             pub_sink.close()
             back_src.close()
-            transport.close()
+            peer.close()
             await http.close()
 
     asyncio.run(go())

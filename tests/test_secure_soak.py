@@ -1,0 +1,127 @@
+"""Secure-tier soak: several concurrent encrypted peers, sustained frames,
+no leaks/errors, sane metrics.
+
+The stability evidence a single-roundtrip e2e cannot give: three
+browser-shaped peers handshake and stream concurrently against one agent
+process; every peer gets ITS OWN processed stream back (distinct DTLS
+associations, distinct SRTP keys), teardown releases cleanly.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_rtc_agent_tpu.media import native
+from ai_rtc_agent_tpu.media.frames import VideoFrame
+from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
+from ai_rtc_agent_tpu.server.agent import build_app
+from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+from tests.secure_client import SecureTestPeer, secure_offer
+
+N_PEERS = 3
+N_FRAMES = 40
+W = H = 64
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    return lib
+
+
+class TintPipeline:
+    """Deterministic transform so each peer's return stream is
+    attributable: output = 255 - input (shared pipeline, distinct inputs)."""
+
+    def __call__(self, frame):
+        arr = frame.to_ndarray(format="rgb24")
+        out = VideoFrame.from_ndarray(255 - arr)
+        out.pts = frame.pts
+        out.time_base = frame.time_base
+        out.wall_ts = frame.wall_ts
+        return out
+
+
+async def _secure_peer(http, idx: int, use_h264: bool):
+    """One full peer lifecycle; returns (decoded_frames, expected_mean)."""
+    peer = await SecureTestPeer(f"soak-peer-{idx}", ufrag=f"pr{idx}a").open_socket()
+    r = await http.post(
+        "/offer",
+        json={
+            "room_id": f"soak{idx}",
+            "offer": {
+                "sdp": secure_offer(
+                    peer.cert.fingerprint,
+                    ufrag=peer.ufrag,
+                    pwd=f"soakpeerpwd0123456789{idx}",
+                ),
+                "type": "offer",
+            },
+        },
+    )
+    assert r.status == 200
+    await peer.establish((await r.json())["sdp"])
+
+    val = 40 + idx * 60  # distinct constant input per peer
+    sink = H264Sink(W, H, use_h264=use_h264, payload_type=102)
+    back = H264RingSource(W, H, use_h264=use_h264)
+    decoded = []
+
+    def pop_all():
+        while (item := back.poll()) is not None:
+            decoded.append(item[0])
+
+    try:
+        for i in range(N_FRAMES):
+            f = VideoFrame.from_ndarray(np.full((H, W, 3), val, np.uint8))
+            f.pts = i * 3000
+            peer.send_rtp(sink.consume(f))
+            await asyncio.sleep(0.03)
+            peer.drain_into(back)
+            pop_all()
+        for _ in range(80):
+            if len(decoded) >= 5:
+                break
+            await asyncio.sleep(0.05)
+            peer.drain_into(back)
+            pop_all()
+    finally:
+        sink.close()
+        back.close()
+        peer.close()
+    return decoded, 255 - val
+
+
+def test_three_concurrent_secure_peers(native_lib, monkeypatch):
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    use_h264 = native.h264_available()
+
+    async def go():
+        provider = NativeRtpProvider(
+            default_width=W, default_height=H, use_h264=use_h264
+        )
+        app = build_app(pipeline=TintPipeline(), provider=provider)
+        http = TestClient(TestServer(app))
+        await http.start_server()
+        try:
+            results = await asyncio.gather(
+                *(_secure_peer(http, i, use_h264) for i in range(N_PEERS))
+            )
+            for idx, (decoded, expect) in enumerate(results):
+                assert decoded, f"peer {idx} got no frames back"
+                mean = float(decoded[-1].astype(np.float32).mean())
+                assert abs(mean - expect) < 25, (
+                    f"peer {idx} stream not its own: mean {mean} vs {expect}"
+                )
+            m = await http.get("/metrics")
+            snap = await m.json()
+            assert snap.get("secure_sessions_total", 0) >= N_PEERS
+            assert snap.get("srtp_drops_total", 0) == 0
+        finally:
+            await http.close()
+
+    asyncio.run(go())
