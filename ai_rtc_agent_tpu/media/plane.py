@@ -208,17 +208,9 @@ class H264RingSource:
 
     def stop(self):
         self._ended = True
-        h = self._handlers.get("ended")
-        if h:
-            r = h()
-            if asyncio.iscoroutine(r):
-                # the agent registers async on_ended handlers — a sync call
-                # would create the coroutine and silently never run it
-                # (surfaced by the soak test's RuntimeWarnings)
-                try:
-                    asyncio.ensure_future(r)
-                except RuntimeError:
-                    r.close()  # no running loop (sync teardown path)
+        from ..utils.dispatch import fire_handler
+
+        fire_handler(self._handlers.get("ended"))
 
     @property
     def dropped(self) -> int:

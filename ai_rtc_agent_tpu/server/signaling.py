@@ -69,9 +69,9 @@ class LoopbackTrack:
 
     def stop(self):
         self._ended.set()
-        h = self._handlers.get("ended")
-        if h:
-            asyncio.get_event_loop().create_task(_maybe_await(h()))
+        from ..utils.dispatch import fire_handler
+
+        fire_handler(self._handlers.get("ended"))
 
 
 async def _maybe_await(x):

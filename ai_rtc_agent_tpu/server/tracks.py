@@ -57,9 +57,9 @@ class VideoStreamTrack:
         return register(f) if f else register
 
     def stop(self):
-        h = self._handlers.get("ended")
-        if h:
-            h()
+        from ..utils.dispatch import fire_handler
+
+        fire_handler(self._handlers.get("ended"))
 
     @property
     def _fbs(self) -> int:

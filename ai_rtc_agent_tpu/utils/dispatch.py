@@ -1,0 +1,26 @@
+"""One dispatcher for sync-or-async event handlers fired from sync code.
+
+Three media classes fire an "ended" handler from synchronous teardown
+paths, and the agent registers ASYNC handlers on all of them
+(server/agent.py) — a bare ``h()`` creates the coroutine and silently
+never runs it (found via RuntimeWarnings in the secure soak test).  One
+helper instead of three hand-rolled dispatches, so the class of bug is
+fixed once.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def fire_handler(handler) -> None:
+    """Call ``handler()``; if it returns a coroutine, schedule it on the
+    running loop (or close it when no loop exists — sync teardown)."""
+    if handler is None:
+        return
+    r = handler()
+    if asyncio.iscoroutine(r):
+        try:
+            asyncio.ensure_future(r)
+        except RuntimeError:
+            r.close()

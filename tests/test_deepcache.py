@@ -308,3 +308,29 @@ def test_multipeer_buckets_compose_with_deepcache(monkeypatch):
         np.testing.assert_allclose(
             a.astype(np.float64), b.astype(np.float64), atol=1.0
         )
+
+
+@pytest.mark.parametrize("kind,mesh_kw", [("tp", {"tp": 2}), ("sp", {"sp": 2})])
+def test_cache_composes_with_sharded_serving(kind, mesh_kw):
+    """UNET_CACHE under --tp/--sp: both cadence variants compile and run
+    under the sharded mesh (the capture/cached pair are ordinary jitted
+    steps; pjit shards them like the full graph) — pinned so a future
+    engine change cannot silently break the combination."""
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.parallel import mesh as M
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    bundle = registry.load_model_bundle(
+        "tiny-test", attn_impl="ring" if kind == "sp" else None
+    )
+    cfg = registry.default_stream_config("tiny-test", unet_cache_interval=3)
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        mesh=M.make_mesh(**mesh_kw),
+    )
+    eng.prepare("cache x mesh", seed=1)
+    rng = np.random.default_rng(0)
+    for _ in range(4):  # spans a capture tick and cached ticks
+        out = eng(rng.integers(0, 256, (64, 64, 3), np.uint8))
+        assert np.isfinite(out.astype(np.float64)).all()
+    assert eng._tick == 4

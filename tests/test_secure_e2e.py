@@ -21,7 +21,6 @@ from ai_rtc_agent_tpu.media.frames import VideoFrame
 from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
 from ai_rtc_agent_tpu.server.agent import build_app
 from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
-from ai_rtc_agent_tpu.server.secure import generate_certificate
 from tests.secure_client import SecureTestPeer, sdp_attr, secure_offer
 
 
@@ -41,34 +40,6 @@ class InvertPipeline:
         out.time_base = frame.time_base
         out.wall_ts = frame.wall_ts
         return out
-
-
-def _client_offer(fingerprint: str, ufrag: str, pwd: str, direction: str) -> str:
-    """A Chrome-shaped offer (modeled on tests/fixtures/sdp/
-    browser_whip_offer.sdp) with our client's real DTLS identity."""
-    return (
-        "v=0\r\n"
-        "o=- 4611731400430051336 2 IN IP4 127.0.0.1\r\n"
-        "s=-\r\nt=0 0\r\n"
-        "a=group:BUNDLE 0\r\n"
-        "m=video 9 UDP/TLS/RTP/SAVPF 102\r\n"
-        "c=IN IP4 0.0.0.0\r\n"
-        f"a=ice-ufrag:{ufrag}\r\n"
-        f"a=ice-pwd:{pwd}\r\n"
-        f"a=fingerprint:sha-256 {fingerprint}\r\n"
-        "a=setup:actpass\r\n"
-        "a=mid:0\r\n"
-        f"a={direction}\r\n"
-        "a=rtcp-mux\r\n"
-        "a=rtpmap:102 H264/90000\r\n"
-        "a=fmtp:102 level-asymmetry-allowed=1;packetization-mode=1;"
-        "profile-level-id=42001f\r\n"
-    )
-
-
-def _sdp_attr(sdp_text: str, name: str) -> str | None:
-    m = re.search(rf"^a={name}:(.*)$", sdp_text, re.MULTILINE)
-    return m.group(1).strip() if m else None
 
 
 def test_browser_whip_offer_gets_secure_answer(native_lib):
@@ -93,9 +64,9 @@ def test_browser_whip_offer_gets_secure_answer(native_lib):
             assert "m=video" in answer
             assert "UDP/TLS/RTP/SAVPF" in answer
             assert "a=ice-lite" in answer
-            assert _sdp_attr(answer, "ice-ufrag")
-            assert len(_sdp_attr(answer, "ice-pwd") or "") >= 22
-            fp = _sdp_attr(answer, "fingerprint")
+            assert sdp_attr(answer, "ice-ufrag")
+            assert len(sdp_attr(answer, "ice-pwd") or "") >= 22
+            fp = sdp_attr(answer, "fingerprint")
             assert fp and fp.startswith("sha-256 ")
             assert len(fp.split(" ", 1)[1].split(":")) == 32
             assert "a=setup:passive" in answer
@@ -202,7 +173,7 @@ def test_obs_whip_offer_gets_secure_answer_with_bundle(native_lib):
             answer = await r.text()
             assert "UDP/TLS/RTP/SAVPF" in answer
             assert "a=ice-lite" in answer
-            assert _sdp_attr(answer, "fingerprint")
+            assert sdp_attr(answer, "fingerprint")
             assert "a=setup:passive" in answer
             assert "a=group:BUNDLE video0" in answer
         finally:
@@ -309,7 +280,7 @@ def test_sha384_fingerprint_offer_rejected(native_lib):
         client = TestClient(TestServer(app))
         await client.start_server()
         try:
-            offer = _client_offer("AA:" * 47 + "AA", "u", "p" * 22, "sendonly")
+            offer = secure_offer("AA:" * 47 + "AA", ufrag="u", pwd="p" * 22, direction="sendonly")
             offer = offer.replace("fingerprint:sha-256", "fingerprint:sha-384")
             r = await client.post(
                 "/whip",
