@@ -26,9 +26,10 @@ from ai_rtc_agent_tpu.server.secure.srtp import derive_srtp_contexts
 OPENSSL = shutil.which("openssl")
 
 
-def run_handshake(server, client, drop=None, max_rounds=80):
+def run_handshake(server, client, drop=None, max_rounds=80, duplicate=False):
     """Pump datagrams between the two sans-IO endpoints until quiescent.
-    `drop`: set of 0-based indices of datagrams to drop (loss injection)."""
+    `drop`: set of 0-based indices of datagrams to drop (loss injection);
+    `duplicate`: deliver every datagram twice (duplication injection)."""
     n = 0
     retransmits = 0
     inflight = [("s", d) for d in client.start()]
@@ -54,7 +55,12 @@ def run_handshake(server, client, drop=None, max_rounds=80):
         if drop and (n - 1) in drop:
             continue
         target, back = (server, "c") if to == "s" else (client, "s")
-        inflight.extend((back, d) for d in target.handle_datagram(dgram))
+        outs = target.handle_datagram(dgram)
+        if duplicate:
+            outs = outs + target.handle_datagram(dgram)
+        inflight.extend((back, d) for d in outs)
+        if duplicate and server.established and client.established:
+            break  # echo amplification has no more work to do
     return server, client
 
 
@@ -591,3 +597,43 @@ def test_exporter_requires_handshake():
     ep = DtlsEndpoint("server")
     with pytest.raises(DtlsError):
         ep.export_srtp_keying_material()
+
+
+def test_reordered_client_flight_still_completes():
+    """UDP reorders: the client's multi-datagram final flight (Certificate/
+    CKE[/CV] then CCS+Finished) delivered BACKWARDS must still complete —
+    out-of-order handshake messages buffer in the reassembly window and
+    the early epoch-1 Finished is dropped + recovered via retransmit."""
+    server = DtlsEndpoint("server")
+    client = DtlsEndpoint("client")
+    (ch1,) = client.start()
+    (hvr,) = server.handle_datagram(ch1)
+    (ch2,) = client.handle_datagram(hvr)
+    (flight4,) = server.handle_datagram(ch2)
+    final = client.handle_datagram(flight4)
+    assert len(final) >= 2  # multi-datagram flight to reorder
+    outs = []
+    for d in reversed(final):
+        outs.extend(server.handle_datagram(d))
+    if not server.established:
+        # the dropped-early-Finished case: one client retransmit recovers
+        for d in client.retransmit():
+            outs.extend(server.handle_datagram(d))
+    assert server.established, server.failed
+    for d in outs:
+        client.handle_datagram(d)
+    assert client.established, client.failed
+    assert (
+        server.export_srtp_keying_material()
+        == client.export_srtp_keying_material()
+    )
+
+
+def test_duplicated_datagrams_harmless():
+    """Every datagram delivered TWICE (duplication, not loss): handshake
+    completes and nothing double-processes into a failure."""
+    server = DtlsEndpoint("server")
+    client = DtlsEndpoint("client")
+    run_handshake(server, client, duplicate=True)
+    assert server.established and client.established
+    assert server.failed is None and client.failed is None
