@@ -17,8 +17,10 @@ directly over the ``cryptography`` primitive library:
   * optional CertificateRequest so the peer's certificate can be checked
     against the SDP fingerprint (browsers always hold a certificate)
 
-Design: `DtlsEndpoint` is sans-IO — `handle_datagram(bytes) -> [bytes]`
-plus `start()`/`retransmit()`; the UDP plumbing lives in endpoint.py.
+Design: `DtlsEndpoint` is sans-IO — `handle_datagram(bytes, addr=None) ->
+[bytes]` plus `start()`/`retransmit()`; the UDP plumbing lives in
+endpoint.py (which passes the source address so the HVR cookie is
+path-bound).
 Interop is pinned against `openssl s_client -dtls1_2 -use_srtp` in
 tests/test_secure_dtls.py (the same stack browsers run).
 """
@@ -150,6 +152,41 @@ class DtlsDiscard(Exception):
     sender, so treating them as fatal would be a one-datagram DoS."""
 
 
+# every scalar a handshake handler may mutate BEFORE its body parse can
+# raise.  The reassembly drain snapshots these and restores them on any
+# plain-exception rewind — anything missing here becomes a one-datagram
+# wedge: the mutated flag sticks, and the real peer's message then trips a
+# repeat-guard forever (code review r5).  One list, used for both save and
+# restore, so the pairing cannot desync.
+_SNAP_ATTRS = (
+    "_peer_key_share",
+    "_pre_master",
+    "_session_hash",
+    "_cert_verify_ok",
+    "peer_cert_der",
+    "_client_random",
+    "_server_random",
+    "_record_version",
+    "_peer_wants_cert",
+    "_ecdh_group",
+    "_ems",
+    "_peer_offered_ems",
+    "_peer_offered_reneg",
+    "srtp_profile",
+    "_state",
+)
+
+
+class _Unexpected(Exception):
+    """A handshake message that is valid in shape but arrives in a state
+    (or order) where processing it would let a spoofed plaintext record
+    mutate the association — state machine, transcript or msg_seq cursor.
+    Deliberately NOT DtlsError/DtlsDiscard: the reassembly drain rewinds
+    the seq cursor + transcript for plain exceptions before handle_datagram
+    silently drops the record, so the real peer's message at that msg_seq
+    still processes later."""
+
+
 class _RecordCipher:
     """One direction of the epoch-1 AES-128-GCM record protection."""
 
@@ -260,9 +297,25 @@ class DtlsEndpoint:
         self._appdata: list = []
         self._state = "WAIT_CH1" if role == "server" else "START"
         # client-side accumulators for the server flight
-        self._client_seen_done = False
-        self._expect_cert_verify = False
+        # flips True only after a CertificateVerify signature checked out —
+        # the server Finished handler requires it whenever a client cert
+        # was requested (possession proof, RFC 8827 s6.5; advisor r4)
+        self._cert_verify_ok = False
         self._peer_wants_cert = False
+        # hello phase is STATELESS and restartable (RFC 6347 s4.2.1 server
+        # philosophy): HVRs echo the peer's msg_seq and consume nothing; a
+        # valid-cookie hello (re-)derives both msg_seq counters from itself.
+        # _hvr_count bounds client-side restart thrash from spoofed HVRs;
+        # _accepted_ch_* make the server's accept idempotent/replay-safe.
+        self._hvr_count = 0
+        self._accepted_ch_body: bytes | None = None
+        self._accepted_ch_seq = -1
+        # source address of the datagram currently being processed (when
+        # the I/O layer supplies one) — binds the HVR cookie to the path
+        self._dgram_addr: tuple | None = None
+        # address that last successfully advanced the handshake: the
+        # duplicate-triggered flight retransmit only answers this source
+        self._assoc_addr: tuple | None = None
 
     # ------------------------------------------------------------------
     # public API
@@ -278,11 +331,17 @@ class DtlsEndpoint:
         self._last_flight = flight
         return flight
 
-    def handle_datagram(self, data: bytes) -> list:
-        """Feed one UDP datagram; returns datagrams to transmit."""
+    def handle_datagram(self, data: bytes, addr: tuple | None = None) -> list:
+        """Feed one UDP datagram; returns datagrams to transmit.
+
+        ``addr`` (optional) is the datagram's source address; when given,
+        the server binds its HelloVerifyRequest cookie to it so a cookie
+        minted for one source cannot validate a spoofed-source ClientHello
+        (RFC 6347 s4.2.1 return-routability / anti-amplification)."""
         if self.failed is not None:
             return []  # dead association — a fatal alert already went out
         out: list = []
+        self._dgram_addr = addr
         self._dup_seen = False
         off = 0
         while off + RECORD_HEADER_LEN <= len(data):
@@ -319,9 +378,22 @@ class DtlsEndpoint:
                     e,
                 )
                 continue
-        if self._dup_seen and not out and self._last_flight:
+        if (
+            self._dup_seen
+            and not out
+            and self._last_flight
+            and (
+                addr is None
+                or self._assoc_addr is None
+                or addr == self._assoc_addr
+            )
+        ):
             # the peer retransmitted a flight we already processed — our
-            # answering flight was lost; resend it (once per datagram)
+            # answering flight was lost; resend it (once per datagram).
+            # Address-gated: a stale-msg_seq record is a ~25-byte forgery,
+            # and answering an arbitrary source with a ~1.5 KB flight would
+            # be a 60x amplifier aimed wherever the attacker spoofs
+            # (code review r5)
             out.extend(self._last_flight)
         return out
 
@@ -415,7 +487,19 @@ class DtlsEndpoint:
             frag = self._read_cipher.open(seq8, ctype, frag)
             self._replay_note(seq_int)
         if ctype == CT_CCS:
-            # peer switches to its epoch-1 cipher for everything after
+            # peer switches to its epoch-1 cipher for everything after.
+            # CCS is ONE unauthenticated plaintext byte; accepting it in the
+            # wrong state flips _recv_epoch early and the peer's remaining
+            # plaintext flight (CertificateVerify!) gets wrong-epoch-dropped
+            # into a fatal auth failure (code review r5) — so gate it to the
+            # exact point the real peer sends it
+            if self.role == "server":
+                if self._state != "WAIT_CLIENT_FLIGHT" or (
+                    self.request_client_cert and not self._cert_verify_ok
+                ):
+                    return []
+            elif self._state != "WAIT_SERVER_FINISHED":
+                return []
             self._derive_keys_if_needed()
             if self._key_block is None:
                 return []  # CCS before key exchange completed — drop
@@ -479,6 +563,34 @@ class DtlsEndpoint:
             off += HS_HEADER_LEN + frag_len
             if len(body) < frag_len:
                 break
+            # hello phase: handled OUT OF BAND, before any seq bookkeeping.
+            # A racing/restarting peer's hello may carry any msg_seq (stale
+            # or ahead); binding it to the in-order drain is exactly what
+            # let one spoofed hello permanently desync the exchange (code
+            # review r5).  CH/HVR are tiny — never fragmented in practice;
+            # a fragmented one falls through to the drain and is rejected.
+            if (
+                frag_off == 0
+                and frag_len == total
+                and not self.established
+                and (
+                    (
+                        self.role == "server"
+                        and msg_type == HT_CLIENT_HELLO
+                        and self._peer_key_share is None
+                    )
+                    or (
+                        self.role == "client"
+                        and msg_type == HT_HELLO_VERIFY_REQUEST
+                        and not self._server_random
+                    )
+                )
+            ):
+                if self.role == "server":
+                    out.extend(self._hello_phase_server(bytes(body), msg_seq))
+                else:
+                    out.extend(self._hello_phase_client(bytes(body), msg_seq))
+                continue
             if msg_seq < self._recv_next_seq:
                 # duplicate from the peer's last flight → ours was likely
                 # lost; flag for a single resend (classic DTLS recovery)
@@ -512,28 +624,36 @@ class DtlsEndpoint:
                 # retransmission would be transcribed a second time and the
                 # Finished hashes could never match again
                 t_len = len(self._session_hash_input)
-                snap = (
-                    self._peer_key_share,
-                    self._pre_master,
-                    self._session_hash,
-                    self._expect_cert_verify,
-                    self.peer_cert_der,
-                )
+                snap = tuple(getattr(self, a) for a in _SNAP_ATTRS)
                 try:
                     out.extend(self._process_handshake(mtype, bytes(mbody), seq))
+                    # remember which source address is actually speaking the
+                    # handshake — the duplicate-triggered flight retransmit
+                    # is gated on it (anti-amplification, code review r5)
+                    if self._dgram_addr is not None:
+                        self._assoc_addr = self._dgram_addr
                 except (DtlsError, DtlsDiscard):
                     raise
-                except Exception:
+                except Exception as e:
                     self._recv_next_seq = seq
                     del self._session_hash_input[t_len:]
-                    (
-                        self._peer_key_share,
-                        self._pre_master,
-                        self._session_hash,
-                        self._expect_cert_verify,
-                        self.peer_cert_der,
-                    ) = snap
-                    raise
+                    for a, v in zip(_SNAP_ATTRS, snap):
+                        setattr(self, a, v)
+                    # swallow, don't re-raise: this record is being silently
+                    # dropped either way, but a re-raise would ALSO discard
+                    # the response flights already accumulated in `out` for
+                    # real messages processed earlier in this same drain —
+                    # a spoofed pre-buffered junk message would then cost
+                    # the peer a full retransmission timeout per flight
+                    # (code review r5)
+                    logger.debug(
+                        "dtls %s: dropping handshake msg seq %d (%s: %s)",
+                        self.role,
+                        seq,
+                        type(e).__name__,
+                        e,
+                    )
+                    break
         return out
 
     def _transcribe(self, msg_type: int, body: bytes, msg_seq: int) -> None:
@@ -586,12 +706,8 @@ class DtlsEndpoint:
         """A ClientHello with an empty cookie is the pre-cookie CH1 — it and
         the HelloVerifyRequest stay out of the transcript (RFC 6347 s4.2.1)."""
         try:
-            off = 2 + 32
-            sid_len = body[off]
-            off += 1 + sid_len
-            cookie_len = body[off]
-            return cookie_len == 0
-        except IndexError:
+            return self._peek_hello(body)[1] == b""
+        except (ValueError, IndexError):
             return False
 
     def _plain_record(self, ctype: int, payload: bytes) -> bytes:
@@ -646,32 +762,67 @@ class DtlsEndpoint:
 
     def _server_process(self, msg_type: int, body: bytes, msg_seq: int) -> list:
         if msg_type == HT_CLIENT_HELLO:
-            return self._server_on_client_hello(body, msg_seq)
+            # real hellos are intercepted statelessly pre-drain; one that
+            # reaches the in-order drain is fragmented (no real browser
+            # fragments a CH) or arrived after the key exchange — spoof
+            # either way (advisor r4 + code review r5)
+            raise _Unexpected(f"ClientHello in state {self._state}")
         if msg_type == HT_CERTIFICATE and self._state == "WAIT_CLIENT_FLIGHT":
+            if self._peer_key_share is not None:
+                # the client flight orders Certificate → ClientKeyExchange →
+                # CertificateVerify (RFC 5246 s7.4.8); a certificate landing
+                # AFTER the CKE is how a replayed cert would dodge the
+                # CertificateVerify it owes (advisor r4 high)
+                raise _Unexpected("client Certificate after ClientKeyExchange")
+            if not self.request_client_cert or self.peer_cert_der is not None:
+                # unsolicited or repeated client Certificate: no legitimate
+                # client sends one we didn't request, or sends two — only a
+                # spoof does, and processing it would pollute the transcript
+                # or overwrite the identity (code review r5)
+                raise _Unexpected("unsolicited/repeated client Certificate")
             self._transcribe(msg_type, body, msg_seq)
             self._parse_peer_certificate(body)
             return []
         if msg_type == HT_CLIENT_KEY_EXCHANGE and self._state == "WAIT_CLIENT_FLIGHT":
+            if self.request_client_cert and self.peer_cert_der is None:
+                # when a certificate was requested it must precede the CKE;
+                # accepting the CKE first would let the whole client-auth
+                # requirement evaporate with the Certificate message
+                raise _Unexpected("ClientKeyExchange before required client Certificate")
             self._transcribe(msg_type, body, msg_seq)
             plen = body[0]
             self._peer_key_share = body[1 : 1 + plen]
             self._compute_pre_master()
             # EMS session hash: transcript through ClientKeyExchange
             self._session_hash = self._transcript_hash()
-            self._expect_cert_verify = (
-                self.peer_cert_der is not None and self.request_client_cert
-            )
             return []
         if msg_type == HT_CERTIFICATE_VERIFY and self._state == "WAIT_CLIENT_FLIGHT":
+            if self.peer_cert_der is None or self._peer_key_share is None:
+                raise _Unexpected(
+                    "CertificateVerify before Certificate/ClientKeyExchange"
+                )
             self._verify_certificate_verify(body)
             self._transcribe(msg_type, body, msg_seq)
-            self._expect_cert_verify = False
+            self._cert_verify_ok = True
             return []
         if msg_type == HT_FINISHED and self._state == "WAIT_CLIENT_FLIGHT":
-            if self._expect_cert_verify:
-                # a replayed certificate without proof of key possession
-                # must not authenticate (the whole point of CertificateVerify)
-                raise DtlsError("client presented a certificate but no CertificateVerify")
+            if self._recv_epoch == 0:
+                # a legitimate Finished always arrives AFTER the peer's CCS,
+                # i.e. encrypted on epoch 1 — a plaintext epoch-0 Finished
+                # is a forgery and must not reach the fatal verify/auth
+                # checks below (code review r5)
+                raise _Unexpected("plaintext Finished before ChangeCipherSpec")
+            if self.request_client_cert and not self._cert_verify_ok:
+                # the requested client auth never completed — the client
+                # presented a (possibly replayed) certificate without the
+                # CertificateVerify that proves key possession, omitted its
+                # Certificate entirely, or smuggled it outside the
+                # Certificate→CKE→CertificateVerify order; with an
+                # SDP-pinned identity this is mandatory (RFC 8827 s6.5)
+                raise DtlsError(
+                    "client authentication incomplete: no verified "
+                    "Certificate/CertificateVerify before Finished"
+                )
             self._derive_keys_if_needed()
             expect = p_sha256(
                 self._master_secret,
@@ -698,10 +849,102 @@ class DtlsEndpoint:
             flight = [ccs + fin[0]] + fin[1:]
             self._last_flight = flight
             return flight
-        return []
+        # no branch matched: wrong type for this state.  Raise (→ seq-cursor
+        # rewind + silent drop) rather than return []: a plain return would
+        # CONSUME the msg_seq, turning the real peer's message at that seq
+        # into a permanent duplicate — a spoofed livelock (code review r5)
+        raise _Unexpected(
+            f"handshake type {msg_type} in server state {self._state}"
+        )
+
+    # ---------------- hello phase (stateless, restartable) ----------------
+
+    @staticmethod
+    def _peek_hello(body: bytes) -> tuple:
+        """Pure parse of (client_random, cookie) from a ClientHello body —
+        raises on truncation BEFORE any state is touched."""
+        off = 2
+        client_random = bytes(body[off : off + 32])
+        if len(client_random) != 32:
+            raise ValueError("short ClientHello")
+        off += 32
+        sid_len = body[off]
+        off += 1 + sid_len
+        cookie_len = body[off]
+        cookie = bytes(body[off + 1 : off + 1 + cookie_len])
+        if len(cookie) != cookie_len:
+            raise ValueError("short ClientHello cookie")
+        return client_random, cookie
+
+    def _hello_phase_server(self, body: bytes, msg_seq: int) -> list:
+        client_random, cookie = self._peek_hello(body)
+        expected = self._cookie_for(client_random)
+        if not cookie or not hmac.compare_digest(cookie, expected):
+            if self._accepted_ch_body is not None:
+                # a wrong-cookie hello after we already accepted one is a
+                # spoof (or a mid-handshake NAT rebind, vanishingly rare
+                # under ICE) — restarting the exchange for it would let any
+                # blind forgery reset the real client's progress
+                raise _Unexpected("wrong-cookie ClientHello after accept")
+            # stateless HelloVerifyRequest: echo the hello's msg_seq and
+            # touch no sequencing/transcript state — every racing or
+            # restarting client gets a usable cookie and nothing to poison
+            # (RFC 6347 s4.2.1).  The WAIT_CH2 label is introspection-only
+            # (nothing branches on CH1-vs-CH2; tests and logs read it).
+            hvr = (
+                struct.pack("!H", DTLS_10)
+                + struct.pack("!B", len(expected))
+                + expected
+            )
+            rec = self._plain_record(
+                CT_HANDSHAKE, _hs_header(HT_HELLO_VERIFY_REQUEST, len(hvr), msg_seq) + hvr
+            )
+            self._state = "WAIT_CH2"
+            return [rec]
+        if self._accepted_ch_body is not None:
+            if (
+                body == self._accepted_ch_body
+                and msg_seq == self._accepted_ch_seq
+            ):
+                # pure retransmit of the accepted hello → our flight was
+                # lost.  Ride the duplicate path's single end-of-datagram
+                # resend (address-gated, once per datagram) instead of
+                # emitting the flight here: N replayed copies packed into
+                # one datagram must not extract N flights (code review r5)
+                self._dup_seen = True
+                return []
+            if body != self._accepted_ch_body:
+                # valid cookie but different hello after accept: only an
+                # observing injector can build this (cookie+random ride the
+                # wire) — documented concession; never restart for it
+                raise _Unexpected("divergent ClientHello after accept")
+            # same body, new msg_seq: the client restarted its hello (a
+            # spoofed HVR reset it) — restart our side in lockstep
+        return self._accept_client_hello(body, msg_seq)
+
+    def _accept_client_hello(self, body: bytes, msg_seq: int) -> list:
+        # the accepted hello DEFINES the handshake: both msg_seq cursors
+        # derive from it (our flight answers at its seq — the convention
+        # OpenSSL's DTLSv1_listen follows), and everything negotiated by a
+        # previous accept of this association is recomputed
+        self._session_hash_input = bytearray()
+        self._reassembly.clear()
+        self._recv_next_seq = msg_seq + 1
+        self._send_msg_seq = msg_seq
+        self.peer_cert_der = None
+        self._cert_verify_ok = False
+        self._pre_master = None
+        self._master_secret = None
+        self._session_hash = None
+        self._key_block = None
+        self._accepted_ch_body = body
+        self._accepted_ch_seq = msg_seq
+        if self._dgram_addr is not None:
+            self._assoc_addr = self._dgram_addr
+        return self._server_on_client_hello(body, msg_seq)
 
     def _server_on_client_hello(self, body: bytes, msg_seq: int) -> list:
-        # parse
+        # parse (cookie already validated by _hello_phase_server)
         off = 0
         (client_version,) = struct.unpack_from("!H", body, off)
         off += 2
@@ -721,20 +964,6 @@ class DtlsEndpoint:
         comp_len = body[off]
         off += 1 + comp_len
         exts = self._parse_extensions(body[off:])
-
-        expected_cookie = hmac.new(
-            self._cookie_secret, client_random, hashlib.sha256
-        ).digest()[:16]
-        if not cookie or not hmac.compare_digest(cookie, expected_cookie):
-            hvr = (
-                struct.pack("!H", DTLS_10)
-                + struct.pack("!B", len(expected_cookie))
-                + expected_cookie
-            )
-            flight = self._flush_handshake([(HT_HELLO_VERIFY_REQUEST, hvr, False)])
-            self._last_flight = flight
-            self._state = "WAIT_CH2"
-            return flight
 
         # CH2 accepted — everything we send from here is DTLS 1.2
         self._record_version = DTLS_12
@@ -852,6 +1081,17 @@ class DtlsEndpoint:
         self._state = "WAIT_CLIENT_FLIGHT"
         return flight
 
+    def _cookie_for(self, client_random: bytes) -> bytes:
+        """HVR cookie: HMAC over the client random AND (when the I/O layer
+        passes one) the datagram's source address, so a cookie the attacker
+        legitimately obtained at its own address cannot be replayed with a
+        spoofed source to aim our ~1.5 KB certificate flight at a victim
+        (RFC 6347 s4.2.1; advisor r4 low)."""
+        addr = b"" if self._dgram_addr is None else repr(self._dgram_addr).encode()
+        return hmac.new(
+            self._cookie_secret, client_random + addr, hashlib.sha256
+        ).digest()[:16]
+
     def _parse_peer_certificate(self, body: bytes) -> None:
         total = int.from_bytes(body[0:3], "big")
         if total == 0:
@@ -862,6 +1102,14 @@ class DtlsEndpoint:
                 raise DtlsError(
                     "peer declined to present a certificate but the SDP "
                     "pins a fingerprint"
+                )
+            if self.role == "server" and self.request_client_cert:
+                # spec-legal decline (RFC 5246 s7.4.6) of auth we require:
+                # answer with a FATAL alert, not the silent stall the CKE
+                # ordering guard would otherwise produce (code review r5)
+                raise DtlsError(
+                    "client answered CertificateRequest with an empty "
+                    "certificate list"
                 )
             self.peer_cert_der = None  # empty list (no client cert)
             return
@@ -876,13 +1124,17 @@ class DtlsEndpoint:
                 )
 
     def _verify_certificate_verify(self, body: bytes) -> None:
+        # structural defects are discard-class, not fatal: a malformed CV is
+        # a ~25-byte plaintext forgery anyone can aim at the port, and the
+        # real client's well-formed CV should still process afterwards
+        # (code review r5).  Only a failed SIGNATURE check is fatal.
         if len(body) < 4:
-            raise DtlsError("short CertificateVerify")
+            raise _Unexpected("short CertificateVerify")
         (alg,) = struct.unpack_from("!H", body, 0)
         (slen,) = struct.unpack_from("!H", body, 2)
         sig = body[4 : 4 + slen]
         if alg != SIG_ECDSA_SECP256R1_SHA256:
-            raise DtlsError(f"unsupported CertificateVerify alg {alg:#06x}")
+            raise _Unexpected(f"unsupported CertificateVerify alg {alg:#06x}")
         pub = x509.load_der_x509_certificate(self.peer_cert_der).public_key()
         try:
             pub.verify(
@@ -893,17 +1145,42 @@ class DtlsEndpoint:
 
     # ---------------- client ----------------
 
+    def _hello_phase_client(self, body: bytes, msg_seq: int) -> list:
+        """Stateless HVR handling: restart the hello with the offered
+        cookie, deriving the expected server-flight msg_seq from our own
+        hello's (the accept convention).  Bounded so spoofed HVRs cost RTTs,
+        never the handshake."""
+        if self._state != "WAIT_SH":
+            raise _Unexpected("HelloVerifyRequest before start")
+        if self._hvr_count >= 8:
+            # restart-thrash bound (a real exchange uses 1-2): fail LOUDLY —
+            # silently dropping would let 8 junk HVRs park the handshake in
+            # a signal-less livelock; a clean `failed` lets the signaling
+            # layer re-offer (code review r5)
+            raise DtlsError("HelloVerifyRequest restart budget exhausted")
+        cookie_len = body[2]
+        cookie = bytes(body[3 : 3 + cookie_len])  # raises → silent discard
+        self._hvr_count += 1
+        # cookied CH restarts the transcript (CH1/HVR excluded, RFC 6347)
+        self._session_hash_input = bytearray()
+        self._reassembly.clear()
+        ch = self._build_client_hello(cookie=cookie)
+        ch_seq = self._send_msg_seq
+        flight = self._flush_handshake([(HT_CLIENT_HELLO, ch, False)])
+        # the server's accepting flight answers at OUR hello's msg_seq
+        self._recv_next_seq = ch_seq
+        self._last_flight = flight
+        return flight
+
     def _client_process(self, msg_type: int, body: bytes, msg_seq: int) -> list:
         if msg_type == HT_HELLO_VERIFY_REQUEST:
-            cookie_len = body[2]
-            cookie = body[3 : 3 + cookie_len]
-            # CH2 restarts the transcript (CH1/HVR excluded per RFC 6347)
-            self._session_hash_input = bytearray()
-            ch = self._build_client_hello(cookie=cookie)
-            flight = self._flush_handshake([(HT_CLIENT_HELLO, ch, False)])
-            self._last_flight = flight
-            return flight
+            # real HVRs are intercepted statelessly pre-drain; one that gets
+            # here is post-ServerHello, fragmented, or mid-key-exchange —
+            # a spoof in every case (advisor r4 + code review r5)
+            raise _Unexpected("unexpected HelloVerifyRequest")
         if msg_type == HT_SERVER_HELLO:
+            if self._state != "WAIT_SH" or self._server_random:
+                raise _Unexpected("repeated/unexpected ServerHello")
             self._record_version = DTLS_12
             self._transcribe(msg_type, body, msg_seq)
             self._server_random = body[2:34]
@@ -929,10 +1206,27 @@ class DtlsEndpoint:
                 self.srtp_profile = chosen
             return []
         if msg_type == HT_CERTIFICATE:
+            # server-flight ordering + repeat guards (code review r5): each
+            # flight-4 message is legitimate exactly once, after ServerHello
+            # and before the client's final flight goes out — anything else
+            # is a spoof/replay whose processing would pollute the
+            # transcript or overwrite negotiated state
+            if (
+                self._state != "WAIT_SH"
+                or not self._server_random
+                or self.peer_cert_der is not None
+            ):
+                raise _Unexpected("unexpected/repeated server Certificate")
             self._transcribe(msg_type, body, msg_seq)
             self._parse_peer_certificate(body)
             return []
         if msg_type == HT_SERVER_KEY_EXCHANGE:
+            if (
+                self._state != "WAIT_SH"
+                or self.peer_cert_der is None
+                or self._peer_key_share is not None
+            ):
+                raise _Unexpected("unexpected/repeated ServerKeyExchange")
             self._transcribe(msg_type, body, msg_seq)
             if body[0] != 3:
                 raise DtlsError("only named_curve ECDHE supported")
@@ -955,13 +1249,28 @@ class DtlsEndpoint:
             self._peer_key_share = point
             return []
         if msg_type == HT_CERTIFICATE_REQUEST:
+            if (
+                self._state != "WAIT_SH"
+                or not self._server_random
+                or self._peer_wants_cert
+            ):
+                raise _Unexpected("unexpected/repeated CertificateRequest")
             self._transcribe(msg_type, body, msg_seq)
             self._peer_wants_cert = True
             return []
         if msg_type == HT_SERVER_HELLO_DONE:
+            # repeat guard matters here more than anywhere: re-running
+            # _client_final_flight would regenerate the ECDH key and fork
+            # the transcript — an unrecoverable wedge from an EMPTY spoofed
+            # message (code review r5)
+            if self._state != "WAIT_SH" or self._peer_key_share is None:
+                raise _Unexpected("unexpected/repeated ServerHelloDone")
             self._transcribe(msg_type, body, msg_seq)
             return self._client_final_flight()
         if msg_type == HT_FINISHED:
+            if self._state != "WAIT_SERVER_FINISHED" or self._recv_epoch == 0:
+                # same epoch gate as the server side: Finished rides epoch 1
+                raise _Unexpected("unexpected/plaintext server Finished")
             self._derive_keys_if_needed()
             expect = p_sha256(
                 self._master_secret,
@@ -975,7 +1284,11 @@ class DtlsEndpoint:
             self.established = True
             self._state = "ESTABLISHED"
             return []
-        return []
+        # same rationale as the server-side fall-through: never silently
+        # consume a msg_seq for a message no state expects
+        raise _Unexpected(
+            f"handshake type {msg_type} in client state {self._state}"
+        )
 
     def _client_final_flight(self) -> list:
         msgs = []

@@ -105,7 +105,7 @@ class SecureMediaSession:
                 self.peer_addr = self.ice.nominated_addr
         elif kind == "dtls":
             was_established = self.dtls.established
-            for d in self.dtls.handle_datagram(datagram):
+            for d in self.dtls.handle_datagram(datagram, addr):
                 out.append((d, addr))
             if self.dtls.established:
                 self.peer_addr = self.peer_addr or addr

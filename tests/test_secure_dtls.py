@@ -381,8 +381,12 @@ class TestInMemoryHandshake:
 
         client._flush_handshake = no_cv
         run_handshake(server, client)
+        # r5: the CCS state gate (spoofed-CCS immunity) means a CV-less
+        # client now STALLS pre-epoch-1 instead of drawing a fatal alert —
+        # either way it must never authenticate
         assert not server.established
-        assert "CertificateVerify" in (server.failed or "")
+        assert server._cert_verify_ok is False
+        assert server._state == "WAIT_CLIENT_FLIGHT"
 
     def test_declined_certificate_with_pin_fails(self):
         """A peer that answers the CertificateRequest with an EMPTY
@@ -637,3 +641,515 @@ def test_duplicated_datagrams_harmless():
     run_handshake(server, client, duplicate=True)
     assert server.established and client.established
     assert server.failed is None and client.failed is None
+
+# ----------------------------------------------------------------------
+# Advisor r4 hardening: client-auth enforcement, mid-flight plaintext
+# spoof immunity, path-bound HVR cookies
+# ----------------------------------------------------------------------
+
+import struct as _struct
+
+
+def _rewrap_hs(dgram: bytes, msg_seq: int) -> bytes:
+    """Take a single plaintext handshake record and re-number its handshake
+    msg_seq (and record seq) — an off-path attacker impersonating the next
+    in-window handshake message with bytes it observed earlier."""
+    hdr, payload = bytearray(dgram[:13]), bytearray(dgram[13:])
+    _struct.pack_into("!H", payload, 4, msg_seq)
+    hdr[5:11] = (1000 + msg_seq).to_bytes(6, "big")
+    return bytes(hdr) + bytes(payload)
+
+
+def _plain_hs_record(hs_type: int, body: bytes, msg_seq: int) -> bytes:
+    """Forge a plaintext epoch-0 handshake record from nothing — the
+    cheapest datagram an off-path attacker can aim at the port."""
+    hs = (
+        _struct.pack("!B", hs_type)
+        + len(body).to_bytes(3, "big")
+        + _struct.pack("!H", msg_seq)
+        + (0).to_bytes(3, "big")
+        + len(body).to_bytes(3, "big")
+        + body
+    )
+    rec = (
+        _struct.pack("!BH", 22, 0xFEFF)
+        + _struct.pack("!H", 0)
+        + (5000 + msg_seq).to_bytes(6, "big")
+        + _struct.pack("!H", len(hs))
+        + hs
+    )
+    return rec
+
+
+class TestAdvisorR4Hardening:
+    def test_client_omitting_certificate_cannot_authenticate(self):
+        """Advisor r4 HIGH: a client that simply never sends its Certificate
+        (so no CertificateVerify is 'owed') must not complete a handshake
+        whose SDP pinned an identity — pre-fix this established."""
+        from ai_rtc_agent_tpu.server.secure import dtls as D
+
+        scert, ccert = generate_certificate(), generate_certificate()
+        server = DtlsEndpoint(
+            "server", scert, request_client_cert=True,
+            verify_fingerprint=ccert.fingerprint,
+        )
+        client = DtlsEndpoint("client", ccert, verify_fingerprint=scert.fingerprint)
+        orig = client._flush_handshake
+
+        def no_cert(msgs, _orig=orig):
+            kept = [
+                m for m in msgs
+                if m[0] not in (D.HT_CERTIFICATE, D.HT_CERTIFICATE_VERIFY)
+            ]
+            return _orig(kept)
+
+        client._flush_handshake = no_cert
+        run_handshake(server, client)
+        assert not server.established
+        # the CKE-before-required-Certificate guard silently discards, so
+        # the server must still be parked waiting for a legitimate flight
+        assert server._state == "WAIT_CLIENT_FLIGHT"
+
+    def test_certificate_replayed_after_cke_cannot_authenticate(self):
+        """Advisor r4 HIGH: Certificate smuggled AFTER ClientKeyExchange
+        (dodging the CertificateVerify it owes) must not authenticate even
+        though the replayed cert matches the pinned fingerprint."""
+        from ai_rtc_agent_tpu.server.secure import dtls as D
+
+        scert, ccert = generate_certificate(), generate_certificate()
+        server = DtlsEndpoint(
+            "server", scert, request_client_cert=True,
+            verify_fingerprint=ccert.fingerprint,
+        )
+        client = DtlsEndpoint("client", ccert, verify_fingerprint=scert.fingerprint)
+        orig = client._flush_handshake
+
+        def reorder_no_cv(msgs, _orig=orig):
+            certs = [m for m in msgs if m[0] == D.HT_CERTIFICATE]
+            ckes = [m for m in msgs if m[0] == D.HT_CLIENT_KEY_EXCHANGE]
+            if certs and ckes:
+                msgs = ckes + certs
+            else:
+                msgs = [m for m in msgs if m[0] != D.HT_CERTIFICATE_VERIFY]
+            return _orig(msgs)
+
+        client._flush_handshake = reorder_no_cv
+        run_handshake(server, client)
+        assert not server.established
+        assert server._state == "WAIT_CLIENT_FLIGHT"
+
+    def test_spoofed_client_hello_mid_flight_harmless(self):
+        """Advisor r4 MEDIUM: one spoofed plaintext ClientHello with an
+        in-window msg_seq, landing while the server waits for the client
+        flight, must not wedge the handshake (pre-fix it re-entered the
+        hello logic, consumed a msg_seq and overwrote _last_flight)."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        assert server._state == "WAIT_CLIENT_FLIGHT"
+        seq_before = server._recv_next_seq
+        flight_before = list(server._last_flight)
+        # blind off-path spoof: a hello whose cookie cannot match (a
+        # replayed valid-cookie hello instead triggers the documented
+        # lockstep-restart path — see the HVR-restart test)
+        other = DtlsEndpoint("client", generate_certificate())
+        (blind,) = other.start()
+        spoof = _rewrap_hs(blind, server._recv_next_seq)
+        assert server.handle_datagram(spoof) == []
+        assert server._state == "WAIT_CLIENT_FLIGHT"
+        assert server._recv_next_seq == seq_before
+        assert server._last_flight == flight_before
+        # and the real handshake still completes
+        outs = []
+        for d in client.handle_datagram(flight4):
+            outs.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in outs:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_spoofed_hvr_mid_flight_harmless(self):
+        """Advisor r4 MEDIUM (client side): a spoofed HelloVerifyRequest
+        after the real ServerHello must not reset the transcript or emit a
+        fresh ClientHello."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        final = client.handle_datagram(flight4)
+        transcript_before = bytes(client._session_hash_input)
+        spoof = _rewrap_hs(hvr, client._recv_next_seq)
+        assert client.handle_datagram(spoof) == []
+        assert bytes(client._session_hash_input) == transcript_before
+        outs = []
+        for d in final:
+            outs.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in outs:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_cookie_bound_to_source_address(self):
+        """Advisor r4 LOW: a cookie minted for one source address must not
+        validate a ClientHello replayed from a spoofed source — the server
+        answers with another HVR (small), never the ~1.5 KB cert flight."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        addr_a = ("198.51.100.7", 40000)
+        addr_b = ("203.0.113.9", 40000)
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1, addr_a)
+        (ch2,) = client.handle_datagram(hvr)
+        out = server.handle_datagram(ch2, addr_b)  # spoofed source
+        # cookie minted for A fails from B: the reply is one HVR (smaller
+        # than the request — no amplification) and nothing is consumed
+        assert len(out) == 1 and out[0][13] == 3
+        assert server._state == "WAIT_CH2"
+        # the same CH2 from the real address still completes the exchange
+        out = server.handle_datagram(ch2, addr_a)
+        assert len(out) >= 1 and out[0][13] == 2  # ServerHello flight
+        assert server._state == "WAIT_CLIENT_FLIGHT"
+
+    def test_handshake_completes_with_consistent_address(self):
+        """Positive control for the path-bound cookie: the same source
+        address end-to-end still completes (and without any address the
+        binding degrades to client_random-only, covered by every other
+        test in this file)."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        addr = ("198.51.100.7", 40000)
+        inflight = client.start()
+        for _ in range(50):
+            if server.established and client.established:
+                break
+            back = []
+            for d in inflight:
+                back.extend(server.handle_datagram(d, addr))
+            inflight = []
+            for d in back:
+                inflight.extend(client.handle_datagram(d))
+        assert server.established and client.established
+        assert (
+            server.export_srtp_keying_material()
+            == client.export_srtp_keying_material()
+        )
+
+    def test_spoofed_hvr_between_ch2_and_serverhello_recovers(self):
+        """Code review r5: the CH2→ServerHello window — a replayed HVR
+        there used to reset the transcript and turn the real server flight
+        into a fatal SKE signature failure.  With the stateless hello
+        phase it now costs one benign restart round and the handshake
+        still completes."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        # client sits between CH2 and the (not yet delivered) ServerHello;
+        # a replayed HVR restarts its hello — both sides re-lockstep
+        spoof = _rewrap_hs(hvr, client._recv_next_seq)
+        inflight = client.handle_datagram(spoof)
+        assert client.failed is None
+        for _ in range(30):
+            if server.established and client.established:
+                break
+            back = []
+            for d in inflight:
+                back.extend(server.handle_datagram(d))
+            inflight = []
+            for d in back:
+                inflight.extend(client.handle_datagram(d))
+        assert server.established, server.failed
+        assert client.established, client.failed
+
+    def test_empty_certificate_without_pin_fails_fatally(self):
+        """Code review r5: a spec-legal empty certificate list answering a
+        CertificateRequest must produce a FATAL alert when auth is
+        required, not a silent retransmit livelock."""
+        from ai_rtc_agent_tpu.server.secure import dtls as D
+
+        scert, ccert = generate_certificate(), generate_certificate()
+        server = DtlsEndpoint("server", scert, request_client_cert=True)
+        client = DtlsEndpoint("client", ccert)
+        orig = client._flush_handshake
+
+        def empty_cert(msgs, _orig=orig):
+            out = []
+            for t, b, e in msgs:
+                if t == D.HT_CERTIFICATE:
+                    b = (0).to_bytes(3, "big")
+                if t == D.HT_CERTIFICATE_VERIFY:
+                    continue
+                out.append((t, b, e))
+            return _orig(out)
+
+        client._flush_handshake = empty_cert
+        run_handshake(server, client)
+        assert not server.established
+        assert "empty certificate list" in (server.failed or "")
+
+    def test_spoofed_shd_replay_does_not_refork_final_flight(self):
+        """Code review r5: an EMPTY spoofed ServerHelloDone after the client
+        already sent its final flight must not re-run _client_final_flight
+        (which would regenerate the ECDH key and fork the transcript)."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        final = client.handle_datagram(flight4)
+        assert client._state == "WAIT_SERVER_FINISHED"
+        shd = _plain_hs_record(14, b"", client._recv_next_seq)
+        key_before = client._pre_master
+        assert client.handle_datagram(shd) == []
+        assert client._pre_master == key_before
+        outs = []
+        for d in final:
+            outs.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in outs:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_replayed_flight4_record_harmless(self):
+        """Code review r5: the server's own flight-4 Certificate replayed
+        with a bumped msg_seq after the client processed the flight must be
+        discarded (repeat guard), not re-transcribed."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        final = client.handle_datagram(flight4)
+        # pull the Certificate record (hs type 11) out of flight 4
+        cert_rec = None
+        off = 0
+        while off + 13 <= len(flight4):
+            (rlen,) = _struct.unpack_from("!H", flight4, off + 11)
+            rec = flight4[off : off + 13 + rlen]
+            if rec[0] == 22 and rec[13] == 11:
+                cert_rec = rec
+            off += 13 + rlen
+        assert cert_rec is not None
+        transcript_before = bytes(client._session_hash_input)
+        spoof = _rewrap_hs(cert_rec, client._recv_next_seq)
+        assert client.handle_datagram(spoof) == []
+        assert bytes(client._session_hash_input) == transcript_before
+        outs = []
+        for d in final:
+            outs.extend(server.handle_datagram(d))
+        for d in outs:
+            client.handle_datagram(d)
+        assert server.established and client.established
+
+    def test_unknown_handshake_type_does_not_consume_msg_seq(self):
+        """Code review r5: a handshake message matching no state branch must
+        REWIND the msg_seq cursor — silently consuming it would turn the
+        real peer's next message into a permanent duplicate (livelock)."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        # server sits in WAIT_CH2 expecting the real CH2 at this msg_seq
+        seq = server._recv_next_seq
+        spoof = _plain_hs_record(99, b"junk", seq)
+        assert server.handle_datagram(spoof) == []
+        assert server._recv_next_seq == seq
+        (flight4,) = server.handle_datagram(ch2)  # real CH2 still lands
+        outs = []
+        for d in client.handle_datagram(flight4):
+            outs.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in outs:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_malformed_hvr_spoof_does_not_burn_real_hvr(self):
+        """Code review r5 (pass 3): a malformed empty-body HVR spoofed at
+        msg_seq 0 must rewind _hvr_seen, or the real server HVR at that
+        seq is dropped forever (silent permanent wedge)."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        assert client.handle_datagram(_plain_hs_record(3, b"", 0)) == []
+        assert client._hvr_count == 0
+        (hvr,) = server.handle_datagram(ch1)
+        outs = client.handle_datagram(hvr)  # real HVR must still work
+        assert len(outs) == 1  # CH2 went out
+        (flight4,) = server.handle_datagram(outs[0])
+        back = []
+        for d in client.handle_datagram(flight4):
+            back.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in back:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_spoofed_cookieless_ch_in_wait_ch2_rewound(self):
+        """Code review r5 (pass 3): a cookie-less ClientHello spoofed into
+        the WAIT_CH2 window must not consume the real CH2's msg_seq or
+        overwrite _last_flight with an attacker-addressed HVR."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        assert server._state == "WAIT_CH2"
+        seq = server._recv_next_seq
+        # forge a fresh cookie-less CH at the real CH2's msg_seq
+        other = DtlsEndpoint("client", generate_certificate())
+        (spoof_src,) = other.start()
+        spoof = _rewrap_hs(spoof_src, seq)
+        flight_before = list(server._last_flight)
+        out = server.handle_datagram(spoof)
+        # stateless HVR reply; NOTHING of the association is consumed
+        assert len(out) == 1 and out[0][13] == 3
+        assert server._recv_next_seq == seq
+        assert server._last_flight == flight_before
+        (flight4,) = server.handle_datagram(ch2)  # real CH2 still lands
+        back = []
+        for d in client.handle_datagram(flight4):
+            back.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in back:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_truncated_serverhello_spoof_rewinds_server_random(self):
+        """Code review r5 (pass 3): a truncated spoofed ServerHello must
+        rewind _server_random/_record_version, or the real server flight
+        trips the repeat guard forever."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        spoof = _plain_hs_record(2, os.urandom(34), client._recv_next_seq)
+        assert client.handle_datagram(spoof) == []
+        assert client._server_random == b""
+        back = []
+        for d in client.handle_datagram(flight4):  # real flight still lands
+            back.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in back:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_spoofed_plaintext_finished_harmless_both_roles(self):
+        """Code review r5 (pass 4): a forged epoch-0 Finished must be
+        rewound-and-dropped in both roles — a legitimate Finished only ever
+        arrives encrypted on epoch 1, after the peer's CCS."""
+        scert, ccert = generate_certificate(), generate_certificate()
+        server = DtlsEndpoint(
+            "server", scert, request_client_cert=True,
+            verify_fingerprint=ccert.fingerprint,
+        )
+        client = DtlsEndpoint("client", ccert, verify_fingerprint=scert.fingerprint)
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        # server in WAIT_CLIENT_FLIGHT: empty spoofed Finished (the 0-byte
+        # forgery that used to trip the fatal auth check)
+        assert server.handle_datagram(
+            _plain_hs_record(20, b"", server._recv_next_seq)
+        ) == []
+        assert server.failed is None
+        final = client.handle_datagram(flight4)
+        # client in WAIT_SERVER_FINISHED: garbage spoofed plaintext Finished
+        assert client.handle_datagram(
+            _plain_hs_record(20, os.urandom(12), client._recv_next_seq)
+        ) == []
+        assert client.failed is None
+        outs = []
+        for d in final:
+            outs.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in outs:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_malformed_cv_spoof_discarded_real_cv_still_lands(self):
+        """Code review r5 (pass 4): a structurally-broken CertificateVerify
+        (empty body / unknown alg) is a discardable forgery; only a failed
+        SIGNATURE check may kill the association."""
+        scert, ccert = generate_certificate(), generate_certificate()
+        server = DtlsEndpoint(
+            "server", scert, request_client_cert=True,
+            verify_fingerprint=ccert.fingerprint,
+        )
+        client = DtlsEndpoint("client", ccert, verify_fingerprint=scert.fingerprint)
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        (flight4,) = server.handle_datagram(ch2)
+        final = client.handle_datagram(flight4)
+        # deliver Certificate+CKE, hold back the real CV
+        server.handle_datagram(final[0])
+        assert server._peer_key_share is not None
+        assert server.handle_datagram(
+            _plain_hs_record(15, b"", server._recv_next_seq)
+        ) == []
+        assert server.failed is None
+        outs = []
+        for d in final[1:]:
+            outs.extend(server.handle_datagram(d))
+        assert server.established, server.failed
+        for d in outs:
+            client.handle_datagram(d)
+        assert client.established, client.failed
+
+    def test_stale_seq_dup_from_wrong_address_gets_no_retransmit(self):
+        """Code review r5 (pass 4): the duplicate-triggered flight resend is
+        address-gated — a 25-byte stale-seq forgery from a spoofed source
+        must not extract the ~1.5 KB flight (amplification)."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        addr_a = ("198.51.100.7", 40000)
+        addr_b = ("203.0.113.9", 666)
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1, addr_a)
+        (ch2,) = client.handle_datagram(hvr)
+        flight4 = server.handle_datagram(ch2, addr_a)
+        assert flight4
+        stale = _plain_hs_record(16, b"x", 0)  # stale CKE: long consumed
+        assert server.handle_datagram(stale, addr_b) == []
+        # the real peer's address still gets the recovery resend
+        assert server.handle_datagram(stale, addr_a) == flight4
+
+    def test_hvr_restart_budget_fails_loudly(self):
+        """Code review r5 (pass 6): exhausting the HVR restart budget must
+        set `failed` (signaling can re-offer) — never a silent livelock."""
+        client = DtlsEndpoint("client", generate_certificate())
+        client.start()
+        for i in range(10):
+            bogus = _plain_hs_record(3, b"\xfe\xff" + b"\x10" + os.urandom(16), i)
+            client.handle_datagram(bogus)
+            if client.failed:
+                break
+        assert client.failed is not None
+        assert "restart budget" in client.failed
+
+    def test_replayed_accepted_ch_datagram_not_amplified(self):
+        """Code review r5 (pass 6): N copies of the accepted CH2 packed in
+        one datagram extract at most ONE flight resend."""
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        (ch1,) = client.start()
+        (hvr,) = server.handle_datagram(ch1)
+        (ch2,) = client.handle_datagram(hvr)
+        flight4 = server.handle_datagram(ch2)
+        assert flight4
+        replay = ch2 * 10  # 10 records in one datagram
+        out = server.handle_datagram(replay)
+        assert sum(len(d) for d in out) <= sum(len(d) for d in flight4)
