@@ -259,13 +259,15 @@ def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4,
 
 def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                           active=None, pipeline_depth=None, unet_cache=None):
-    """Most recent committed TPU measurement for ``metric`` from
-    PERF_LOG.jsonl (appended + git-committed by scripts/tpu_watch.sh the
-    moment a tunnel claim succeeds).  Used ONLY when the accelerator is
-    unreachable at bench time; the emitted line is clearly labeled
-    ``live: false`` with the original ``recorded_at`` timestamp, so a flaky
-    tunnel at round end cannot void a real number captured mid-round
-    (rounds 1-2 both lost their windows this way)."""
+    """Most recent committed measurement for ``metric`` from PERF_LOG.jsonl
+    (appended + git-committed by scripts/tpu_watch.sh the moment a tunnel
+    claim succeeds, or banked manually with a cpu label).  Used ONLY when
+    the accelerator is unreachable at bench time; the emitted line is
+    clearly labeled ``live: false`` with the original ``recorded_at``
+    timestamp and its own ``backend``, so a flaky tunnel at round end
+    cannot void a real number captured mid-round (rounds 1-2 both lost
+    their windows this way).  TPU entries always win; a CPU entry is the
+    last-resort tier, replayed only when no TPU number exists."""
     import os
 
     path = os.getenv("PERF_LOG_PATH") or os.path.join(
@@ -273,9 +275,11 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
     )
     # graph-variant keys: a safe-path number (attn_impl=xla, no fused
     # epilogue) must not stand in for the TPU-default pallas config or vice
-    # versa.  Replay candidates are always backend=="tpu", so the requested
-    # variant resolves via the shared jax-free resolvers bound to "tpu"
-    # (this path runs precisely when the backend is unreachable).
+    # versa.  Variant preference applies to the TPU tiers; the last-resort
+    # CPU tier ignores it (a CPU entry is already a different beast and
+    # carries its own labels).  The requested variant resolves via the
+    # shared jax-free resolvers bound to "tpu" (this path runs precisely
+    # when the backend is unreachable).
     from ai_rtc_agent_tpu.utils.env import (
         attn_impl_default,
         fused_epilogue_default,
@@ -283,7 +287,7 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
 
     want_attn = attn_impl_default("tpu")
     want_fused = fused_epilogue_default("tpu")
-    best_same_variant = best_any_variant = None
+    best_same_variant = best_any_variant = best_cpu = None
     try:
         with open(path) as f:
             for line in f:
@@ -291,20 +295,30 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
                     d = json.loads(line)
                 except ValueError:
                     continue
-                if not (
+                # same-config only: an fbs-batched, w8-quantized or
+                # different-occupancy entry must not stand in for the plain
+                # config (or vice versa) — one predicate for every tier
+                same_config = (
                     d.get("metric") == metric
-                    and d.get("backend") == "tpu"
                     and d.get("value", 0) > 0
-                    # same-config only: an fbs-batched, w8-quantized or
-                    # different-occupancy entry must not stand in for the
-                    # plain config (or vice versa)
                     and d.get("fbs") == fbs
                     and d.get("quant") == quant
                     and d.get("peers") == peers
                     and d.get("active") == active
                     and d.get("pipeline_depth") == pipeline_depth
                     and d.get("unet_cache") == unet_cache
-                ):
+                )
+                if not same_config:
+                    continue
+                if d.get("backend") == "cpu":
+                    # last-resort tier: a committed CPU-backend measurement
+                    # (clearly labeled backend:"cpu" in the line itself) is
+                    # still a real number — replaying it beats emitting
+                    # value 0.0 with an error object when the tunnel is down
+                    # (verdict r4 next-round #3)
+                    best_cpu = d
+                    continue
+                if d.get("backend") != "tpu":
                     continue
                 best_any_variant = d
                 # entries predating the variant fields count as same-variant
@@ -318,7 +332,7 @@ def _replay_from_perf_log(metric: str, fbs=None, quant=None, peers=None,
     # a different-variant entry (e.g. only the safe xla/unfused path banked
     # before the tunnel died) is still honest evidence: the line carries its
     # own attn_impl/fused_epilogue labels — far better than value 0.0
-    return best_same_variant or best_any_variant
+    return best_same_variant or best_any_variant or best_cpu
 
 
 def _maybe_replay(result: dict) -> dict:

@@ -88,6 +88,9 @@ def test_contract_line_happy_path_tiny():
     d = _contract_line(r.stdout)
     assert d["metric"] == "e2e_fps_tiny64_singlechip"
     assert d["value"] > 0
+    # live, not replayed: the repo PERF_LOG now contains a matching CPU
+    # entry, and a silently-replaying broken pipeline must still fail here
+    assert d["live"] is True
     assert "stage_ms" in d and set(d["stage_ms"]) == {
         "upload", "compute", "readback"
     }
@@ -326,3 +329,34 @@ note() { :; }
         capture_output=True, text=True, timeout=30,
     )
     assert "RESUMED" not in out.stdout
+
+
+def test_unreachable_backend_falls_back_to_cpu_entry(tmp_path):
+    """VERDICT r4 item 3: with NO TPU entry banked, a committed CPU-backend
+    measurement must replay (clearly labeled backend:"cpu", live:false)
+    rather than emitting value 0.0 with an error object — and a TPU entry,
+    when present, must always win over it."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    cpu_entry = {
+        "metric": "e2e_fps_turbo512_singlechip", "value": 0.9, "unit": "fps",
+        "vs_baseline": 0.03, "backend": "cpu", "label": "turbo512_cpu",
+        "recorded_at": "2026-08-01T05:00:00+00:00",
+    }
+    log.write_text(json.dumps(cpu_entry) + "\n")
+    r = _run_bench(
+        {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": str(log)}
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert d["value"] == 0.9 and d["backend"] == "cpu"
+    assert d["live"] is False
+    assert "unreachable" in d["live_attempt"]["error"]
+    # TPU tier still wins when present
+    tpu_entry = dict(cpu_entry, backend="tpu", value=31.4, vs_baseline=1.047)
+    log.write_text(json.dumps(cpu_entry) + "\n" + json.dumps(tpu_entry) + "\n")
+    r = _run_bench(
+        {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": str(log)}
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    d = _contract_line(r.stdout)
+    assert d["value"] == 31.4 and d["backend"] == "tpu"
