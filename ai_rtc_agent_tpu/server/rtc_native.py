@@ -216,6 +216,8 @@ class NativeRtpPeerConnection:
         self._sdp_offer = None  # parsed real-SDP offer (server/sdp.py)
         self._h264_pt: int | None = None  # offered H264 payload type
         self._secure_session = None  # secure.SecureMediaSession (DTLS tier)
+        self._sctp = None  # secure.sctp.SctpAssociation (datachannels)
+        self._sctp_timer_task = None
         self.server_port: int | None = None
         self.pc_id = str(uuid.uuid4())
 
@@ -265,16 +267,21 @@ class NativeRtpPeerConnection:
             offer = sdp.parse(desc.sdp)
             self._sdp_offer = offer
             video = offer.video()
-            if video is None:
-                raise ValueError("offer has no video m= section")
-            h264 = video.h264_payloads()
-            if h264:
-                self._h264_pt = h264[0]
-            self._client_addr = sdp.client_media_addr(offer)
-            # the client sends us media unless its offer is recvonly (WHEP)
-            self._payload = {
-                "video": video.direction in ("sendonly", "sendrecv"),
-            }
+            if video is None and offer.application() is None:
+                raise ValueError("offer has no video or datachannel m= section")
+            if video is not None:
+                h264 = video.h264_payloads()
+                if h264:
+                    self._h264_pt = h264[0]
+                self._client_addr = sdp.client_media_addr(offer)
+                # the client sends us media unless its offer is recvonly
+                self._payload = {
+                    "video": video.direction in ("sendonly", "sendrecv"),
+                }
+            else:
+                # datachannel-only offer: no media, but the socket still
+                # carries ICE + DTLS + SCTP
+                self._payload = {"video": False}
             payload = self._payload
             if offer.is_secure():
                 # browser-shaped offer: ICE-lite + DTLS-SRTP on ONE socket
@@ -296,6 +303,13 @@ class NativeRtpPeerConnection:
                     remote_ufrag=offer.ice_ufrag,
                     stats=self._provider.stats,
                 )
+                app_section = offer.application()
+                if app_section is not None:
+                    # browser offered a datachannel (m=application): attach
+                    # an SCTP association to the DTLS session so
+                    # createDataChannel("config") reaches the agent's
+                    # runtime-config handler (reference agent.py:154-168)
+                    self._attach_sctp(app_section)
         else:
             try:
                 payload = json.loads(desc.sdp)
@@ -385,6 +399,47 @@ class NativeRtpPeerConnection:
         self.iceConnectionState = "completed"
         await self._emit("connectionstatechange")
 
+    def _attach_sctp(self, app_section):
+        from .secure.sctp import SctpAssociation
+
+        loop = asyncio.get_event_loop()
+
+        def dispatch(fn, *args):
+            r = fn(*args)
+            if asyncio.iscoroutine(r):
+                asyncio.ensure_future(r)
+
+        def on_channel(channel):
+            # DCEP open accepted — surface it exactly like aiortc does
+            asyncio.ensure_future(self._emit("datachannel", channel))
+
+        self._sctp = SctpAssociation(
+            "server",
+            remote_port=app_section.sctp_port(),
+            on_channel=on_channel,
+            dispatch=dispatch,
+        )
+        self._sctp.transmit = self._sctp_transmit
+        self._secure_session.sctp = self._sctp
+        self._sctp_timer_task = loop.create_task(self._sctp_timer())
+
+    def _sctp_transmit(self, pkt: bytes) -> None:
+        if self._recv_transport is None or self._secure_session is None:
+            return
+        for d, a in self._secure_session.sctp_transmit(pkt):
+            self._recv_transport.sendto(d, a)
+
+    async def _sctp_timer(self):
+        """Drive the association's retransmission clock (sans-IO core —
+        the timer lives here, like the DTLS retransmit timer)."""
+        try:
+            while self._sctp is not None and not self._sctp.closed:
+                await asyncio.sleep(0.5)
+                for pkt in self._sctp.retransmit_due():
+                    self._sctp_transmit(pkt)
+        except asyncio.CancelledError:
+            pass
+
     def _force_sink_keyframe(self):
         """RTCP-PLI handler: the viewer dropped a frame — next encode is IDR."""
         if self._sink is not None:
@@ -451,6 +506,13 @@ class NativeRtpPeerConnection:
         self.connectionState = "closed"
         for t in self._sender_tasks:
             t.cancel()
+        if self._sctp_timer_task is not None:
+            self._sctp_timer_task.cancel()
+        if self._sctp is not None:
+            # tell the peer's stack the channels are gone (one ABORT) —
+            # otherwise its datachannels dangle until its own RTX budget
+            for pkt in self._sctp.close():
+                self._sctp_transmit(pkt)
         if self.in_track:
             self.in_track.stop()
             self.in_track.close()

@@ -15,7 +15,10 @@ This module implements the small, deterministic subset the agent needs:
                             * accepts the first H264 payload (prefers
                               packetization-mode=1), echoing the offered
                               payload type number,
-                            * rejects non-video sections (port 0),
+                            * accepts `m=application ... webrtc-datachannel`
+                              on the secure tier (SCTP datachannels, RFC
+                              8841) and rejects other non-video sections
+                              (port 0),
                             * mirrors a=mid and inverts direction
                               (sendonly -> recvonly etc.),
                             * embeds the host candidate inline
@@ -48,6 +51,16 @@ class MediaSection:
     mid: str | None = None
     connection: str | None = None  # media-level c= address
     attrs: list = field(default_factory=list)  # raw a= lines (verbatim)
+    fmt_tokens: list = field(default_factory=list)  # raw m= fmt column
+
+    def sctp_port(self, default: int = 5000) -> int:
+        for a in self.attrs:
+            if a.startswith("sctp-port:"):
+                try:
+                    return int(a.split(":", 1)[1])
+                except ValueError:
+                    break
+        return default
 
     def h264_payloads(self) -> list:
         """Offered H264 payload types, packetization-mode=1 first (the only
@@ -83,6 +96,13 @@ class SdpOffer:
     def video(self) -> MediaSection | None:
         for m in self.media:
             if m.kind == "video":
+                return m
+        return None
+
+    def application(self) -> MediaSection | None:
+        """The datachannel m= section (RFC 8841), if offered."""
+        for m in self.media:
+            if m.kind == "application" and "SCTP" in m.proto.upper():
                 return m
         return None
 
@@ -135,6 +155,7 @@ def parse(text: str) -> SdpOffer:
                 port=int(parts[1]),
                 proto=parts[2],
                 payloads=[int(p) for p in parts[3:] if p.isdigit()],
+                fmt_tokens=parts[3:],
             )
             media.append(cur)
         elif key == "c":
@@ -219,6 +240,15 @@ def build_answer(
     ]
     if secure is not None:
         lines.append("a=ice-lite")
+    def _accepts_datachannel(m: MediaSection) -> bool:
+        # the datachannel rides SCTP over the SAME DTLS session as media
+        # (RFC 8261 + BUNDLE) — only the secure tier can carry it
+        return (
+            secure is not None
+            and m.kind == "application"
+            and "SCTP" in m.proto.upper()
+        )
+
     if offer.bundle:
         # echo the BUNDLE group for the mids we ACCEPT (RFC 9143 s7.3:
         # rejected m-lines leave the group) — browsers with
@@ -226,14 +256,39 @@ def build_answer(
         accepted = [
             m.mid
             for m in offer.media
-            if m.kind == "video" and m.mid is not None and m.mid in offer.bundle
+            if (m.kind == "video" or _accepts_datachannel(m))
+            and m.mid is not None
+            and m.mid in offer.bundle
         ]
         if accepted:
             lines.append("a=group:BUNDLE " + " ".join(accepted))
     for m in offer.media:
+        if _accepts_datachannel(m):
+            # accepted datachannel section (RFC 8841): same socket as the
+            # media (our demux speaks STUN/DTLS/SRTP on one port), SCTP
+            # inside the DTLS session
+            fmt = " ".join(m.fmt_tokens) or "webrtc-datachannel"
+            lines.append(f"m=application {video_port} {m.proto} {fmt}")
+            lines.append(f"c=IN IP4 {host}")
+            if m.mid is not None:
+                lines.append(f"a=mid:{m.mid}")
+            lines.append(f"a=ice-ufrag:{secure['ice_ufrag']}")
+            lines.append(f"a=ice-pwd:{secure['ice_pwd']}")
+            lines.append(f"a=fingerprint:sha-256 {secure['fingerprint']}")
+            lines.append("a=setup:passive")
+            # OUR listening port (sctp.DEFAULT_SCTP_PORT), not an echo of
+            # the offerer's: the answer's a=sctp-port describes the
+            # answerer, and port-validating stacks check the common header
+            lines.append("a=sctp-port:5000")
+            lines.append("a=max-message-size:65536")
+            lines.append(
+                f"a=candidate:1 1 udp 2130706431 {host} {video_port} typ host"
+            )
+            lines.append("a=end-of-candidates")
+            continue
         if m.kind != "video":
-            # rejected section: port 0, mirror the proto + first payload
-            first = m.payloads[0] if m.payloads else 0
+            # rejected section: port 0, mirror the proto + first fmt token
+            first = m.fmt_tokens[0] if m.fmt_tokens else "0"
             lines.append(f"m={m.kind} 0 {m.proto} {first}")
             if m.mid is not None:
                 lines.append(f"a=mid:{m.mid}")

@@ -80,6 +80,9 @@ class SecureMediaSession:
         self.rx_srtp = None
         self._handshake_done_cb = None
         self.peer_addr: tuple | None = None
+        # optional SCTP association (WebRTC datachannels, RFC 8831): SCTP
+        # packets ride the DTLS session as application data (RFC 8261)
+        self.sctp = None
 
     # ------------------------------------------------------------------
 
@@ -111,6 +114,13 @@ class SecureMediaSession:
                 self.peer_addr = self.peer_addr or addr
                 if not was_established:
                     self._derive_srtp()
+            # DTLS application data = SCTP packets (datachannel plane)
+            msgs = self.dtls.recv_application_data()
+            if msgs and self.sctp is not None:
+                for m in msgs:
+                    for reply in self.sctp.handle_packet(m):
+                        for d in self.dtls.send_application_data(reply):
+                            out.append((d, addr))
         elif kind == "rtp":
             if self.rx_srtp is not None:
                 try:
@@ -167,6 +177,15 @@ class SecureMediaSession:
         if self.tx_srtp is None:
             return None
         return self.tx_srtp.protect_rtcp(packet)
+
+    def sctp_transmit(self, pkt: bytes) -> list:
+        """Wrap one outbound SCTP packet for the wire.
+        -> [(datagram, addr)] (empty until the handshake is done)."""
+        if not self.dtls.established or self.peer_addr is None:
+            return []
+        return [
+            (d, self.peer_addr) for d in self.dtls.send_application_data(pkt)
+        ]
 
     def retransmit(self) -> list:
         """Datagrams to resend if the peer has gone quiet mid-handshake

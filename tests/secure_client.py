@@ -31,14 +31,18 @@ def secure_offer(
     pwd: str = "clientpwd0123456789abc",
     direction: str = "sendrecv",
     pt: int = 102,
+    datachannel: bool = False,
 ) -> str:
     """A Chrome-shaped offer (modeled on tests/fixtures/sdp/
-    browser_whip_offer.sdp) carrying a real client DTLS identity."""
-    return (
+    browser_whip_offer.sdp) carrying a real client DTLS identity.
+    ``datachannel`` adds the m=application section Chrome emits for
+    createDataChannel (RFC 8841)."""
+    bundle = "0 1" if datachannel else "0"
+    sdp = (
         "v=0\r\n"
         "o=- 4611731400430051336 2 IN IP4 127.0.0.1\r\n"
         "s=-\r\nt=0 0\r\n"
-        "a=group:BUNDLE 0\r\n"
+        f"a=group:BUNDLE {bundle}\r\n"
         f"m=video 9 UDP/TLS/RTP/SAVPF {pt}\r\n"
         "c=IN IP4 0.0.0.0\r\n"
         f"a=ice-ufrag:{ufrag}\r\n"
@@ -52,6 +56,19 @@ def secure_offer(
         f"a=fmtp:{pt} level-asymmetry-allowed=1;packetization-mode=1;"
         "profile-level-id=42001f\r\n"
     )
+    if datachannel:
+        sdp += (
+            "m=application 9 UDP/DTLS/SCTP webrtc-datachannel\r\n"
+            "c=IN IP4 0.0.0.0\r\n"
+            f"a=ice-ufrag:{ufrag}\r\n"
+            f"a=ice-pwd:{pwd}\r\n"
+            f"a=fingerprint:sha-256 {fingerprint}\r\n"
+            "a=setup:actpass\r\n"
+            "a=mid:1\r\n"
+            "a=sctp-port:5000\r\n"
+            "a=max-message-size:262144\r\n"
+        )
+    return sdp
 
 
 class SecureTestPeer:
@@ -127,6 +144,58 @@ class SecureTestPeer:
             profile=self.dtls.srtp_profile,
         )
         return self
+
+    def _sctp_tx(self, packets) -> None:
+        for p in packets:
+            for d in self.dtls.send_application_data(p):
+                self.transport.sendto(d, self.server_addr)
+
+    async def open_datachannel(self, label: str = "config", timeout: float = 10.0):
+        """Browser-shaped datachannel open: SCTP association over the
+        established DTLS session, then DCEP OPEN.  Returns the open
+        channel (send via `dc_send`, drain replies via `drain_dc`)."""
+        from ai_rtc_agent_tpu.server.secure.sctp import SctpAssociation
+
+        assert self.dtls is not None and self.dtls.established
+        self.sctp = SctpAssociation("client")
+        self._sctp_tx(self.sctp.start())
+        ch = None
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            if self.sctp.established and ch is None:
+                ch, pkts = self.sctp.open_channel(label)
+                self._sctp_tx(pkts)
+            if ch is not None and ch.readyState == "open":
+                return ch
+            try:
+                data = await asyncio.wait_for(self.q.get(), 1)
+            except asyncio.TimeoutError:
+                self._sctp_tx(self.sctp.retransmit_due())
+                continue
+            for d in self.dtls.handle_datagram(data):
+                self.transport.sendto(d, self.server_addr)
+            for m in self.dtls.recv_application_data():
+                self._sctp_tx(self.sctp.handle_packet(m))
+        raise AssertionError("datachannel open timed out")
+
+    def dc_send(self, channel, message) -> None:
+        self._sctp_tx(channel.send(message))
+
+    async def drain_dc(self, duration: float = 1.0) -> None:
+        """Pump inbound datagrams through DTLS+SCTP for `duration` seconds
+        (channel message handlers fire from inside handle_packet)."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + duration
+        while loop.time() < deadline:
+            try:
+                data = await asyncio.wait_for(self.q.get(), 0.2)
+            except asyncio.TimeoutError:
+                continue
+            for d in self.dtls.handle_datagram(data):
+                self.transport.sendto(d, self.server_addr)
+            for m in self.dtls.recv_application_data():
+                self._sctp_tx(self.sctp.handle_packet(m))
 
     def send_rtp(self, packets):
         for pkt in packets:
