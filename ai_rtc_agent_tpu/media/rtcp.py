@@ -1,0 +1,210 @@
+"""RTCP: sender/receiver reports and NACK loss recovery (native tier).
+
+The reference inherits all RTCP machinery from aiortc (reference
+agent.py:13-20, SURVEY.md L3): periodic sender reports for lip-sync and
+stats, receiver-report parsing, and NACK-driven retransmission.  The
+native tier previously only spoke PLI (media/rtp.py); this module adds
+the rest:
+
+  * make_sr / make_rr — RFC 3550 report packets (SR carries the NTP/RTP
+    timestamp pair receivers use for lip-sync and clock mapping), with a
+    minimal SDES CNAME so the compound is spec-shaped
+  * make_nack — RFC 4585 generic NACK (transport-layer FB, FMT=1) with
+    PID/BLP encoding of the lost sequence numbers
+  * parse_compound — one walk over a compound RTCP datagram yielding
+    every SR/RR/NACK/PLI with its fields, for both the server's inbound
+    path and the tests' client side
+  * RetransmissionCache — ring of recently-sent WIRE packets keyed by RTP
+    seq.  Cached post-protection, so an SRTP retransmission is the
+    original ciphertext (the receiver never saw the seq — its replay
+    window accepts it; re-protecting would need ROC care for nothing)
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from collections import OrderedDict
+
+PT_SR = 200
+PT_RR = 201
+PT_SDES = 202
+PT_RTPFB = 205  # transport-layer feedback (NACK is FMT 1)
+PT_PSFB = 206  # payload-specific feedback (PLI is FMT 1)
+
+NTP_EPOCH_OFFSET = 2208988800  # 1900 -> 1970
+
+
+def _ntp_now(now: float | None = None) -> tuple:
+    t = time.time() if now is None else now
+    sec = int(t) + NTP_EPOCH_OFFSET
+    frac = int((t - int(t)) * (1 << 32)) & 0xFFFFFFFF
+    return sec & 0xFFFFFFFF, frac
+
+
+def _sdes_cname(ssrc: int, cname: bytes = b"tpu-rtc-agent") -> bytes:
+    item = struct.pack("!IBB", ssrc & 0xFFFFFFFF, 1, len(cname)) + cname
+    item += b"\x00"  # item-list END
+    while len(item) % 4:
+        item += b"\x00"  # pad chunk to a 32-bit boundary
+    words = len(item) // 4
+    return struct.pack("!BBH", 0x81, PT_SDES, words) + item
+
+
+def make_sr(
+    ssrc: int,
+    rtp_ts: int,
+    packet_count: int,
+    octet_count: int,
+    now: float | None = None,
+    compound_sdes: bool = True,
+) -> bytes:
+    """Sender report: the NTP↔RTP timestamp pair + send counters."""
+    sec, frac = _ntp_now(now)
+    sr = struct.pack(
+        "!BBHIIIIII",
+        0x80,  # V=2, no report blocks
+        PT_SR,
+        6,  # length in words - 1 (28 bytes body)
+        ssrc & 0xFFFFFFFF,
+        sec,
+        frac,
+        rtp_ts & 0xFFFFFFFF,
+        packet_count & 0xFFFFFFFF,
+        octet_count & 0xFFFFFFFF,
+    )
+    return sr + _sdes_cname(ssrc) if compound_sdes else sr
+
+
+def make_rr(ssrc: int, media_ssrc: int, fraction_lost: int = 0,
+            cumulative_lost: int = 0, highest_seq: int = 0,
+            jitter: int = 0) -> bytes:
+    """Receiver report with one report block (the shape browsers send)."""
+    block = struct.pack(
+        "!IIIIII",
+        media_ssrc & 0xFFFFFFFF,
+        ((fraction_lost & 0xFF) << 24) | (cumulative_lost & 0xFFFFFF),
+        highest_seq & 0xFFFFFFFF,
+        jitter & 0xFFFFFFFF,
+        0,  # LSR
+        0,  # DLSR
+    )
+    return (
+        struct.pack("!BBHI", 0x81, PT_RR, 7, ssrc & 0xFFFFFFFF) + block
+    )
+
+
+def make_nack(sender_ssrc: int, media_ssrc: int, seqs: list) -> bytes:
+    """Generic NACK (RFC 4585 s6.2.1): PID + bitmask of 16 following."""
+    seqs = sorted(set(s & 0xFFFF for s in seqs))
+    fci = b""
+    i = 0
+    while i < len(seqs):
+        pid = seqs[i]
+        blp = 0
+        j = i + 1
+        while j < len(seqs) and 0 < ((seqs[j] - pid) & 0xFFFF) <= 16:
+            blp |= 1 << (((seqs[j] - pid) & 0xFFFF) - 1)
+            j += 1
+        fci += struct.pack("!HH", pid, blp)
+        i = j
+    length = 2 + len(fci) // 4
+    return (
+        struct.pack("!BBH", 0x81, PT_RTPFB, length)
+        + struct.pack("!II", sender_ssrc & 0xFFFFFFFF, media_ssrc & 0xFFFFFFFF)
+        + fci
+    )
+
+
+def parse_compound(data: bytes) -> list:
+    """Walk a compound RTCP datagram -> [dict] (unknown chunks skipped).
+
+    Yields: {"type": "sr", ssrc, ntp_sec, ntp_frac, rtp_ts, packet_count,
+    octet_count} / {"type": "rr", ssrc, blocks: [{ssrc, fraction_lost,
+    cumulative_lost, highest_seq, jitter}]} / {"type": "nack", seqs: [...]}
+    / {"type": "pli"}."""
+    out = []
+    off = 0
+    while off + 8 <= len(data):
+        b0, pt = data[off], data[off + 1]
+        if (b0 >> 6) != 2 or not (200 <= pt <= 206):
+            break
+        (length_words,) = struct.unpack_from("!H", data, off + 2)
+        end = off + (length_words + 1) * 4
+        if end > len(data):
+            break
+        body = data[off + 4 : end]
+        fmt_or_rc = b0 & 0x1F
+        if pt == PT_SR and len(body) >= 24:
+            ssrc, sec, frac, rtp_ts, pc, oc = struct.unpack_from("!IIIIII", body, 0)
+            out.append(
+                {
+                    "type": "sr",
+                    "ssrc": ssrc,
+                    "ntp_sec": sec,
+                    "ntp_frac": frac,
+                    "rtp_ts": rtp_ts,
+                    "packet_count": pc,
+                    "octet_count": oc,
+                }
+            )
+        elif pt == PT_RR and len(body) >= 4:
+            (ssrc,) = struct.unpack_from("!I", body, 0)
+            blocks = []
+            boff = 4
+            for _ in range(fmt_or_rc):
+                if boff + 24 > len(body):
+                    break
+                bssrc, lost, hseq, jit, _lsr, _dlsr = struct.unpack_from(
+                    "!IIIIII", body, boff
+                )
+                blocks.append(
+                    {
+                        "ssrc": bssrc,
+                        "fraction_lost": lost >> 24,
+                        "cumulative_lost": lost & 0xFFFFFF,
+                        "highest_seq": hseq,
+                        "jitter": jit,
+                    }
+                )
+                boff += 24
+            out.append({"type": "rr", "ssrc": ssrc, "blocks": blocks})
+        elif pt == PT_RTPFB and fmt_or_rc == 1 and len(body) >= 8:
+            seqs = []
+            boff = 8
+            while boff + 4 <= len(body):
+                pid, blp = struct.unpack_from("!HH", body, boff)
+                seqs.append(pid)
+                for bit in range(16):
+                    if blp & (1 << bit):
+                        seqs.append((pid + bit + 1) & 0xFFFF)
+                boff += 4
+            out.append({"type": "nack", "seqs": seqs})
+        elif pt == PT_PSFB and fmt_or_rc == 1:
+            out.append({"type": "pli"})
+        off = end
+    return out
+
+
+class RetransmissionCache:
+    """Ring of the last ``size`` sent packets, keyed by RTP seq.  Stores
+    WIRE bytes (post-SRTP) so a NACK answer is a pure resend."""
+
+    def __init__(self, size: int = 512):
+        self.size = size
+        self._d: OrderedDict = OrderedDict()
+
+    def add(self, plain_rtp: bytes, wire: bytes) -> None:
+        if len(plain_rtp) < 4:
+            return
+        seq = (plain_rtp[2] << 8) | plain_rtp[3]
+        self._d[seq] = wire
+        self._d.move_to_end(seq)
+        while len(self._d) > self.size:
+            self._d.popitem(last=False)
+
+    def get(self, seq: int):
+        return self._d.get(seq & 0xFFFF)
+
+    def __len__(self):
+        return len(self._d)

@@ -52,6 +52,93 @@ class SessionDescription:
         self.type = type
 
 
+# the outbound stream identity: one constant feeds the H264Sink/packetizer
+# AND the RTCP sender state, so SRs always describe the actual RTP stream
+OUT_SSRC = 0x5EED
+
+
+class _RtcpState:
+    """Outbound-stream RTCP bookkeeping (VERDICT r4 next-round #5): send
+    counters feeding periodic Sender Reports, the retransmission cache
+    answering NACKs, and receiver-report gauges for /metrics — the
+    machinery the reference inherits from aiortc (reference agent.py:13-20).
+    """
+
+    def __init__(self, stats: FrameStats | None = None, ssrc: int = OUT_SSRC):
+        from ..media.rtcp import RetransmissionCache
+
+        self.ssrc = ssrc
+        self.cache = RetransmissionCache()
+        self.packet_count = 0
+        self.octet_count = 0
+        self.last_rtp_ts = 0
+        self.last_sent_wall = None  # wall clock paired with last_rtp_ts
+        self.stats = stats
+
+    def sent(self, plain_pkt: bytes, wire: bytes) -> None:
+        import time as _t
+
+        self.packet_count += 1
+        self.octet_count += max(0, len(plain_pkt) - 12)
+        if len(plain_pkt) >= 8:
+            self.last_rtp_ts = int.from_bytes(plain_pkt[4:8], "big")
+            self.last_sent_wall = _t.time()
+        self.cache.add(plain_pkt, wire)
+
+    def make_sr(self) -> bytes:
+        from ..media import rtcp
+
+        # RFC 3550 s6.4.1: the NTP and RTP timestamps must denote the SAME
+        # instant — use the wall clock captured when last_rtp_ts was sent,
+        # not now() (a stalled pipeline would otherwise skew the mapping)
+        return rtcp.make_sr(
+            self.ssrc,
+            self.last_rtp_ts,
+            self.packet_count,
+            self.octet_count,
+            now=self.last_sent_wall,
+        )
+
+    def on_rtcp(self, payload: bytes, resend) -> bool:
+        """Handle one inbound compound RTCP datagram.  `resend` transmits a
+        cached WIRE packet.  Returns True when the sender should IDR
+        (PLI, or a NACK for packets that aged out of the cache)."""
+        from ..media import rtcp
+
+        force_idr = False
+        for item in rtcp.parse_compound(payload):
+            if item["type"] == "pli":
+                force_idr = True
+            elif item["type"] == "nack":
+                if self.stats is not None:
+                    self.stats.count("rtcp_nacks")
+                for seq in item["seqs"]:
+                    wire = self.cache.get(seq)
+                    if wire is not None:
+                        resend(wire)
+                        if self.stats is not None:
+                            self.stats.count("rtcp_nack_retransmits")
+                    else:
+                        # aged out of the cache: a keyframe is the only
+                        # recovery that still helps
+                        force_idr = True
+            elif item["type"] == "rr" and item["blocks"]:
+                blk = item["blocks"][0]
+                if self.stats is not None:
+                    self.stats.count("rtcp_rrs")
+                    self.stats.gauge("rr_fraction_lost", blk["fraction_lost"])
+                    self.stats.gauge("rr_jitter", blk["jitter"])
+        return force_idr
+
+
+def _looks_like_rtcp(data: bytes) -> bool:
+    # RFC 5761 s4 demux, same rule as secure/endpoint.py classify(): the
+    # full RTCP PT block is 192-223 (FIR/NACK-legacy 192/193, SR..XR
+    # 200-207) — RTP can't land there (our PTs are 96-127, or 224-255
+    # with the marker bit)
+    return len(data) >= 2 and (data[0] >> 6) == 2 and 192 <= data[1] <= 223
+
+
 class _RtpReceiverProtocol(asyncio.DatagramProtocol):
     """Hands packets to a queue; H.264 decode runs on a worker thread, never
     on the event loop (5-30 ms/frame of software codec would starve every
@@ -65,13 +152,15 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
     PLI_MIN_INTERVAL = 0.25  # s — bound the PLI storm under loss bursts
 
-    def __init__(self, source: H264RingSource | None, on_pli=None, session=None):
+    def __init__(self, source: H264RingSource | None, rtcp_state: _RtcpState,
+                 on_pli=None, session=None):
         """`session`: a secure.SecureMediaSession — when given, this socket
         speaks the full RFC 7983 mux (STUN + DTLS + SRTP/SRTCP) instead of
         plain RTP; `source` may be None for a send-only (WHEP) secure peer
         whose socket still has to answer ICE checks and the handshake."""
         self.source = source
         self.session = session
+        self._rtcp_state = rtcp_state
         self.transport = None
         self._on_pli = on_pli
         self._last_addr = None
@@ -126,6 +215,8 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         addr = self.session.peer_addr
         if wire is None or addr is None:
             return False
+        # cache the CIPHERTEXT: a NACK answer resends it verbatim
+        self._rtcp_state.sent(packet, wire)
         self.transport.sendto(wire, addr)
         return True
 
@@ -137,7 +228,11 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
             for d, a in outs:
                 self.transport.sendto(d, a)
             if kind == "rtcp":
-                if R.is_pli(payload) and self._on_pli is not None:
+                dst = self.session.peer_addr or addr
+                force = self._rtcp_state.on_rtcp(
+                    payload, lambda w: self.transport.sendto(w, dst)
+                )
+                if force and self._on_pli is not None:
                     self._on_pli()
                 return
             if kind != "rtp" or self.source is None:
@@ -146,8 +241,11 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
             self._last_addr = self.session.peer_addr or addr
         else:
             self._last_addr = addr
-            if R.is_pli(data):
-                if self._on_pli is not None:
+            if _looks_like_rtcp(data):
+                force = self._rtcp_state.on_rtcp(
+                    data, lambda w: self.transport.sendto(w, addr)
+                )
+                if force and self._on_pli is not None:
                     self._on_pli()
                 return
         try:
@@ -176,17 +274,26 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
 
 class _PliListenerProtocol(asyncio.DatagramProtocol):
-    """Send-side return channel: RTCP PLI from the viewer -> force an IDR
-    (the PLI/FIR machinery the reference's WebRTC stack handles internally,
+    """Send-side return channel: RTCP from the viewer — PLI forces an IDR,
+    NACKs answer from the retransmission cache, RRs land in /metrics
+    (the machinery the reference's WebRTC stack handles internally,
     SURVEY L3)."""
 
-    def __init__(self, on_pli):
+    def __init__(self, on_pli, rtcp_state: _RtcpState):
         self._on_pli = on_pli
+        self._rtcp_state = rtcp_state
+        self.transport = None
+
+    def connection_made(self, transport):
+        self.transport = transport
 
     def datagram_received(self, data, addr):
-        from ..media import rtp as R
-
-        if R.is_pli(data):
+        if self.transport is None:
+            return
+        force = self._rtcp_state.on_rtcp(
+            data, lambda w: self.transport.sendto(w)
+        )
+        if force:
             self._on_pli()
 
 
@@ -218,6 +325,8 @@ class NativeRtpPeerConnection:
         self._secure_session = None  # secure.SecureMediaSession (DTLS tier)
         self._sctp = None  # secure.sctp.SctpAssociation (datachannels)
         self._sctp_timer_task = None
+        self._rtcp_state = _RtcpState(stats=provider.stats)
+        self._sr_task = None
         self.server_port: int | None = None
         self.pc_id = str(uuid.uuid4())
 
@@ -340,6 +449,7 @@ class NativeRtpPeerConnection:
                 await loop.create_datagram_endpoint(
                     lambda: _RtpReceiverProtocol(
                         self.in_track,
+                        self._rtcp_state,
                         on_pli=self._force_sink_keyframe,
                         session=self._secure_session,
                     ),
@@ -452,7 +562,9 @@ class NativeRtpPeerConnection:
         # the send socket doubles as the PLI return channel: the only
         # upstream traffic we understand is "please keyframe"
         self._send_transport, _ = await loop.create_datagram_endpoint(
-            lambda: _PliListenerProtocol(self._force_sink_keyframe),
+            lambda: _PliListenerProtocol(
+                self._force_sink_keyframe, rtcp_state=self._rtcp_state
+            ),
             local_addr=("0.0.0.0", 0),
             remote_addr=self._client_addr,
         )
@@ -471,12 +583,34 @@ class NativeRtpPeerConnection:
         h = int(self._payload.get("height", self._provider.default_height))
         self._sink = H264Sink(
             w, h, stats=self._provider.stats, use_h264=self._provider.use_h264,
-            payload_type=self._h264_pt or 96,
+            payload_type=self._h264_pt or 96, ssrc=OUT_SSRC,
         )
         for track in self.out_tracks:
             self._sender_tasks.append(
                 asyncio.ensure_future(self._pump(track, self._sink))
             )
+        # periodic Sender Reports for the outbound stream (RFC 3550; the
+        # clock mapping receivers use for lip-sync and stats)
+        self._sr_task = asyncio.ensure_future(self._sr_loop())
+
+    async def _sr_loop(self):
+        try:
+            while self.connectionState != "closed":
+                await asyncio.sleep(2.0)
+                if self._rtcp_state.packet_count == 0:
+                    continue
+                sr = self._rtcp_state.make_sr()
+                if self._secure_session is not None:
+                    wire = self._secure_session.protect_rtcp(sr)
+                    dst = self._secure_session.peer_addr
+                    if wire is not None and dst is not None and self._recv_transport:
+                        self._recv_transport.sendto(wire, dst)
+                elif self._send_transport is not None:
+                    self._send_transport.sendto(sr)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("SR loop failed")
 
     async def _pump(self, track, sink: H264Sink):
         """The RTP sender loop (the aiortc-internal loop the reference relies
@@ -490,6 +624,7 @@ class NativeRtpPeerConnection:
                         # drops silently until DTLS keys + ICE latch exist
                         self._recv_protocol.send_media(pkt)
                     else:
+                        self._rtcp_state.sent(pkt, pkt)
                         self._send_transport.sendto(pkt)
         except (ConnectionError, asyncio.CancelledError):
             pass
@@ -508,6 +643,8 @@ class NativeRtpPeerConnection:
             t.cancel()
         if self._sctp_timer_task is not None:
             self._sctp_timer_task.cancel()
+        if self._sr_task is not None:
+            self._sr_task.cancel()
         if self._sctp is not None:
             # tell the peer's stack the channels are gone (one ABORT) —
             # otherwise its datachannels dangle until its own RTX budget

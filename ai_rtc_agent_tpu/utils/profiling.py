@@ -30,6 +30,7 @@ class FrameStats:
         self._window = window
         self._lock = threading.Lock()
         self._counts: dict = {}
+        self._gauges: dict = {}
         self.frames_total = 0
 
     def record(self, latency_s: float, t: float | None = None):
@@ -50,6 +51,12 @@ class FrameStats:
         lands in the snapshot as ``<name>_total``."""
         with self._lock:
             self._counts[name] = self._counts.get(name, 0) + n
+
+    def gauge(self, name: str, value):
+        """Last-value gauge (receiver-report loss/jitter, …) — lands in
+        the snapshot under its own name."""
+        with self._lock:
+            self._gauges[name] = value
 
     def timed(self):
         """Context manager: with stats.timed(): process(frame)."""
@@ -72,6 +79,7 @@ class FrameStats:
             times = list(self._times)
             stages = {k: sorted(q) for k, q in self._stages.items()}
             counts = dict(self._counts)
+            gauges = dict(self._gauges)
         out = {
             "frames_total": self.frames_total,
             "fps": 0.0,
@@ -91,6 +99,7 @@ class FrameStats:
                 out[f"{name}_p90_ms"] = 1e3 * q[min(len(q) - 1, int(len(q) * 0.9))]
         for name, n in counts.items():
             out[f"{name}_total"] = n
+        out.update(gauges)
         return out
 
 

@@ -201,6 +201,34 @@ class SecureTestPeer:
         for pkt in packets:
             self.transport.sendto(self.tx.protect(pkt), self.server_addr)
 
+    def drain_classified(self) -> tuple:
+        """-> (rtp_wires, rtcp_items): everything queued, split by RFC 5761
+        payload-type demux.  RTP stays as WIRE bytes (replay-window-safe
+        duplicate detection); RTCP is SRTCP-unprotected and parsed."""
+        from ai_rtc_agent_tpu.media import rtcp as rtcp_mod
+
+        rtp_wires, rtcp_items = [], []
+        try:
+            while True:
+                wire = self.q.get_nowait()
+                if len(wire) >= 2 and 192 <= wire[1] <= 223:
+                    try:
+                        rtcp_items.extend(
+                            rtcp_mod.parse_compound(
+                                self.rx.unprotect_rtcp(wire)
+                            )
+                        )
+                    except ValueError:
+                        pass
+                else:
+                    rtp_wires.append(wire)
+        except asyncio.QueueEmpty:
+            pass
+        return rtp_wires, rtcp_items
+
+    def send_rtcp(self, packet: bytes) -> None:
+        self.transport.sendto(self.tx.protect_rtcp(packet), self.server_addr)
+
     def drain_into(self, ring_source) -> None:
         """Unprotect everything queued and feed it to the decode ring
         (non-RTP / replayed datagrams are skipped)."""

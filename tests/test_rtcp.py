@@ -1,0 +1,229 @@
+"""RTCP SR/RR + NACK on the native tier (VERDICT r4 next-round #5).
+
+The reference inherits sender reports, receiver-report stats and
+NACK-driven retransmission from aiortc (reference agent.py:13-20); these
+tests pin the in-repo equivalents (media/rtcp.py + rtc_native._RtcpState):
+wire formats, the retransmission cache, and the live secure-session
+behavior — an SR observable by the client, a NACK answered with the
+original ciphertext, receiver-report gauges landing in /metrics.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.media import rtcp
+from ai_rtc_agent_tpu.media.rtcp import (
+    RetransmissionCache,
+    make_nack,
+    make_rr,
+    make_sr,
+    parse_compound,
+)
+
+
+class TestWireFormats:
+    def test_sr_roundtrip_with_sdes(self):
+        sr = make_sr(0x5EED, rtp_ts=90000, packet_count=42, octet_count=4242)
+        items = parse_compound(sr)
+        assert len(items) == 1  # SDES walks but doesn't yield
+        s = items[0]
+        assert s["type"] == "sr" and s["ssrc"] == 0x5EED
+        assert s["rtp_ts"] == 90000
+        assert s["packet_count"] == 42 and s["octet_count"] == 4242
+        # NTP timestamp is current wall time in the 1900 epoch
+        import time
+
+        assert abs(s["ntp_sec"] - rtcp.NTP_EPOCH_OFFSET - time.time()) < 5
+
+    def test_sr_length_is_spec_shaped(self):
+        sr = make_sr(1, 0, 0, 0, compound_sdes=False)
+        assert len(sr) == 28
+        (words,) = struct.unpack_from("!H", sr, 2)
+        assert (words + 1) * 4 == len(sr)
+
+    def test_rr_roundtrip(self):
+        rr = make_rr(0xABC, 0x5EED, fraction_lost=25, cumulative_lost=7,
+                     highest_seq=1234, jitter=99)
+        (item,) = parse_compound(rr)
+        assert item["type"] == "rr" and item["ssrc"] == 0xABC
+        (blk,) = item["blocks"]
+        assert blk["ssrc"] == 0x5EED
+        assert blk["fraction_lost"] == 25 and blk["cumulative_lost"] == 7
+        assert blk["highest_seq"] == 1234 and blk["jitter"] == 99
+
+    def test_nack_pid_blp_encoding(self):
+        # 5 and 9 fold into 3's bitmask; 100 starts a second FCI pair
+        nack = make_nack(1, 2, [3, 5, 9, 100])
+        (item,) = parse_compound(nack)
+        assert item["type"] == "nack"
+        assert sorted(item["seqs"]) == [3, 5, 9, 100]
+
+    def test_nack_wraparound_seqs(self):
+        nack = make_nack(1, 2, [65535, 0])
+        (item,) = parse_compound(nack)
+        assert 65535 in item["seqs"] and 0 in item["seqs"]
+
+    def test_browser_style_compound_rr_plus_pli(self):
+        rr = make_rr(0xABC, 0x5EED)
+        pli = struct.pack("!BBH", 0x81, 206, 2) + struct.pack("!II", 0xABC, 0x5EED)
+        items = parse_compound(rr + pli)
+        assert [i["type"] for i in items] == ["rr", "pli"]
+
+    def test_garbage_not_parsed(self):
+        assert parse_compound(b"\x00" * 32) == []
+        assert parse_compound(b"") == []
+
+
+class TestRetransmissionCache:
+    def _pkt(self, seq, ts=0):
+        return struct.pack("!BBHII", 0x80, 102, seq, ts, 0x5EED) + b"payload"
+
+    def test_add_get_and_eviction(self):
+        c = RetransmissionCache(size=4)
+        for seq in range(6):
+            c.add(self._pkt(seq), b"wire%d" % seq)
+        assert len(c) == 4
+        assert c.get(0) is None and c.get(1) is None  # evicted
+        assert c.get(5) == b"wire5"
+
+    def test_rtcp_state_nack_resends_and_cache_miss_forces_idr(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+        from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+        stats = FrameStats()
+        st = _RtcpState(stats=stats)
+        st.sent(self._pkt(10, ts=777), b"wire10")
+        resent = []
+        force = st.on_rtcp(make_nack(1, 0x5EED, [10]), resent.append)
+        assert resent == [b"wire10"] and force is False
+        force = st.on_rtcp(make_nack(1, 0x5EED, [9999]), resent.append)
+        assert force is True  # aged out -> IDR recovery
+        snap = stats.snapshot()
+        assert snap["rtcp_nacks_total"] == 2
+        assert snap["rtcp_nack_retransmits_total"] == 1
+
+    def test_rtcp_state_rr_gauges(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+        from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+        stats = FrameStats()
+        st = _RtcpState(stats=stats)
+        st.on_rtcp(make_rr(1, 0x5EED, fraction_lost=64, jitter=12), lambda w: None)
+        snap = stats.snapshot()
+        assert snap["rr_fraction_lost"] == 64 and snap["rr_jitter"] == 12
+        assert snap["rtcp_rrs_total"] == 1
+
+    def test_sr_counters_track_sends(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        st.sent(self._pkt(1, ts=3000), b"w1")
+        st.sent(self._pkt(2, ts=6000), b"w2")
+        (item,) = [i for i in parse_compound(st.make_sr()) if i["type"] == "sr"]
+        assert item["packet_count"] == 2
+        assert item["rtp_ts"] == 6000
+        assert item["octet_count"] == 2 * len(b"payload")
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    from ai_rtc_agent_tpu.media import native
+
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    return lib
+
+
+def test_live_secure_session_sr_nack_rr(native_lib, monkeypatch):
+    """One encrypted session exercises all three: the client OBSERVES a
+    sender report, a NACK is answered with the identical ciphertext
+    packet, and a receiver report lands in /metrics."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_rtc_agent_tpu.media import native
+    from ai_rtc_agent_tpu.media.frames import VideoFrame
+    from ai_rtc_agent_tpu.media.plane import H264Sink
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+    from tests.secure_client import SecureTestPeer, secure_offer
+    from tests.test_secure_e2e import InvertPipeline
+
+    use_h264 = native.h264_available()
+    w = h = 64
+
+    async def go():
+        provider = NativeRtpProvider(
+            default_width=w, default_height=h, use_h264=use_h264
+        )
+        app = build_app(pipeline=InvertPipeline(), provider=provider)
+        http = TestClient(TestServer(app))
+        await http.start_server()
+        peer = await SecureTestPeer("rtcp-client").open_socket()
+        out_sink = H264Sink(w, h, use_h264=use_h264, payload_type=102)
+        try:
+            r = await http.post(
+                "/offer",
+                json={
+                    "room_id": "rtcp-room",
+                    "offer": {
+                        "sdp": secure_offer(peer.cert.fingerprint),
+                        "type": "offer",
+                    },
+                },
+            )
+            assert r.status == 200
+            await peer.establish((await r.json())["sdp"])
+
+            seen_wires: list = []
+            rtcp_items: list = []
+            # push frames until processed media returns, collecting RTCP
+            for i in range(120):
+                f = VideoFrame.from_ndarray(
+                    np.full((h, w, 3), 180, np.uint8)
+                )
+                f.pts = i * 3000
+                peer.send_rtp(out_sink.consume(f))
+                rtp, items = peer.drain_classified()
+                seen_wires.extend(rtp)
+                rtcp_items.extend(items)
+                if seen_wires and any(x["type"] == "sr" for x in rtcp_items):
+                    break
+                await asyncio.sleep(0.05)
+            assert seen_wires, "no media came back"
+            srs = [x for x in rtcp_items if x["type"] == "sr"]
+            assert srs, "no sender report observed within the session"
+            assert srs[-1]["ssrc"] == 0x5EED
+            assert srs[-1]["packet_count"] > 0
+
+            # NACK the first media packet we saw: the identical ciphertext
+            # must come back (cache hit — no re-encryption)
+            target_wire = seen_wires[0]
+            seq = (target_wire[2] << 8) | target_wire[3]
+            peer.send_rtcp(make_nack(0xABC, 0x5EED, [seq]))
+            got_dup = False
+            for _ in range(40):
+                await asyncio.sleep(0.05)
+                rtp, items = peer.drain_classified()
+                if any(wire == target_wire for wire in rtp):
+                    got_dup = True
+                    break
+            assert got_dup, "NACK was not answered with a retransmission"
+
+            # a receiver report lands in /metrics as gauges
+            peer.send_rtcp(make_rr(0xABC, 0x5EED, fraction_lost=3, jitter=8))
+            await asyncio.sleep(0.3)
+            snap = await (await http.get("/metrics")).json()
+            assert snap.get("rtcp_rrs_total", 0) >= 1
+            assert snap.get("rr_fraction_lost") == 3
+            assert snap.get("rr_jitter") == 8
+        finally:
+            out_sink.close()
+            peer.close()
+            await http.close()
+
+    asyncio.run(go())
