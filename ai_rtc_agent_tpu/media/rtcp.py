@@ -35,6 +35,15 @@ PT_PSFB = 206  # payload-specific feedback (PLI is FMT 1)
 NTP_EPOCH_OFFSET = 2208988800  # 1900 -> 1970
 
 
+def is_rtcp(data: bytes) -> bool:
+    """RFC 5761 s4 demux: version 2 + payload type in the full RTCP block
+    (192-223: legacy FIR/NACK 192/193, SR..XR 200-207).  RTP can't land
+    there — media PTs are 96-127, or 224-255 with the marker bit.  THE
+    shared predicate: endpoint.classify, rtc_native and the test client
+    all route on this one definition."""
+    return len(data) >= 2 and (data[0] >> 6) == 2 and 192 <= data[1] <= 223
+
+
 def _ntp_now(now: float | None = None) -> tuple:
     t = time.time() if now is None else now
     sec = int(t) + NTP_EPOCH_OFFSET
@@ -127,7 +136,10 @@ def parse_compound(data: bytes) -> list:
     off = 0
     while off + 8 <= len(data):
         b0, pt = data[off], data[off + 1]
-        if (b0 >> 6) != 2 or not (200 <= pt <= 206):
+        # walk the full RTCP PT block; an UNKNOWN type inside it (XR 207,
+        # legacy 192/193) is skipped, not a walk terminator — feedback
+        # packets can trail it in the same compound (code review r5)
+        if (b0 >> 6) != 2 or not (192 <= pt <= 223):
             break
         (length_words,) = struct.unpack_from("!H", data, off + 2)
         end = off + (length_words + 1) * 4
@@ -170,6 +182,7 @@ def parse_compound(data: bytes) -> list:
                 boff += 24
             out.append({"type": "rr", "ssrc": ssrc, "blocks": blocks})
         elif pt == PT_RTPFB and fmt_or_rc == 1 and len(body) >= 8:
+            media_ssrc = struct.unpack_from("!I", body, 4)[0]
             seqs = []
             boff = 8
             while boff + 4 <= len(body):
@@ -179,9 +192,14 @@ def parse_compound(data: bytes) -> list:
                     if blp & (1 << bit):
                         seqs.append((pid + bit + 1) & 0xFFFF)
                 boff += 4
-            out.append({"type": "nack", "seqs": seqs})
+            out.append(
+                {"type": "nack", "media_ssrc": media_ssrc, "seqs": seqs}
+            )
         elif pt == PT_PSFB and fmt_or_rc == 1:
-            out.append({"type": "pli"})
+            media_ssrc = (
+                struct.unpack_from("!I", body, 4)[0] if len(body) >= 8 else 0
+            )
+            out.append({"type": "pli", "media_ssrc": media_ssrc})
         off = end
     return out
 

@@ -37,8 +37,10 @@ import asyncio
 import json
 import logging
 import os
+import time
 import uuid
 
+from ..media import rtcp as rtcp_mod
 from ..media.plane import H264RingSource, H264Sink
 from ..utils.profiling import FrameStats
 from . import sdp
@@ -64,34 +66,35 @@ class _RtcpState:
     machinery the reference inherits from aiortc (reference agent.py:13-20).
     """
 
-    def __init__(self, stats: FrameStats | None = None, ssrc: int = OUT_SSRC):
-        from ..media.rtcp import RetransmissionCache
+    # per-second retransmission budget: NACKs are unauthenticated on the
+    # plain tier, and even the secure tier shouldn't let one feedback
+    # datagram extract the whole 512-packet cache (amplification)
+    RTX_PER_SECOND = 64
 
+    def __init__(self, stats: FrameStats | None = None, ssrc: int = OUT_SSRC):
         self.ssrc = ssrc
-        self.cache = RetransmissionCache()
+        self.cache = rtcp_mod.RetransmissionCache()
         self.packet_count = 0
         self.octet_count = 0
         self.last_rtp_ts = 0
         self.last_sent_wall = None  # wall clock paired with last_rtp_ts
         self.stats = stats
+        self._rtx_window_start = 0.0
+        self._rtx_in_window = 0
 
     def sent(self, plain_pkt: bytes, wire: bytes) -> None:
-        import time as _t
-
         self.packet_count += 1
         self.octet_count += max(0, len(plain_pkt) - 12)
         if len(plain_pkt) >= 8:
             self.last_rtp_ts = int.from_bytes(plain_pkt[4:8], "big")
-            self.last_sent_wall = _t.time()
+            self.last_sent_wall = time.time()
         self.cache.add(plain_pkt, wire)
 
     def make_sr(self) -> bytes:
-        from ..media import rtcp
-
         # RFC 3550 s6.4.1: the NTP and RTP timestamps must denote the SAME
         # instant — use the wall clock captured when last_rtp_ts was sent,
         # not now() (a stalled pipeline would otherwise skew the mapping)
-        return rtcp.make_sr(
+        return rtcp_mod.make_sr(
             self.ssrc,
             self.last_rtp_ts,
             self.packet_count,
@@ -99,44 +102,57 @@ class _RtcpState:
             now=self.last_sent_wall,
         )
 
+    def _rtx_allowed(self) -> bool:
+        now = time.monotonic()
+        if now - self._rtx_window_start >= 1.0:
+            self._rtx_window_start = now
+            self._rtx_in_window = 0
+        if self._rtx_in_window >= self.RTX_PER_SECOND:
+            return False
+        self._rtx_in_window += 1
+        return True
+
     def on_rtcp(self, payload: bytes, resend) -> bool:
         """Handle one inbound compound RTCP datagram.  `resend` transmits a
         cached WIRE packet.  Returns True when the sender should IDR
-        (PLI, or a NACK for packets that aged out of the cache)."""
-        from ..media import rtcp
+        (PLI, or a NACK for packets that aged out of the cache).
 
+        Feedback about a DIFFERENT media SSRC is ignored wholesale — a
+        misdirected/forged NACK must neither drain the cache nor force
+        spurious keyframes, and another stream's RR must not pollute the
+        rr_* gauges (code review r5)."""
         force_idr = False
-        for item in rtcp.parse_compound(payload):
+        for item in rtcp_mod.parse_compound(payload):
             if item["type"] == "pli":
-                force_idr = True
+                if item.get("media_ssrc") in (0, self.ssrc):
+                    force_idr = True
             elif item["type"] == "nack":
+                if item.get("media_ssrc") != self.ssrc:
+                    continue
                 if self.stats is not None:
                     self.stats.count("rtcp_nacks")
                 for seq in item["seqs"]:
                     wire = self.cache.get(seq)
-                    if wire is not None:
+                    if wire is not None and self._rtx_allowed():
                         resend(wire)
                         if self.stats is not None:
                             self.stats.count("rtcp_nack_retransmits")
-                    else:
+                    elif wire is None:
                         # aged out of the cache: a keyframe is the only
                         # recovery that still helps
                         force_idr = True
-            elif item["type"] == "rr" and item["blocks"]:
-                blk = item["blocks"][0]
-                if self.stats is not None:
+            elif item["type"] == "rr":
+                blks = [
+                    b for b in item["blocks"] if b["ssrc"] == self.ssrc
+                ]
+                if blks and self.stats is not None:
                     self.stats.count("rtcp_rrs")
-                    self.stats.gauge("rr_fraction_lost", blk["fraction_lost"])
-                    self.stats.gauge("rr_jitter", blk["jitter"])
+                    self.stats.gauge("rr_fraction_lost", blks[0]["fraction_lost"])
+                    self.stats.gauge("rr_jitter", blks[0]["jitter"])
         return force_idr
 
 
-def _looks_like_rtcp(data: bytes) -> bool:
-    # RFC 5761 s4 demux, same rule as secure/endpoint.py classify(): the
-    # full RTCP PT block is 192-223 (FIR/NACK-legacy 192/193, SR..XR
-    # 200-207) — RTP can't land there (our PTs are 96-127, or 224-255
-    # with the marker bit)
-    return len(data) >= 2 and (data[0] >> 6) == 2 and 192 <= data[1] <= 223
+_looks_like_rtcp = rtcp_mod.is_rtcp  # one RFC 5761 demux rule, one place
 
 
 class _RtpReceiverProtocol(asyncio.DatagramProtocol):
@@ -594,8 +610,8 @@ class NativeRtpPeerConnection:
         self._sr_task = asyncio.ensure_future(self._sr_loop())
 
     async def _sr_loop(self):
-        try:
-            while self.connectionState != "closed":
+        while self.connectionState != "closed":
+            try:
                 await asyncio.sleep(2.0)
                 if self._rtcp_state.packet_count == 0:
                     continue
@@ -607,10 +623,12 @@ class NativeRtpPeerConnection:
                         self._recv_transport.sendto(wire, dst)
                 elif self._send_transport is not None:
                     self._send_transport.sendto(sr)
-        except asyncio.CancelledError:
-            pass
-        except Exception:
-            logger.exception("SR loop failed")
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                # one transient send failure (route flap, close race) must
+                # not kill the session's reports forever (code review r5)
+                logger.exception("SR emission failed — will retry")
 
     async def _pump(self, track, sink: H264Sink):
         """The RTP sender loop (the aiortc-internal loop the reference relies

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import logging
 
+from ...media.rtcp import is_rtcp
 from .dtls import DtlsEndpoint, DtlsCertificate, generate_certificate
 from .srtp import PROFILE_KEYING, derive_srtp_contexts
 from .stun import IceLiteResponder, is_stun
@@ -31,7 +32,7 @@ def classify(datagram: bytes) -> str:
     if 20 <= b <= 63:
         return "dtls"
     if 128 <= b <= 191:
-        if len(datagram) >= 2 and 192 <= datagram[1] <= 223:
+        if is_rtcp(datagram):
             return "rtcp"
         return "rtp"
     return "drop"

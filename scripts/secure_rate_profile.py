@@ -1,0 +1,91 @@
+"""Secure-tier per-packet cost profile (VERDICT r4 next-round #6).
+
+Measures what docs/security.md asserts: SRTP protect/unprotect µs per
+packet for both negotiated profiles at streaming packet sizes, the DTLS
+handshake cost, and the implied core share at a 30 fps 512² H.264 rate
+(~300-400 pkts/s with FU-A fragmentation).  Prints ONE JSON line (the
+bank-and-commit convention every measurement script here follows).
+"""
+
+import json
+import time
+
+from ai_rtc_agent_tpu.server.secure.dtls import DtlsEndpoint, generate_certificate
+from ai_rtc_agent_tpu.server.secure.srtp import (
+    PROFILE_AEAD_AES_128_GCM,
+    PROFILE_AES128_CM_SHA1_80,
+    derive_srtp_contexts,
+)
+
+PKT_SIZE = 1200  # MTU-filling FU-A fragment — the dominant media packet
+N = 5000
+
+
+def _profile_contexts(profile):
+    km = b"\x5a" * 60
+    tx, _rx = derive_srtp_contexts(km, is_server=True, profile=profile)
+    _tx2, rx = derive_srtp_contexts(km, is_server=False, profile=profile)
+    import struct
+
+    pkts = [
+        struct.pack("!BBHII", 0x80, 102, seq, seq * 3000, 0x5EED)
+        + b"\x7c" * (PKT_SIZE - 12)
+        for seq in range(1, N + 1)
+    ]
+    t0 = time.perf_counter()
+    wires = [tx.protect(p) for p in pkts]
+    t1 = time.perf_counter()
+    for w in wires:
+        rx.unprotect(w)
+    t2 = time.perf_counter()
+    return 1e6 * (t1 - t0) / N, 1e6 * (t2 - t1) / N
+
+
+def _profile_handshake():
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        server = DtlsEndpoint("server", generate_certificate())
+        client = DtlsEndpoint("client", generate_certificate())
+        inflight = client.start()
+        for _round in range(30):
+            if server.established and client.established:
+                break
+            back = []
+            for d in inflight:
+                back.extend(server.handle_datagram(d))
+            inflight = []
+            for d in back:
+                inflight.extend(client.handle_datagram(d))
+        assert server.established
+    return 1e3 * (time.perf_counter() - t0) / n
+
+
+def main():
+    cm_p, cm_u = _profile_contexts(PROFILE_AES128_CM_SHA1_80)
+    gcm_p, gcm_u = _profile_contexts(PROFILE_AEAD_AES_128_GCM)
+    hs_ms = _profile_handshake()
+    # 30 fps 512² H.264 at realistic diffusion-output bitrates: every frame
+    # spans several MTU packets; bound with a generous 400 pkt/s each way
+    pkts_per_s = 400
+    core_share = pkts_per_s * (cm_p + cm_u) / 1e6
+    print(
+        json.dumps(
+            {
+                "check": "secure_rate_profile",
+                "pkt_bytes": PKT_SIZE,
+                "srtp_cm_protect_us": round(cm_p, 2),
+                "srtp_cm_unprotect_us": round(cm_u, 2),
+                "srtp_gcm_protect_us": round(gcm_p, 2),
+                "srtp_gcm_unprotect_us": round(gcm_u, 2),
+                "dtls_handshake_ms": round(hs_ms, 2),
+                "assumed_pkts_per_s": pkts_per_s,
+                "core_share_at_rate": round(core_share, 4),
+                "ok": core_share < 0.05,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -211,7 +211,7 @@ class SecureTestPeer:
         try:
             while True:
                 wire = self.q.get_nowait()
-                if len(wire) >= 2 and 192 <= wire[1] <= 223:
+                if rtcp_mod.is_rtcp(wire):
                     try:
                         rtcp_items.extend(
                             rtcp_mod.parse_compound(

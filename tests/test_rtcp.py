@@ -227,3 +227,46 @@ def test_live_secure_session_sr_nack_rr(native_lib, monkeypatch):
             await http.close()
 
     asyncio.run(go())
+
+
+class TestReviewHardening:
+    def _pkt(self, seq, ts=0):
+        return struct.pack("!BBHII", 0x80, 102, seq, ts, 0x5EED) + b"p"
+
+    def test_unknown_pt_does_not_terminate_compound_walk(self):
+        # [RR][XR pt=207][NACK]: the NACK after the unknown XR must parse
+        rr = make_rr(0xABC, 0x5EED)
+        xr = struct.pack("!BBHI", 0x80, 207, 1, 0xABC)
+        nack = make_nack(0xABC, 0x5EED, [7])
+        types = [i["type"] for i in parse_compound(rr + xr + nack)]
+        assert types == ["rr", "nack"]
+
+    def test_nack_for_foreign_ssrc_ignored(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        st.sent(self._pkt(10), b"wire10")
+        resent = []
+        # media SSRC is someone else's stream: no resend AND no IDR
+        force = st.on_rtcp(make_nack(1, 0xDEAD, [10, 9999]), resent.append)
+        assert resent == [] and force is False
+
+    def test_rr_for_foreign_ssrc_does_not_pollute_gauges(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+        from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+        stats = FrameStats()
+        st = _RtcpState(stats=stats)
+        st.on_rtcp(make_rr(1, 0xDEAD, fraction_lost=99), lambda w: None)
+        snap = stats.snapshot()
+        assert "rr_fraction_lost" not in snap
+
+    def test_retransmit_budget_caps_amplification(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        for seq in range(200):
+            st.sent(self._pkt(seq), b"w%d" % seq)
+        resent = []
+        st.on_rtcp(make_nack(1, 0x5EED, list(range(200))), resent.append)
+        assert len(resent) == st.RTX_PER_SECOND  # one window's budget

@@ -1,0 +1,77 @@
+"""Secure-tier per-packet cost pins (VERDICT r4 next-round #6).
+
+docs/security.md claims SRTP crypto is <5% of one core at streaming rates;
+scripts/secure_rate_profile.py measured it (committed in PERF.md).  These
+tests keep the claim honest without a flaky absolute wall-clock bound:
+costs are normalized against an HMAC-SHA1 primitive from the same crypto
+library on the same box, so a slow CI machine scales both sides equally.
+A Python-level regression (accidental per-packet allocs, a lost fast
+path) shows up as a ratio blowup.
+"""
+
+import struct
+import time
+
+from ai_rtc_agent_tpu.server.secure.srtp import (
+    PROFILE_AEAD_AES_128_GCM,
+    PROFILE_AES128_CM_SHA1_80,
+    derive_srtp_contexts,
+)
+
+PKT_SIZE = 1200
+N = 1500
+
+
+def _pkts():
+    return [
+        struct.pack("!BBHII", 0x80, 102, seq, seq * 3000, 0x5EED)
+        + b"\x7c" * (PKT_SIZE - 12)
+        for seq in range(1, N + 1)
+    ]
+
+
+def _baseline_us() -> float:
+    """HMAC-SHA1 over one packet-sized buffer — the normalization unit."""
+    import hashlib
+    import hmac as hmac_mod
+
+    key = b"k" * 20
+    buf = b"\x7c" * PKT_SIZE
+    t0 = time.perf_counter()
+    for _ in range(N):
+        hmac_mod.new(key, buf, hashlib.sha1).digest()
+    return 1e6 * (time.perf_counter() - t0) / N
+
+
+def _roundtrip_us(profile) -> float:
+    km = b"\x5a" * 60
+    tx, _ = derive_srtp_contexts(km, is_server=True, profile=profile)
+    _, rx = derive_srtp_contexts(km, is_server=False, profile=profile)
+    pkts = _pkts()
+    t0 = time.perf_counter()
+    for p in pkts:
+        rx.unprotect(tx.protect(p))
+    return 1e6 * (time.perf_counter() - t0) / N
+
+
+def test_cm_profile_per_packet_cost_bounded():
+    base = _baseline_us()
+    cost = _roundtrip_us(PROFILE_AES128_CM_SHA1_80)
+    # measured ~14x on the build box (27.8 us vs ~2 us); 60x is the
+    # generous regression fence, not a performance target
+    assert cost < 60 * base, f"CM roundtrip {cost:.1f}us vs base {base:.1f}us"
+
+
+def test_gcm_profile_per_packet_cost_bounded():
+    base = _baseline_us()
+    cost = _roundtrip_us(PROFILE_AEAD_AES_128_GCM)
+    # measured ~5x on the build box (9.4 us)
+    assert cost < 30 * base, f"GCM roundtrip {cost:.1f}us vs base {base:.1f}us"
+
+
+def test_core_share_claim_at_streaming_rate():
+    """The docs/security.md '<5% of a core' claim, with slack for slow CI
+    boxes: even at 25% the order of magnitude documented is right."""
+    cost_s = _roundtrip_us(PROFILE_AES128_CM_SHA1_80) / 1e6
+    core_share = 400 * cost_s  # 400 pkts/s each way at 30 fps 512²
+    assert core_share < 0.25, f"core share {core_share:.3f}"
