@@ -130,8 +130,10 @@ def parse_compound(data: bytes) -> list:
 
     Yields: {"type": "sr", ssrc, ntp_sec, ntp_frac, rtp_ts, packet_count,
     octet_count} / {"type": "rr", ssrc, blocks: [{ssrc, fraction_lost,
-    cumulative_lost, highest_seq, jitter}]} / {"type": "nack", seqs: [...]}
-    / {"type": "pli"}."""
+    cumulative_lost, highest_seq, jitter}]} / {"type": "nack", media_ssrc,
+    seqs: [...]} / {"type": "pli", media_ssrc} — media_ssrc is which
+    outbound stream the feedback is about (0 when the packet was too short
+    to carry one; the PLI convention our own recovery path sends)."""
     out = []
     off = 0
     while off + 8 <= len(data):

@@ -70,6 +70,10 @@ class _RtcpState:
     # plain tier, and even the secure tier shouldn't let one feedback
     # datagram extract the whole 512-packet cache (amplification)
     RTX_PER_SECOND = 64
+    # feedback-driven IDR floor: forged PLIs / cache-miss NACKs must not be
+    # able to degrade the encoder to all-keyframes (code review r5); legit
+    # receivers recover fine at 2 IDR/s
+    IDR_MIN_INTERVAL_S = 0.5
 
     def __init__(self, stats: FrameStats | None = None, ssrc: int = OUT_SSRC):
         self.ssrc = ssrc
@@ -81,6 +85,7 @@ class _RtcpState:
         self.stats = stats
         self._rtx_window_start = 0.0
         self._rtx_in_window = 0
+        self._last_idr = 0.0
 
     def sent(self, plain_pkt: bytes, wire: bytes) -> None:
         self.packet_count += 1
@@ -149,6 +154,11 @@ class _RtcpState:
                     self.stats.count("rtcp_rrs")
                     self.stats.gauge("rr_fraction_lost", blks[0]["fraction_lost"])
                     self.stats.gauge("rr_jitter", blks[0]["jitter"])
+        if force_idr:
+            now = time.monotonic()
+            if now - self._last_idr < self.IDR_MIN_INTERVAL_S:
+                return False
+            self._last_idr = now
         return force_idr
 
 

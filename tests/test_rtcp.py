@@ -270,3 +270,16 @@ class TestReviewHardening:
         resent = []
         st.on_rtcp(make_nack(1, 0x5EED, list(range(200))), resent.append)
         assert len(resent) == st.RTX_PER_SECOND  # one window's budget
+
+    def test_feedback_idr_rate_limited(self):
+        """A PLI/NACK flood must not turn every frame into a keyframe:
+        feedback-driven IDRs are floored at IDR_MIN_INTERVAL_S."""
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        pli = struct.pack("!BBH", 0x81, 206, 2) + struct.pack("!II", 1, 0x5EED)
+        assert st.on_rtcp(pli, lambda w: None) is True
+        for _ in range(10):  # immediate repeats are suppressed
+            assert st.on_rtcp(pli, lambda w: None) is False
+        st._last_idr -= 10.0  # interval elapsed -> allowed again
+        assert st.on_rtcp(pli, lambda w: None) is True
