@@ -129,7 +129,11 @@ class _RtcpState:
         force_idr = False
         for item in rtcp_mod.parse_compound(payload):
             if item["type"] == "pli":
-                if item.get("media_ssrc") in (0, self.ssrc):
+                # exact SSRC match only: a media_ssrc=0 wildcard would keep
+                # the forged-PLI door the filter exists to close open (code
+                # review r5); our own receive path PLIs with the publisher's
+                # real SSRC, and browsers always set it
+                if item.get("media_ssrc") == self.ssrc:
                     force_idr = True
             elif item["type"] == "nack":
                 if item.get("media_ssrc") != self.ssrc:
@@ -187,6 +191,7 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         self.source = source
         self.session = session
         self._rtcp_state = rtcp_state
+        self._last_rx_ssrc = 0  # publisher's SSRC, learned from its RTP
         self.transport = None
         self._on_pli = on_pli
         self._last_addr = None
@@ -221,7 +226,9 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         try:
             from ..media import rtp as R
 
-            pkt = R.make_pli()
+            # name the stream we are asking a keyframe FOR — peers with an
+            # exact-match feedback filter (like ours) ignore wildcard PLIs
+            pkt = R.make_pli(media_ssrc=self._last_rx_ssrc)
             if self.session is not None:
                 pkt = self.session.protect_rtcp(pkt)
                 if pkt is None:
@@ -274,6 +281,8 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
                 if force and self._on_pli is not None:
                     self._on_pli()
                 return
+        if len(data) >= 12:
+            self._last_rx_ssrc = int.from_bytes(data[8:12], "big")
         try:
             # reorder + depacketize inline (microseconds); queue only
             # COMPLETED access units so the worker hop is per frame

@@ -283,3 +283,12 @@ class TestReviewHardening:
             assert st.on_rtcp(pli, lambda w: None) is False
         st._last_idr -= 10.0  # interval elapsed -> allowed again
         assert st.on_rtcp(pli, lambda w: None) is True
+
+    def test_wildcard_pli_ignored(self):
+        """media_ssrc=0 is no longer a PLI wildcard — forged wildcard PLIs
+        must not force keyframes (code review r5 pass 2)."""
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        pli0 = struct.pack("!BBH", 0x81, 206, 2) + struct.pack("!II", 1, 0)
+        assert st.on_rtcp(pli0, lambda w: None) is False
