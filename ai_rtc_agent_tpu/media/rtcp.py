@@ -60,6 +60,19 @@ def _sdes_cname(ssrc: int, cname: bytes = b"tpu-rtc-agent") -> bytes:
     return struct.pack("!BBH", 0x81, PT_SDES, words) + item
 
 
+def _report_block_bytes(blk: dict) -> bytes:
+    return struct.pack(
+        "!IIIIII",
+        blk["ssrc"] & 0xFFFFFFFF,
+        ((blk.get("fraction_lost", 0) & 0xFF) << 24)
+        | (blk.get("cumulative_lost", 0) & 0xFFFFFF),
+        blk.get("highest_seq", 0) & 0xFFFFFFFF,
+        blk.get("jitter", 0) & 0xFFFFFFFF,
+        0,  # LSR
+        0,  # DLSR
+    )
+
+
 def make_sr(
     ssrc: int,
     rtp_ts: int,
@@ -67,40 +80,52 @@ def make_sr(
     octet_count: int,
     now: float | None = None,
     compound_sdes: bool = True,
+    report_blocks: list | None = None,
 ) -> bytes:
-    """Sender report: the NTP↔RTP timestamp pair + send counters."""
+    """Sender report: the NTP↔RTP timestamp pair + send counters, plus
+    optional reception report blocks about inbound streams (RFC 3550
+    s6.4.1 — how a bidirectional endpoint reports both directions in one
+    packet)."""
     sec, frac = _ntp_now(now)
-    sr = struct.pack(
-        "!BBHIIIIII",
-        0x80,  # V=2, no report blocks
-        PT_SR,
-        6,  # length in words - 1 (28 bytes body)
-        ssrc & 0xFFFFFFFF,
-        sec,
-        frac,
-        rtp_ts & 0xFFFFFFFF,
-        packet_count & 0xFFFFFFFF,
-        octet_count & 0xFFFFFFFF,
+    blocks = report_blocks or []
+    payload = (
+        struct.pack("!I", ssrc & 0xFFFFFFFF)
+        + struct.pack(
+            "!IIIII",
+            sec,
+            frac,
+            rtp_ts & 0xFFFFFFFF,
+            packet_count & 0xFFFFFFFF,
+            octet_count & 0xFFFFFFFF,
+        )
+        + b"".join(_report_block_bytes(b) for b in blocks)
+    )
+    sr = (
+        struct.pack(
+            "!BBH", 0x80 | len(blocks), PT_SR, len(payload) // 4
+        )
+        + payload
     )
     return sr + _sdes_cname(ssrc) if compound_sdes else sr
 
 
 def make_rr(ssrc: int, media_ssrc: int, fraction_lost: int = 0,
             cumulative_lost: int = 0, highest_seq: int = 0,
-            jitter: int = 0) -> bytes:
-    """Receiver report with one report block (the shape browsers send)."""
-    block = struct.pack(
-        "!IIIIII",
-        media_ssrc & 0xFFFFFFFF,
-        ((fraction_lost & 0xFF) << 24) | (cumulative_lost & 0xFFFFFF),
-        highest_seq & 0xFFFFFFFF,
-        jitter & 0xFFFFFFFF,
-        0,  # LSR
-        0,  # DLSR
+            jitter: int = 0, compound_sdes: bool = True) -> bytes:
+    """Receiver report with one report block (the shape browsers send),
+    compounded with an SDES CNAME (RFC 3550 s6.1 requires every RTCP
+    compound to carry one)."""
+    block = _report_block_bytes(
+        {
+            "ssrc": media_ssrc,
+            "fraction_lost": fraction_lost,
+            "cumulative_lost": cumulative_lost,
+            "highest_seq": highest_seq,
+            "jitter": jitter,
+        }
     )
-    return (
-        struct.pack("!BBHI", 0x81, PT_RR, 7, ssrc & 0xFFFFFFFF) + block
-    )
+    rr = struct.pack("!BBHI", 0x81, PT_RR, 7, ssrc & 0xFFFFFFFF) + block
+    return rr + _sdes_cname(ssrc) if compound_sdes else rr
 
 
 def make_nack(sender_ssrc: int, media_ssrc: int, seqs: list) -> bytes:
@@ -123,6 +148,27 @@ def make_nack(sender_ssrc: int, media_ssrc: int, seqs: list) -> bytes:
         + struct.pack("!II", sender_ssrc & 0xFFFFFFFF, media_ssrc & 0xFFFFFFFF)
         + fci
     )
+
+
+def _parse_report_blocks(body: bytes, off: int, count: int) -> list:
+    blocks = []
+    for _ in range(count):
+        if off + 24 > len(body):
+            break
+        bssrc, lost, hseq, jit, _lsr, _dlsr = struct.unpack_from(
+            "!IIIIII", body, off
+        )
+        blocks.append(
+            {
+                "ssrc": bssrc,
+                "fraction_lost": lost >> 24,
+                "cumulative_lost": lost & 0xFFFFFF,
+                "highest_seq": hseq,
+                "jitter": jit,
+            }
+        )
+        off += 24
+    return blocks
 
 
 def parse_compound(data: bytes) -> list:
@@ -160,29 +206,18 @@ def parse_compound(data: bytes) -> list:
                     "rtp_ts": rtp_ts,
                     "packet_count": pc,
                     "octet_count": oc,
+                    "blocks": _parse_report_blocks(body, 24, fmt_or_rc),
                 }
             )
         elif pt == PT_RR and len(body) >= 4:
             (ssrc,) = struct.unpack_from("!I", body, 0)
-            blocks = []
-            boff = 4
-            for _ in range(fmt_or_rc):
-                if boff + 24 > len(body):
-                    break
-                bssrc, lost, hseq, jit, _lsr, _dlsr = struct.unpack_from(
-                    "!IIIIII", body, boff
-                )
-                blocks.append(
-                    {
-                        "ssrc": bssrc,
-                        "fraction_lost": lost >> 24,
-                        "cumulative_lost": lost & 0xFFFFFF,
-                        "highest_seq": hseq,
-                        "jitter": jit,
-                    }
-                )
-                boff += 24
-            out.append({"type": "rr", "ssrc": ssrc, "blocks": blocks})
+            out.append(
+                {
+                    "type": "rr",
+                    "ssrc": ssrc,
+                    "blocks": _parse_report_blocks(body, 4, fmt_or_rc),
+                }
+            )
         elif pt == PT_RTPFB and fmt_or_rc == 1 and len(body) >= 8:
             media_ssrc = struct.unpack_from("!I", body, 4)[0]
             seqs = []
@@ -204,6 +239,87 @@ def parse_compound(data: bytes) -> list:
             out.append({"type": "pli", "media_ssrc": media_ssrc})
         off = end
     return out
+
+
+class ReceiverStats:
+    """Inbound-stream reception statistics (RFC 3550 appendix A.3/A.8):
+    extended highest sequence (16-bit cycles), cumulative + interval loss,
+    and interarrival jitter in RTP timestamp units — everything a report
+    block needs.  Feed every received RTP packet via `received()`."""
+
+    def __init__(self, clock_rate: int = 90000):
+        self.clock_rate = clock_rate
+        self.ssrc = 0
+        self._base_seq = None
+        self._max_seq = 0
+        self._cycles = 0
+        self._received = 0
+        self._jitter = 0.0
+        self._last_transit = None
+        # interval state for fraction_lost (reset at each report)
+        self._expected_prior = 0
+        self._received_prior = 0
+
+    def received(self, pkt: bytes, arrival: float | None = None) -> None:
+        if len(pkt) < 12:
+            return
+        seq = (pkt[2] << 8) | pkt[3]
+        rtp_ts = int.from_bytes(pkt[4:8], "big")
+        ssrc = int.from_bytes(pkt[8:12], "big")
+        if self._base_seq is None:
+            # lock onto the FIRST stream: an unauthenticated socket can see
+            # stray RTP from other senders, and interleaving two seq spaces
+            # would report the real publisher's stream as collapsing
+            self.ssrc = ssrc
+            self._base_seq = seq
+            self._max_seq = seq
+        elif ssrc != self.ssrc:
+            return
+        else:
+            delta = (seq - self._max_seq) & 0xFFFF
+            if delta < 0x8000:  # in-order / ahead
+                if seq < self._max_seq:
+                    self._cycles += 1  # wrapped
+                self._max_seq = seq
+        self._received += 1
+        # interarrival jitter (A.8): difference of relative transit times,
+        # in 32-bit MODULAR arithmetic — float subtraction would turn the
+        # sender's rtp_ts wrap (~13h at 90kHz) into a ~3000s jitter spike
+        t = time.monotonic() if arrival is None else arrival
+        arrival_rtp = int(t * self.clock_rate) & 0xFFFFFFFF
+        transit = (arrival_rtp - rtp_ts) & 0xFFFFFFFF
+        if self._last_transit is not None:
+            d = (transit - self._last_transit) & 0xFFFFFFFF
+            if d >= 1 << 31:
+                d = (1 << 32) - d
+            self._jitter += (d - self._jitter) / 16.0
+        self._last_transit = transit
+
+    @property
+    def ext_highest_seq(self) -> int:
+        return ((self._cycles << 16) | self._max_seq) & 0xFFFFFFFF
+
+    def report_block(self) -> dict | None:
+        """-> report-block dict for make_sr/make_rr, or None before any
+        packet arrived.  Resets the fraction-lost interval."""
+        if self._base_seq is None:
+            return None
+        expected = self.ext_highest_seq - self._base_seq + 1
+        lost = max(0, expected - self._received)
+        exp_int = expected - self._expected_prior
+        rec_int = self._received - self._received_prior
+        self._expected_prior = expected
+        self._received_prior = self._received
+        fraction = 0
+        if exp_int > 0 and exp_int > rec_int:
+            fraction = min(255, ((exp_int - rec_int) << 8) // exp_int)
+        return {
+            "ssrc": self.ssrc,
+            "fraction_lost": fraction,
+            "cumulative_lost": min(lost, 0xFFFFFF),
+            "highest_seq": self.ext_highest_seq,
+            "jitter": int(self._jitter),
+        }
 
 
 class RetransmissionCache:

@@ -78,6 +78,7 @@ class _RtcpState:
     def __init__(self, stats: FrameStats | None = None, ssrc: int = OUT_SSRC):
         self.ssrc = ssrc
         self.cache = rtcp_mod.RetransmissionCache()
+        self.recv = rtcp_mod.ReceiverStats()
         self.packet_count = 0
         self.octet_count = 0
         self.last_rtp_ts = 0
@@ -95,17 +96,35 @@ class _RtcpState:
             self.last_sent_wall = time.time()
         self.cache.add(plain_pkt, wire)
 
-    def make_sr(self) -> bytes:
-        # RFC 3550 s6.4.1: the NTP and RTP timestamps must denote the SAME
-        # instant — use the wall clock captured when last_rtp_ts was sent,
-        # not now() (a stalled pipeline would otherwise skew the mapping)
-        return rtcp_mod.make_sr(
-            self.ssrc,
-            self.last_rtp_ts,
-            self.packet_count,
-            self.octet_count,
-            now=self.last_sent_wall,
-        )
+    def make_report(self) -> bytes | None:
+        """The periodic report for this session: an SR (with a reception
+        block about the publisher's stream when one is inbound) while we
+        are sending, a bare RR while we only receive, None before any
+        traffic.  RFC 3550 s6.4 — the both-directions reporting browsers
+        expect from a full endpoint."""
+        blk = self.recv.report_block()
+        if self.packet_count > 0:
+            # RFC 3550 s6.4.1: the NTP and RTP timestamps must denote the
+            # SAME instant — use the wall clock captured when last_rtp_ts
+            # was sent, not now() (a stalled pipeline would skew the map)
+            return rtcp_mod.make_sr(
+                self.ssrc,
+                self.last_rtp_ts,
+                self.packet_count,
+                self.octet_count,
+                now=self.last_sent_wall,
+                report_blocks=[blk] if blk else None,
+            )
+        if blk is not None:
+            return rtcp_mod.make_rr(
+                self.ssrc,
+                blk["ssrc"],
+                fraction_lost=blk["fraction_lost"],
+                cumulative_lost=blk["cumulative_lost"],
+                highest_seq=blk["highest_seq"],
+                jitter=blk["jitter"],
+            )
+        return None
 
     def _rtx_allowed(self) -> bool:
         now = time.monotonic()
@@ -283,6 +302,7 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
                 return
         if len(data) >= 12:
             self._last_rx_ssrc = int.from_bytes(data[8:12], "big")
+            self._rtcp_state.recv.received(data)
         try:
             # reorder + depacketize inline (microseconds); queue only
             # COMPLETED access units so the worker hop is per frame
@@ -492,6 +512,10 @@ class NativeRtpPeerConnection:
                 )
             )
             self.server_port = self._recv_transport.get_extra_info("sockname")[1]
+            # RTCP reports flow for receive-only (WHIP) sessions too — the
+            # publisher expects RRs about its stream (RFC 3550 s6.4.2)
+            if self._sr_task is None:
+                self._sr_task = asyncio.ensure_future(self._sr_loop())
             if self.in_track is not None:
                 await self._emit("track", self.in_track)
         if (
@@ -624,30 +648,42 @@ class NativeRtpPeerConnection:
             self._sender_tasks.append(
                 asyncio.ensure_future(self._pump(track, self._sink))
             )
-        # periodic Sender Reports for the outbound stream (RFC 3550; the
-        # clock mapping receivers use for lip-sync and stats)
-        self._sr_task = asyncio.ensure_future(self._sr_loop())
+        # periodic reports for the outbound stream (RFC 3550; the clock
+        # mapping receivers use for lip-sync and stats) — unless the
+        # receive path already started the loop
+        if self._sr_task is None:
+            self._sr_task = asyncio.ensure_future(self._sr_loop())
 
     async def _sr_loop(self):
         while self.connectionState != "closed":
             try:
                 await asyncio.sleep(2.0)
-                if self._rtcp_state.packet_count == 0:
+                report = self._rtcp_state.make_report()
+                if report is None:
                     continue
-                sr = self._rtcp_state.make_sr()
                 if self._secure_session is not None:
-                    wire = self._secure_session.protect_rtcp(sr)
+                    wire = self._secure_session.protect_rtcp(report)
                     dst = self._secure_session.peer_addr
                     if wire is not None and dst is not None and self._recv_transport:
                         self._recv_transport.sendto(wire, dst)
                 elif self._send_transport is not None:
-                    self._send_transport.sendto(sr)
+                    self._send_transport.sendto(report)
+                elif (
+                    self._recv_transport is not None
+                    and self._recv_protocol is not None
+                    and self._recv_protocol._last_addr is not None
+                ):
+                    # plain receive-only (WHIP publisher): the RR rides the
+                    # receive socket back to the publisher's source address
+                    self._recv_transport.sendto(
+                        report, self._recv_protocol._last_addr
+                    )
             except asyncio.CancelledError:
                 return
             except Exception:
                 # one transient send failure (route flap, close race) must
                 # not kill the session's reports forever (code review r5)
-                logger.exception("SR emission failed — will retry")
+                logger.exception("RTCP report emission failed — will retry")
 
     async def _pump(self, track, sink: H264Sink):
         """The RTP sender loop (the aiortc-internal loop the reference relies

@@ -4,14 +4,17 @@ The browser-shaped counterpart of examples/native_rtp_client.py: it does
 what a browser's WebRTC stack does against the agent's secure tier
 (server/secure/), using the framework's own protocol modules:
 
-  1. POST a fingerprinted SDP offer to /offer (UDP/TLS/RTP/SAVPF)
+  1. POST a fingerprinted SDP offer to /offer (UDP/TLS/RTP/SAVPF, plus
+     m=application when --prompt asks for a datachannel)
   2. authenticated STUN binding (USE-CANDIDATE) to the answered port
   3. DTLS 1.2 handshake, both fingerprints verified against the SDP
-  4. SRTP-protected H.264 up; SRTP-unprotected processed frames back
+  4. optional SCTP datachannel "config" over the DTLS session (DCEP) —
+     runtime config rides it exactly like a browser's createDataChannel
+  5. SRTP-protected H.264 up; SRTP-unprotected processed frames back
 
 Usage (agent started with WEBRTC_PROVIDER=native-rtp):
     python examples/secure_webrtc_client.py --agent http://127.0.0.1:8888 \
-        --size 512 --frames 120
+        --size 512 --frames 120 --prompt "a neon fox"
 """
 
 from __future__ import annotations
@@ -46,10 +49,13 @@ def sdp_attr(text: str, name: str) -> str | None:
     return m.group(1).strip() if m else None
 
 
-def make_offer(fingerprint: str, ufrag: str, pwd: str) -> str:
-    return (
+def make_offer(
+    fingerprint: str, ufrag: str, pwd: str, datachannel: bool = False
+) -> str:
+    bundle = "0 1" if datachannel else "0"
+    sdp = (
         "v=0\r\no=- 1 2 IN IP4 0.0.0.0\r\ns=-\r\nt=0 0\r\n"
-        "a=group:BUNDLE 0\r\n"
+        f"a=group:BUNDLE {bundle}\r\n"
         f"m=video 9 UDP/TLS/RTP/SAVPF {H264_PT}\r\n"
         "c=IN IP4 0.0.0.0\r\n"
         f"a=ice-ufrag:{ufrag}\r\na=ice-pwd:{pwd}\r\n"
@@ -58,9 +64,22 @@ def make_offer(fingerprint: str, ufrag: str, pwd: str) -> str:
         f"a=rtpmap:{H264_PT} H264/90000\r\n"
         f"a=fmtp:{H264_PT} packetization-mode=1\r\n"
     )
+    if datachannel:
+        # the m=application section Chrome emits for createDataChannel
+        sdp += (
+            "m=application 9 UDP/DTLS/SCTP webrtc-datachannel\r\n"
+            "c=IN IP4 0.0.0.0\r\n"
+            f"a=ice-ufrag:{ufrag}\r\na=ice-pwd:{pwd}\r\n"
+            f"a=fingerprint:sha-256 {fingerprint}\r\n"
+            "a=setup:actpass\r\na=mid:1\r\n"
+            "a=sctp-port:5000\r\n"
+        )
+    return sdp
 
 
-async def run(agent: str, size: int, frames: int, room: str) -> int:
+async def run(
+    agent: str, size: int, frames: int, room: str, prompt: str | None = None
+) -> int:
     cert = generate_certificate("secure-example-client")
     from ai_rtc_agent_tpu.server.secure.stun import random_ice_string
 
@@ -71,7 +90,10 @@ async def run(agent: str, size: int, frames: int, room: str) -> int:
             {
                 "room_id": room,
                 "offer": {
-                    "sdp": make_offer(cert.fingerprint, ufrag, pwd),
+                    "sdp": make_offer(
+                        cert.fingerprint, ufrag, pwd,
+                        datachannel=prompt is not None,
+                    ),
                     "type": "offer",
                 },
             }
@@ -136,6 +158,52 @@ async def run(agent: str, size: int, frames: int, room: str) -> int:
             profile=dtls.srtp_profile,
         )
 
+        sctp = None
+
+        def sctp_tx(pkts):
+            for p in pkts:
+                for d in dtls.send_application_data(p):
+                    transport.sendto(d, server_addr)
+
+        def pump_dtls(wire) -> bool:
+            """Route a DTLS record (SCTP datachannel plane).  True when the
+            datagram was DTLS."""
+            if not wire or not (20 <= wire[0] <= 63):
+                return False
+            for d in dtls.handle_datagram(wire):
+                transport.sendto(d, server_addr)
+            for msg in dtls.recv_application_data():
+                if sctp is not None:
+                    sctp_tx(sctp.handle_packet(msg))
+            return True
+
+        if prompt is not None:
+            # the browser flow: createDataChannel("config") -> DCEP open ->
+            # runtime config rides the channel (reference agent.py:154-168)
+            from ai_rtc_agent_tpu.server.secure.sctp import SctpAssociation
+
+            sctp = SctpAssociation("client")
+            sctp_tx(sctp.start())
+            channel = None
+            deadline = loop.time() + 10
+            while loop.time() < deadline:
+                if sctp.established and channel is None:
+                    channel, pkts = sctp.open_channel("config")
+                    sctp_tx(pkts)
+                if channel is not None and channel.readyState == "open":
+                    break
+                try:
+                    wire = await asyncio.wait_for(q.get(), 1)
+                except asyncio.TimeoutError:
+                    sctp_tx(sctp.retransmit_due())
+                    continue
+                pump_dtls(wire)
+            if channel is None or channel.readyState != "open":
+                print("datachannel open timed out")
+                return 1
+            sctp_tx(channel.send(json.dumps({"prompt": prompt})))
+            print(f'datachannel "config" open — sent prompt {prompt!r}')
+
         use_h264 = native.h264_available()
         sink = H264Sink(size, size, use_h264=use_h264, payload_type=H264_PT)
         back = H264RingSource(size, size, use_h264=use_h264)
@@ -149,10 +217,16 @@ async def run(agent: str, size: int, frames: int, room: str) -> int:
                 f.pts = i * 3000
                 for pkt in sink.consume(f):
                     transport.sendto(tx.protect(pkt), server_addr)
+                if sctp is not None:
+                    # the prompt's DATA chunk stays on the SCTP timer until
+                    # SACKed — a lost datagram must not lose the config
+                    sctp_tx(sctp.retransmit_due())
                 await asyncio.sleep(1 / 30)
                 try:
                     while True:
                         wire = q.get_nowait()
+                        if pump_dtls(wire):
+                            continue  # SCTP datachannel traffic
                         try:
                             back.feed_packet(rx.unprotect(wire))
                         except ValueError:
@@ -171,6 +245,8 @@ async def run(agent: str, size: int, frames: int, room: str) -> int:
                 try:
                     while True:
                         wire = q.get_nowait()
+                        if pump_dtls(wire):
+                            continue
                         try:
                             back.feed_packet(rx.unprotect(wire))
                         except ValueError:
@@ -194,8 +270,16 @@ def main() -> int:
     ap.add_argument("--size", type=int, default=512)
     ap.add_argument("--frames", type=int, default=120)
     ap.add_argument("--room", default="secure-example")
+    ap.add_argument(
+        "--prompt",
+        default=None,
+        help="open a 'config' datachannel and send this prompt over it "
+        "(the browser's createDataChannel flow)",
+    )
     args = ap.parse_args()
-    return asyncio.run(run(args.agent, args.size, args.frames, args.room))
+    return asyncio.run(
+        run(args.agent, args.size, args.frames, args.room, prompt=args.prompt)
+    )
 
 
 if __name__ == "__main__":

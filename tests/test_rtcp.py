@@ -122,7 +122,7 @@ class TestRetransmissionCache:
         st = _RtcpState()
         st.sent(self._pkt(1, ts=3000), b"w1")
         st.sent(self._pkt(2, ts=6000), b"w2")
-        (item,) = [i for i in parse_compound(st.make_sr()) if i["type"] == "sr"]
+        (item,) = [i for i in parse_compound(st.make_report()) if i["type"] == "sr"]
         assert item["packet_count"] == 2
         assert item["rtp_ts"] == 6000
         assert item["octet_count"] == 2 * len(b"payload")
@@ -164,7 +164,10 @@ def test_live_secure_session_sr_nack_rr(native_lib, monkeypatch):
         http = TestClient(TestServer(app))
         await http.start_server()
         peer = await SecureTestPeer("rtcp-client").open_socket()
-        out_sink = H264Sink(w, h, use_h264=use_h264, payload_type=102)
+        # distinct publish SSRC so the reception block about OUR stream
+        # is distinguishable from the server's own 0x5EED
+        out_sink = H264Sink(w, h, use_h264=use_h264, payload_type=102,
+                            ssrc=0xCAFE)
         try:
             r = await http.post(
                 "/offer",
@@ -199,6 +202,13 @@ def test_live_secure_session_sr_nack_rr(native_lib, monkeypatch):
             assert srs, "no sender report observed within the session"
             assert srs[-1]["ssrc"] == 0x5EED
             assert srs[-1]["packet_count"] > 0
+            # the SR also REPORTS RECEPTION of our publish stream (r5:
+            # ReceiverStats) — highest seq advances, ssrc is ours
+            with_blocks = [x for x in srs if x.get("blocks")]
+            assert with_blocks, "no reception block about our stream"
+            blk = with_blocks[-1]["blocks"][0]
+            assert blk["ssrc"] == 0xCAFE
+            assert blk["highest_seq"] > 0
 
             # NACK the first media packet we saw: the identical ciphertext
             # must come back (cache hit — no re-encryption)
@@ -292,3 +302,126 @@ class TestReviewHardening:
         st = _RtcpState()
         pli0 = struct.pack("!BBH", 0x81, 206, 2) + struct.pack("!II", 1, 0)
         assert st.on_rtcp(pli0, lambda w: None) is False
+
+
+class TestReceiverStats:
+    def _pkt(self, seq, ts, ssrc=0xCAFE):
+        return struct.pack("!BBHII", 0x80, 102, seq, ts, ssrc) + b"d"
+
+    def test_no_loss_clean_run(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        t = 100.0
+        for i in range(50):
+            rs.received(self._pkt(1000 + i, i * 3000), arrival=t + i / 30)
+        blk = rs.report_block()
+        assert blk["ssrc"] == 0xCAFE
+        assert blk["fraction_lost"] == 0 and blk["cumulative_lost"] == 0
+        assert blk["highest_seq"] == 1049
+        # 30 fps arrivals vs 90 kHz ts: transit is constant -> jitter ~0
+        assert blk["jitter"] == 0
+
+    def test_loss_counted_and_interval_fraction(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        # drop every 4th, keeping the interval boundary (139) so the
+        # second interval starts clean
+        seqs = [s for s in range(100, 140) if s % 4 != 1]
+        for s in seqs:
+            rs.received(self._pkt(s, s * 3000), arrival=200.0 + s / 30)
+        blk = rs.report_block()
+        assert blk["cumulative_lost"] == 40 - len(seqs)
+        assert blk["fraction_lost"] > 0
+        # second interval with no loss -> fraction resets to 0
+        for s in range(140, 160):
+            rs.received(self._pkt(s, s * 3000), arrival=210.0 + s / 30)
+        blk2 = rs.report_block()
+        assert blk2["fraction_lost"] == 0
+        assert blk2["cumulative_lost"] == blk["cumulative_lost"]
+
+    def test_seq_wraparound_extends_highest(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        for s in (65533, 65534, 65535, 0, 1, 2):
+            rs.received(self._pkt(s & 0xFFFF, s * 3000), arrival=300.0 + s / 30)
+        blk = rs.report_block()
+        assert blk["highest_seq"] == (1 << 16) | 2
+        assert blk["cumulative_lost"] == 0
+
+    def test_jittery_arrivals_show_jitter(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        rng = __import__("random").Random(4)
+        for i in range(100):
+            rs.received(
+                self._pkt(i, i * 3000),
+                arrival=400.0 + i / 30 + rng.uniform(0, 0.03),
+            )
+        assert rs.report_block()["jitter"] > 100  # RTP ts units (90 kHz)
+
+    def test_sr_carries_reception_block_when_bidirectional(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        out = struct.pack("!BBHII", 0x80, 102, 9, 1000, 0x5EED) + b"x"
+        st.sent(out, out)
+        for i in range(10):
+            st.recv.received(self._pkt(50 + i, i * 3000), arrival=500.0 + i / 30)
+        (sr,) = [i for i in parse_compound(st.make_report()) if i["type"] == "sr"]
+        (blk,) = sr["blocks"]
+        assert blk["ssrc"] == 0xCAFE and blk["highest_seq"] == 59
+
+    def test_receive_only_emits_rr(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        st = _RtcpState()
+        for i in range(5):
+            st.recv.received(self._pkt(7 + i, i * 3000), arrival=600.0 + i / 30)
+        (item,) = parse_compound(st.make_report())
+        assert item["type"] == "rr"
+        assert item["ssrc"] == st.ssrc
+        assert item["blocks"][0]["ssrc"] == 0xCAFE
+
+    def test_no_traffic_no_report(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+
+        assert _RtcpState().make_report() is None
+
+    def test_rtp_timestamp_wrap_no_jitter_spike(self):
+        """Code review r5: the sender's 32-bit rtp_ts wrap (~13h at 90kHz)
+        must not register as a multi-thousand-second jitter spike."""
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        base_ts = (1 << 32) - 5 * 3000  # five frames before the wrap
+        for i in range(10):
+            ts = (base_ts + i * 3000) & 0xFFFFFFFF
+            rs.received(self._pkt(i, ts), arrival=700.0 + i / 30)
+        assert rs.report_block()["jitter"] < 100
+
+    def test_foreign_ssrc_packets_ignored(self):
+        """Code review r5: stray RTP from another SSRC on the same socket
+        must not corrupt the publisher's loss accounting."""
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        for i in range(10):
+            rs.received(self._pkt(100 + i, i * 3000), arrival=800.0 + i / 30)
+            rs.received(
+                self._pkt(40000 + i, i * 7000, ssrc=0xBAD), arrival=800.0 + i / 30
+            )
+        blk = rs.report_block()
+        assert blk["ssrc"] == 0xCAFE
+        assert blk["cumulative_lost"] == 0
+        assert blk["highest_seq"] == 109
+
+    def test_rr_compound_carries_sdes(self):
+        from ai_rtc_agent_tpu.media.rtcp import make_rr
+
+        rr = make_rr(1, 2)
+        assert len(rr) > 32  # RR body is 32 bytes; the SDES chunk follows
+        assert rr[33] == 202 and b"tpu-rtc-agent" in rr  # PT_SDES + CNAME
