@@ -313,3 +313,63 @@ class TestReviewR5Fixes:
         client._hs_flight[1] -= 10.0
         _pump(client.retransmit_due(), server, client)
         assert client.established and server.established
+
+
+def test_multipeer_per_peer_prompts_over_native_datachannels(native_lib):
+    """--multipeer on the NATIVE secure tier: each peer's datachannel
+    config lands on ITS OWN slot (the per-peer prompt isolation the
+    reference serves through aiortc datachannels, reference
+    agent.py:154-168 + multipeer claim semantics)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_rtc_agent_tpu.media import native
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+    from tests.test_multipeer_serving import _FakeMultiPeer
+
+    # the ONE multipeer fake (tests/test_multipeer_serving.py) so a
+    # claim/release contract change breaks every consumer loudly
+    mp = _FakeMultiPeer(capacity=2)
+
+    async def go():
+        provider = NativeRtpProvider(use_h264=native.h264_available())
+        app = build_app(
+            pipeline=None, provider=provider, multipeer=2,
+            multipeer_pipeline=mp,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        peers = []
+        try:
+            for i, prompt in enumerate(["neon fox", "pale moon"]):
+                peer = await SecureTestPeer(f"mp-{i}").open_socket()
+                peers.append(peer)
+                r = await client.post(
+                    "/offer",
+                    json={
+                        "room_id": f"mp-{i}",
+                        "offer": {
+                            "sdp": secure_offer(
+                                peer.cert.fingerprint, datachannel=True
+                            ),
+                            "type": "offer",
+                        },
+                    },
+                )
+                assert r.status == 200, await r.text()
+                await peer.establish((await r.json())["sdp"])
+                ch = await peer.open_datachannel("config")
+                peer.dc_send(ch, json.dumps({"prompt": prompt}))
+            for _ in range(40):
+                await asyncio.sleep(0.1)
+                for peer in peers:
+                    await peer.drain_dc(0.05)
+                if all(p.prompt for p in mp.peers):
+                    break
+            assert [p.prompt for p in mp.peers] == ["neon fox", "pale moon"]
+        finally:
+            for peer in peers:
+                peer.close()
+            await client.close()
+
+    asyncio.run(go())

@@ -8,6 +8,7 @@ associations, distinct SRTP keys), teardown releases cleanly.
 """
 
 import asyncio
+import json
 
 import numpy as np
 import pytest
@@ -37,6 +38,15 @@ class TintPipeline:
     """Deterministic transform so each peer's return stream is
     attributable: output = 255 - input (shared pipeline, distinct inputs)."""
 
+    def __init__(self):
+        self.prompts = []
+
+    def update_prompt(self, p):
+        self.prompts.append(p)
+
+    def update_t_index_list(self, t):
+        pass
+
     def __call__(self, frame):
         arr = frame.to_ndarray(format="rgb24")
         out = VideoFrame.from_ndarray(255 - arr)
@@ -58,6 +68,7 @@ async def _secure_peer(http, idx: int, use_h264: bool):
                     peer.cert.fingerprint,
                     ufrag=peer.ufrag,
                     pwd=f"soakpeerpwd0123456789{idx}",
+                    datachannel=True,
                 ),
                 "type": "offer",
             },
@@ -65,6 +76,11 @@ async def _secure_peer(http, idx: int, use_h264: bool):
     )
     assert r.status == 200
     await peer.establish((await r.json())["sdp"])
+    # every soak peer also runs the datachannel control plane (r5): DCEP
+    # open + one config message per session, concurrently with media
+    ch = await peer.open_datachannel("config")
+    peer.dc_send(ch, json.dumps({"prompt": f"soak prompt {idx}"}))
+    await peer.drain_dc(0.3)
 
     val = 40 + idx * 60  # distinct constant input per peer
     sink = H264Sink(W, H, use_h264=use_h264, payload_type=102)
@@ -121,6 +137,10 @@ def test_three_concurrent_secure_peers(native_lib, monkeypatch):
             snap = await m.json()
             assert snap.get("secure_sessions_total", 0) >= N_PEERS
             assert snap.get("srtp_drops_total", 0) == 0
+            # every session's datachannel config arrived (shared pipeline)
+            assert sorted(app["pipeline"].prompts) == sorted(
+                f"soak prompt {i}" for i in range(N_PEERS)
+            )
         finally:
             await http.close()
 
