@@ -578,14 +578,27 @@ class NativeRtpPeerConnection:
             if asyncio.iscoroutine(r):
                 asyncio.ensure_future(r)
 
+        stats = self._provider.stats
+        if stats is not None:
+            # pre-register so "0" is distinguishable from "not wired"
+            stats.count("datachannels", 0)
+            stats.count("datachannel_messages", 0)
+
         def on_channel(channel):
             # DCEP open accepted — surface it exactly like aiortc does
+            if stats is not None:
+                stats.count("datachannels")
             asyncio.ensure_future(self._emit("datachannel", channel))
+
+        def on_message(channel, message):
+            if stats is not None:
+                stats.count("datachannel_messages")
 
         self._sctp = SctpAssociation(
             "server",
             remote_port=app_section.sctp_port(),
             on_channel=on_channel,
+            on_message=on_message,
             dispatch=dispatch,
         )
         self._sctp.transmit = self._sctp_transmit

@@ -240,6 +240,9 @@ class TestLiveDatachannel:
                         break
                 assert pipeline.prompts == ["neon fox"]
                 assert pipeline.t_index_lists == [[10, 20, 30, 40]]
+                snap = await (await client.get("/metrics")).json()
+                assert snap.get("datachannels_total", 0) >= 1
+                assert snap.get("datachannel_messages_total", 0) >= 1
             finally:
                 peer.close()
                 await client.close()
