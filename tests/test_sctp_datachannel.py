@@ -376,3 +376,52 @@ def test_multipeer_per_peer_prompts_over_native_datachannels(native_lib):
             await client.close()
 
     asyncio.run(go())
+
+
+class TestChromeShapedSctp:
+    """usrsctp/dcsctp wire shapes Chrome actually emits — tolerance pins."""
+
+    def test_init_with_optional_params_tolerated(self):
+        import struct as _s
+
+        server = SctpAssociation("server")
+        client = SctpAssociation("client")
+        (init_pkt,) = client.start()
+        # splice usrsctp-style optional params onto the INIT chunk:
+        # FORWARD-TSN supported (49152), supported extensions (32776)
+        params = _s.pack("!HH", 49152, 4) + _s.pack("!HHBB", 32776, 6, 130, 193) + b"\x00\x00"
+        body = bytearray(init_pkt)
+        chunk_len = _s.unpack_from("!H", body, 14)[0]
+        _s.pack_into("!H", body, 14, chunk_len + len(params))
+        body = bytes(body) + params
+        body = bytearray(body)
+        _s.pack_into("!I", body, 8, 0)
+        from ai_rtc_agent_tpu.server.secure.sctp import crc32c
+
+        _s.pack_into("<I", body, 8, crc32c(bytes(body)))
+        out = server.handle_packet(bytes(body))
+        assert out and out[0][12] == 2  # INIT-ACK
+
+    def test_cookie_echo_bundled_with_dcep_open(self):
+        """Chrome bundles COOKIE-ECHO and the first DATA (DCEP OPEN) in one
+        SCTP packet — both chunks must process in order."""
+        import struct as _s
+
+        opened = []
+        server = SctpAssociation("server", on_channel=opened.append)
+        client = SctpAssociation("client")
+        (init_pkt,) = client.start()
+        (init_ack,) = server.handle_packet(init_pkt)
+        (cookie_echo,) = client.handle_packet(init_ack)
+        # client side: fabricate the bundled packet = COOKIE-ECHO chunk +
+        # DCEP OPEN DATA chunk in one SCTP packet
+        ce_chunk = cookie_echo[12:]
+        ch, open_pkts = client.open_channel("config")
+        data_chunk = open_pkts[0][12:]
+        bundled = client._packet(ce_chunk + data_chunk)
+        outs = server.handle_packet(bundled)
+        assert server.established
+        assert opened and opened[0].label == "config"
+        # replies include COOKIE-ACK and a SACK covering the DATA
+        types = [o[12] for o in outs]
+        assert 11 in types and 3 in types
