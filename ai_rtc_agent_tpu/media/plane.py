@@ -33,6 +33,7 @@ from . import native
 from .codec import H264Decoder, H264Encoder, NullCodec
 from .frames import VideoFrame
 from .ring import FrameRing
+from .rtcp import is_rtcp
 from .rtp import RtpDepacketizer, RtpPacketizer, RtpReorderBuffer
 
 logger = logging.getLogger(__name__)
@@ -104,6 +105,12 @@ class H264RingSource:
         if self._depkt is None:
             raise RuntimeError("native RTP runtime unavailable")
         if self._closed:
+            return []
+        if is_rtcp(packet):
+            # rtcp-mux (RFC 5761): reports ride the media port.  A compound
+            # RTCP fed into the reorder buffer would be read as an RTP seq
+            # (bytes 2:4 are its LENGTH field) and desync the window — the
+            # exact corruption r5's periodic RRs exposed in naive clients.
             return []
         aus = []
         for pkt in self._reorder.push(packet):

@@ -1,12 +1,15 @@
-"""Native RTP/UDP media provider — a real wire path without aiortc.
+"""Native WebRTC provider — the framework's OWN full wire stack.
 
-aiortc is not installable in this environment (VERDICT r1 missing #3), so
-this provider proves the full media path with the framework's OWN stack:
+Born (round 2) as the aiortc-free media path, now (round 5) the DEFAULT
+provider when aiortc is absent and a complete browser-capable tier:
 RTP packetization (native/rtp.cpp, RFC 6184), H.264 codecs (native/h264.cpp
-→ libavcodec), the SPSC frame ring, and UDP sockets opened through the
-event loop — which means the --udp-ports pinning patch applies to media
-exactly as it does for the reference's WebRTC stack (reference
-agent.py:32-69).
+→ libavcodec), the SPSC frame ring, real SDP offer/answer (server/sdp.py),
+ICE-lite + DTLS 1.2 + SRTP/SRTCP on one demuxed socket (server/secure/),
+SCTP data channels (server/secure/sctp.py, RFC 8831/8832), and full RTCP —
+periodic SR/RR with reception statistics, NACK retransmission, PLI
+(media/rtcp.py).  UDP sockets open through the event loop, so the
+--udp-ports pinning patch applies to media exactly as it does for the
+reference's WebRTC stack (reference agent.py:32-69).
 
 Signaling stays the agent's HTTP surface and accepts BOTH body shapes:
 
@@ -27,8 +30,9 @@ Media flow per connection:
     -> VideoStreamTrack(pipeline) -> sender task -> H264Sink
     (encode+packetize) -> UDP -> client.
 
-No ICE/DTLS/SRTP — this is the LAN/loopback transport tier and the e2e
-test vehicle; the AiortcProvider remains the internet-facing tier.
+Offers WITHOUT a DTLS fingerprint (the JSON envelope above, LAN tools) ride
+plain RTP; fingerprinted offers (every browser/OBS) get the encrypted tier —
+see docs/security.md for the exact guarantees and known limits.
 """
 
 from __future__ import annotations

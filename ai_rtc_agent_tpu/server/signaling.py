@@ -1,4 +1,4 @@
-"""WebRTC provider abstraction: aiortc when installed, loopback otherwise.
+"""WebRTC provider abstraction: aiortc when installed, native-rtp otherwise.
 
 The reference's entire WebRTC stack (ICE/DTLS/SRTP/RTP/jitter/datachannel)
 lives in its aiortc fork (SURVEY.md L3/L0); the first-party code only drives
@@ -14,10 +14,13 @@ This module pins down exactly that surface as a provider interface:
 * ``LoopbackProvider`` — a hermetic in-process implementation: "SDP" is a
   JSON envelope, media flows through asyncio queues, datachannel messages
   are delivered directly.  It powers the end-to-end test tier (SURVEY.md
-  section 4) and development on machines without a WebRTC stack — the agent
-  logic (tracks, events, config control plane, pipeline) is identical.
+  section 4); selected only by explicit WEBRTC_PROVIDER=loopback — the
+  agent logic (tracks, events, config control plane, pipeline) is
+  identical across tiers.
 
-``get_provider()`` picks aiortc when importable unless WEBRTC_PROVIDER=loopback.
+``get_provider()`` picks aiortc when importable; otherwise the native-rtp
+tier (the in-repo secure WebRTC stack).  WEBRTC_PROVIDER=loopback/native-rtp
+/aiortc overrides.
 """
 
 from __future__ import annotations
@@ -284,16 +287,44 @@ class AiortcProvider:
 
 def get_provider(name: str | None = None):
     name = name or os.getenv("WEBRTC_PROVIDER")
-    if name == "loopback":
-        return LoopbackProvider()
-    if name == "native-rtp":
+
+    def native():
         from .rtc_native import NativeRtpProvider
 
         return NativeRtpProvider()
+
+    if name == "loopback":
+        return LoopbackProvider()
+    if name == "native-rtp":
+        return native()
+    if name and name != "aiortc":
+        # three tiers with materially different security properties — a
+        # typo must not silently select a different stack
+        raise ValueError(
+            f"unknown WEBRTC_PROVIDER {name!r} "
+            "(expected aiortc | native-rtp | loopback)"
+        )
     try:
         return AiortcProvider()
     except ImportError:
         if name == "aiortc":
             raise
-        logger.warning("aiortc not installed — using loopback WebRTC provider")
-        return LoopbackProvider()
+        # r5: the native tier is the full browser-capable stack (real SDP,
+        # ICE-lite + DTLS-SRTP, SCTP datachannels, RTCP) — a deployment
+        # without aiortc should serve browsers, not the loopback test shim.
+        # But only when its C++ runtime actually loads: a toolchain-less
+        # box must keep degrading to a WORKING loopback, not boot an agent
+        # whose every session dies at setup.
+        from ..media import native as native_rt
+
+        if native_rt.load() is None:
+            logger.warning(
+                "aiortc not installed and the native media runtime is "
+                "unavailable — using the loopback provider"
+            )
+            return LoopbackProvider()
+        logger.warning(
+            "aiortc not installed — using the native-rtp provider "
+            "(in-repo secure WebRTC tier)"
+        )
+        return native()

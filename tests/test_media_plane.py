@@ -451,3 +451,35 @@ def test_rtp_client_drain_survives_bursts(native_lib):
             c.close()
 
     asyncio.run(go())
+
+
+def test_rtcp_on_media_port_does_not_desync_depacketizer(native_lib):
+    """rtcp-mux regression (r5): a compound RR/SR interleaved with RTP on
+    the media port must be ignored by the depacketizer — feeding it into
+    the reorder buffer desyncs the seq window (its bytes 2:4 are a LENGTH
+    field, not a seq) and every later frame drops."""
+    from ai_rtc_agent_tpu.media.rtcp import make_rr, make_sr
+
+    use_h264 = _h264()
+    sink = H264Sink(64, 64, use_h264=use_h264)
+    src = H264RingSource(64, 64, use_h264=use_h264)
+    rng = np.random.default_rng(3)
+    decoded = 0
+    try:
+        for i in range(8):
+            f = VideoFrame.from_ndarray(
+                rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+            )
+            f.pts = i * 3000
+            pkts = sink.consume(f)
+            # interleave reports exactly where a muxed wire would carry them
+            src.feed_packet(make_rr(0xABC, 0x5EED, fraction_lost=1))
+            for pkt in pkts:
+                src.feed_packet(pkt)
+            src.feed_packet(make_sr(0x5EED, i * 3000, i + 1, 1000))
+            while src.poll() is not None:
+                decoded += 1
+    finally:
+        sink.close()
+        src.close()
+    assert decoded >= 6, f"only {decoded} frames survived muxed RTCP"

@@ -552,3 +552,24 @@ def test_config_structurally_wrong_bodies_are_400():
             await client.close()
 
     run(go())
+
+
+def test_default_provider_without_aiortc_is_native(monkeypatch):
+    """r5: a deployment without aiortc serves real browsers (native secure
+    tier), not the loopback test shim — loopback only on explicit request."""
+    monkeypatch.delenv("WEBRTC_PROVIDER", raising=False)
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_aiortc(name, *a, **kw):
+        if name == "aiortc" or name.startswith("aiortc."):
+            raise ImportError("aiortc unavailable (test)")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", no_aiortc)
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+    from ai_rtc_agent_tpu.server.signaling import LoopbackProvider, get_provider
+
+    assert isinstance(get_provider(), NativeRtpProvider)
+    assert isinstance(get_provider("loopback"), LoopbackProvider)
