@@ -14,9 +14,10 @@ This module pins down exactly that surface as a provider interface:
 * ``LoopbackProvider`` — a hermetic in-process implementation: "SDP" is a
   JSON envelope, media flows through asyncio queues, datachannel messages
   are delivered directly.  It powers the end-to-end test tier (SURVEY.md
-  section 4); selected only by explicit WEBRTC_PROVIDER=loopback — the
-  agent logic (tracks, events, config control plane, pipeline) is
-  identical across tiers.
+  section 4); selected by explicit WEBRTC_PROVIDER=loopback, or as the
+  last-resort degrade when neither aiortc nor the native tier's runtime
+  deps are available — the agent logic (tracks, events, config control
+  plane, pipeline) is identical across tiers.
 
 ``get_provider()`` picks aiortc when importable; otherwise the native-rtp
 tier (the in-repo secure WebRTC stack).  WEBRTC_PROVIDER=loopback/native-rtp
@@ -317,10 +318,21 @@ def get_provider(name: str | None = None):
         # whose every session dies at setup.
         from ..media import native as native_rt
 
-        if native_rt.load() is None:
+        def secure_importable() -> bool:
+            try:
+                from .secure import SecureMediaSession  # noqa: F401
+
+                return True
+            except ImportError:
+                return False
+
+        if native_rt.load() is None or not secure_importable():
+            # missing C++ runtime OR missing `cryptography` (the secure
+            # tier's crypto backend): either way every browser session
+            # would die at setup — degrade to a WORKING loopback instead
             logger.warning(
-                "aiortc not installed and the native media runtime is "
-                "unavailable — using the loopback provider"
+                "aiortc not installed and the native tier's runtime deps "
+                "are unavailable — using the loopback provider"
             )
             return LoopbackProvider()
         logger.warning(

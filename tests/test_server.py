@@ -568,8 +568,13 @@ def test_default_provider_without_aiortc_is_native(monkeypatch):
         return real_import(name, *a, **kw)
 
     monkeypatch.setattr(builtins, "__import__", no_aiortc)
+    from ai_rtc_agent_tpu.media import native
     from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
     from ai_rtc_agent_tpu.server.signaling import LoopbackProvider, get_provider
 
-    assert isinstance(get_provider(), NativeRtpProvider)
+    if native.load() is None:
+        # toolchain-less box: the documented degrade is a WORKING loopback
+        assert isinstance(get_provider(), LoopbackProvider)
+    else:
+        assert isinstance(get_provider(), NativeRtpProvider)
     assert isinstance(get_provider("loopback"), LoopbackProvider)
