@@ -56,15 +56,37 @@ def civitai_model_path(name: str) -> str:
 def download_civitai_model(name: str, version_id: str) -> str | None:
     import requests
 
+    from ..resilience.retry import RetryError, transient_policy
+
     path = civitai_model_path(name)
     if os.path.exists(path):
         logger.info("civitai %s cached", name)
         return path
     os.makedirs(os.path.dirname(path), exist_ok=True)
     url = f"https://civitai.com/api/download/models/{version_id}"
-    r = requests.get(url, allow_redirects=True, timeout=120)
-    if r.status_code != 200:
-        logger.error("civitai download failed: %s", r.status_code)
+
+    def fetch():
+        r = requests.get(url, allow_redirects=True, timeout=120)
+        if r.status_code != 200:
+            # 5xx / 429 are transient; 4xx means the version id is wrong
+            # and retrying cannot help
+            if r.status_code >= 500 or r.status_code == 429:
+                raise requests.RequestException(f"civitai {r.status_code}")
+            logger.error("civitai download failed: %s", r.status_code)
+            return None
+        return r
+
+    try:
+        # big-file fetches over flaky links are the canonical retry case —
+        # shared policy, a little more patient than control-plane calls
+        r = transient_policy(attempts=4, base_delay_s=2.0).run(
+            fetch, retry_on=(requests.RequestException, OSError),
+            label=f"civitai {name}",
+        )
+    except RetryError as e:
+        logger.error("civitai download failed after retries: %s", e.last)
+        return None
+    if r is None:
         return None
     # filename from Content-Disposition (parity with reference
     # download.py:33-38), but we store under our canonical name

@@ -245,7 +245,26 @@ class ReceiverStats:
     """Inbound-stream reception statistics (RFC 3550 appendix A.3/A.8):
     extended highest sequence (16-bit cycles), cumulative + interval loss,
     and interarrival jitter in RTP timestamp units — everything a report
-    block needs.  Feed every received RTP packet via `received()`."""
+    block needs.  Feed every received RTP packet via `received()`.
+
+    Duplicate discipline (ADVICE r5): only FIRST-TIME packets count toward
+    ``_received`` — a sliding bitmap over the last :data:`DUP_WINDOW` seqs
+    below the extended highest marks what already arrived, so duplicated
+    and replayed packets can no longer under-report loss (A.3 compares
+    expected against *unique* receptions).  Late packets older than the
+    window are treated as duplicates too (indistinguishable, and at >128
+    packets late they are useless to a real-time stream anyway).
+
+    SSRC re-lock (ADVICE r5): the stats lock onto the first stream seen,
+    but if the locked stream goes silent while another SSRC keeps talking
+    (:data:`RELOCK_AFTER` consecutive foreign packets with none from the
+    locked stream) the stats re-lock onto the live stream — one stray
+    probe datagram must not wedge reporting (and PLI targeting) onto a
+    ghost for the whole session.
+    """
+
+    DUP_WINDOW = 128
+    RELOCK_AFTER = 32
 
     def __init__(self, clock_rate: int = 90000):
         self.clock_rate = clock_rate
@@ -259,6 +278,24 @@ class ReceiverStats:
         # interval state for fraction_lost (reset at each report)
         self._expected_prior = 0
         self._received_prior = 0
+        # bit i set = seq (ext_highest - i) already received
+        self._seen_window = 0
+        # consecutive foreign-SSRC packets since the locked stream last spoke
+        self._foreign_run = 0
+        self._foreign_ssrc = 0
+
+    def _lock(self, ssrc: int, seq: int) -> None:
+        self.ssrc = ssrc
+        self._base_seq = seq
+        self._max_seq = seq
+        self._cycles = 0
+        self._received = 0
+        self._jitter = 0.0
+        self._last_transit = None
+        self._expected_prior = 0
+        self._received_prior = 0
+        self._seen_window = 1
+        self._foreign_run = 0
 
     def received(self, pkt: bytes, arrival: float | None = None) -> None:
         if len(pkt) < 12:
@@ -270,18 +307,39 @@ class ReceiverStats:
             # lock onto the FIRST stream: an unauthenticated socket can see
             # stray RTP from other senders, and interleaving two seq spaces
             # would report the real publisher's stream as collapsing
-            self.ssrc = ssrc
-            self._base_seq = seq
-            self._max_seq = seq
+            self._lock(ssrc, seq)
+            self._received = 1
         elif ssrc != self.ssrc:
+            # foreign stream: ignored, unless the locked stream has gone
+            # silent while this one keeps talking — then re-lock (the lock
+            # was probably won by a stray/probe datagram)
+            if ssrc == self._foreign_ssrc:
+                self._foreign_run += 1
+            else:
+                self._foreign_ssrc = ssrc
+                self._foreign_run = 1
+            if self._foreign_run >= self.RELOCK_AFTER:
+                self._lock(ssrc, seq)
+                self._received = 1
             return
         else:
+            self._foreign_run = 0
             delta = (seq - self._max_seq) & 0xFFFF
+            if delta == 0:
+                return  # duplicate of the current highest
             if delta < 0x8000:  # in-order / ahead
                 if seq < self._max_seq:
                     self._cycles += 1  # wrapped
                 self._max_seq = seq
-        self._received += 1
+                self._seen_window = (
+                    (self._seen_window << delta) | 1
+                ) & ((1 << self.DUP_WINDOW) - 1)
+            else:  # late / reordered / replayed
+                back = (self._max_seq - seq) & 0xFFFF
+                if back >= self.DUP_WINDOW or (self._seen_window >> back) & 1:
+                    return  # duplicate (or too old to tell)
+                self._seen_window |= 1 << back
+            self._received += 1
         # interarrival jitter (A.8): difference of relative transit times,
         # in 32-bit MODULAR arithmetic — float subtraction would turn the
         # sender's rtp_ts wrap (~13h at 90kHz) into a ~3000s jitter spike

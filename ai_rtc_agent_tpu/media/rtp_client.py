@@ -17,6 +17,7 @@ import json
 
 import numpy as np
 
+from ..resilience import faults as _faults
 from .frames import VideoFrame
 from .plane import H264RingSource, H264Sink
 
@@ -33,6 +34,11 @@ class NativeRtpClient:
         self._send_tr = None
         self.sink: H264Sink | None = None
         self.back: H264RingSource | None = None
+        # chaos hooks (resilience/faults.py): impair this client's uplink
+        # ("tx") and downlink ("rx") when a fault plan is active; both are
+        # None — one is-None test per packet — otherwise
+        self._tx_faults = _faults.scope("tx")
+        self._rx_faults = _faults.scope("rx")
 
     async def open(self) -> "NativeRtpClient":
         loop = asyncio.get_event_loop()
@@ -77,6 +83,14 @@ class NativeRtpClient:
         frame = VideoFrame.from_ndarray(np.ascontiguousarray(arr_u8))
         frame.pts = index * (90_000 // self.fps)
         for pkt in self.sink.consume(frame):
+            if self._tx_faults is not None:
+                loop = asyncio.get_event_loop()
+                for d, delay in self._tx_faults.apply(pkt):
+                    if delay > 0:
+                        loop.call_later(delay, self._send_tr.sendto, d)
+                    else:
+                        self._send_tr.sendto(d)
+                continue
             self._send_tr.sendto(pkt)
 
     def drain(self) -> int:
@@ -88,6 +102,14 @@ class NativeRtpClient:
                 data = self._recv_q.get_nowait()
             except asyncio.QueueEmpty:
                 break
+            if self._rx_faults is not None:
+                # downlink impairment: delays collapse to reorder here (the
+                # drain is synchronous — schedule-late == deliver-late)
+                for d, _delay in self._rx_faults.apply(data):
+                    self.back.feed_packet(d)
+                    while self.back.poll() is not None:
+                        got += 1
+                continue
             self.back.feed_packet(data)
             while self.back.poll() is not None:
                 got += 1

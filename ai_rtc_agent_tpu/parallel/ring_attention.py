@@ -34,7 +34,9 @@ from jax.experimental.shard_map import shard_map
 
 def _ring_body(q, k, v, axis: str):
     """Per-shard body: q,k,v [B, Lloc, H, D] -> out [B, Lloc, H, D]."""
-    n = lax.axis_size(axis)
+    # psum(1) is the portable axis-size spelling — lax.axis_size does not
+    # exist on the pinned jax (0.4.x); this folds to a constant at trace
+    n = lax.psum(1, axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
     qf = q.astype(jnp.float32)
 

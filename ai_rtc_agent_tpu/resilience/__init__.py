@@ -1,0 +1,33 @@
+"""Session resilience: fault injection, supervised degradation, retry.
+
+At production scale faults are the steady state, not the exception — the
+reference agent (a single process with no tests and no recovery path,
+PAPER.md §0) dies silently on any stall in its decode→diffuse→encode loop.
+This package makes the failure modes of a live session *injectable*
+(``faults``: a deterministic, seedable fault plan with hook points in the
+media and compute paths, zero overhead when off), *survivable*
+(``supervisor``: a per-session health state machine that degrades to
+passthrough frames instead of freezing the stream and re-prepares the
+engine in the background), and *uniform* (``retry``: the one jittered
+exponential-backoff + deadline helper every control-plane retry loop
+shares).  See docs/resilience.md.
+"""
+
+from .faults import (  # noqa: F401
+    DeviceLostError,
+    FaultPlan,
+    FaultSpec,
+    activate,
+    active,
+    deactivate,
+    scope,
+)
+from .retry import RetryError, RetryPolicy  # noqa: F401
+from .supervisor import (  # noqa: F401
+    DEGRADED,
+    FAILED,
+    HEALTHY,
+    RECOVERING,
+    ResilientPipeline,
+    SessionSupervisor,
+)

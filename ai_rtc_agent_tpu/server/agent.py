@@ -35,6 +35,11 @@ from typing import List, Tuple
 
 from aiohttp import web
 
+from ..resilience.supervisor import (
+    ResilientPipeline,
+    SessionSupervisor,
+    worst_state,
+)
 from ..utils import env
 from ..utils.profiling import FrameStats
 from . import turn
@@ -43,6 +48,59 @@ from .signaling import get_provider
 from .tracks import VideoStreamTrack
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# session resilience (resilience/supervisor.py): every media session gets a
+# health state machine + passthrough degradation; SUPERVISOR=0 disables
+# ---------------------------------------------------------------------------
+
+def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
+    """Wrap a session pipeline in the resilience layer and register its
+    supervisor for /health.  Returns the pipeline unchanged when
+    supervision is disabled.  Must run on the event loop (starts the
+    output-age watchdog there)."""
+    if not env.get_bool("SUPERVISOR", True):
+        return pipeline
+    stats: FrameStats = app["stats"]
+    handler: StreamEventHandler = app["stream_event_handler"]
+    loop = asyncio.get_event_loop()
+
+    def resync():
+        # PLI-driven keyframe re-sync on recovery: force OUR encoder to
+        # IDR (viewers decode the first post-recovery frame) and ask the
+        # publisher for a fresh keyframe (our decoder re-syncs too)
+        force = getattr(pc, "_force_sink_keyframe", None)
+        if force is not None:
+            force()
+        proto = getattr(pc, "_recv_protocol", None)
+        if proto is not None:
+            proto._send_pli()
+
+    def on_transition(old, new, reason):
+        stats.count(f"supervisor_{new.lower()}")
+
+        def fire():
+            handler.handle_session_state(session_key, room_id, new, reason)
+
+        try:  # may fire from a worker thread — webhooks belong on the loop
+            loop.call_soon_threadsafe(fire)
+        except RuntimeError:
+            pass  # loop already closed (teardown race)
+
+    sup = SessionSupervisor(
+        session_key, resync=resync, on_transition=on_transition
+    )
+    wrapped = ResilientPipeline(pipeline, sup)
+    app.setdefault("supervisors", {})[session_key] = sup
+    sup.start_watchdog()
+    return wrapped
+
+
+def _end_supervision(app, session_key: str):
+    sup = app.get("supervisors", {}).pop(session_key, None)
+    if sup is not None:
+        sup.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -188,7 +246,10 @@ async def offer(request):
         def on_track(track):
             logger.info("Track received: %s", track.kind)
             if track.kind == "video":
-                video_track = VideoStreamTrack(track, _TimedPipeline(pipeline, stats))
+                supervised = _supervise_session(
+                    app, pc, _TimedPipeline(pipeline, stats), stream_id, room_id
+                )
+                video_track = VideoStreamTrack(track, supervised)
                 tracks["video"] = video_track
                 sender = pc.addTrack(video_track)
                 provider.force_codec(pc, sender, "video/H264")
@@ -204,10 +265,12 @@ async def offer(request):
                 await pc.close()
                 pcs.discard(pc)
                 release_pipeline()
+                _end_supervision(app, stream_id)
             elif pc.connectionState == "closed":
                 await pc.close()
                 pcs.discard(pc)
                 release_pipeline()
+                _end_supervision(app, stream_id)
                 stream_event_handler.handle_stream_ended(stream_id, room_id)
             elif pc.connectionState == "connected":
                 stream_event_handler.handle_stream_started(stream_id, room_id)
@@ -429,7 +492,10 @@ async def whip(request):
         def on_track(track):
             logger.info("Track received: %s", track.kind)
             if track.kind == "video":
-                vt = VideoStreamTrack(track, _TimedPipeline(pipeline, stats))
+                supervised = _supervise_session(
+                    app, pc, _TimedPipeline(pipeline, stats), session_id
+                )
+                vt = VideoStreamTrack(track, supervised)
                 app["state"].setdefault("whip_tracks", {})[session_id] = vt
                 app["state"]["source_track"] = vt  # latest publisher wins
                 # one relay per publisher SESSION: N WHEP viewers share the
@@ -456,6 +522,7 @@ async def whip(request):
                 app["state"].get("whip_pcs", {}).pop(session_id, None)
                 _refresh_source_track(app)
                 release_pipeline()
+                _end_supervision(app, session_id)
 
         await pc.setRemoteDescription(offer_sdp)
         await pc._RTCPeerConnection__gather()
@@ -505,6 +572,21 @@ async def health(_):
     return web.Response(content_type="application/json", text="OK")
 
 
+async def health_detail(request):
+    """Supervisor rollup: overall status is the worst live session state
+    (HEALTHY when idle); per-session snapshots carry the state machine's
+    recent transitions — the operator's first stop when a stream degrades
+    (docs/resilience.md maps each state to an action)."""
+    sups = request.app.get("supervisors", {})
+    sessions = {k: s.snapshot() for k, s in sups.items()}
+    return web.json_response(
+        {
+            "status": worst_state(s["state"] for s in sessions.values()),
+            "sessions": sessions,
+        }
+    )
+
+
 async def demo(_):
     """Self-contained browser client for the /offer path — the reference
     depends on a hosted web app for this (ref docs/connect.md:3-5)."""
@@ -534,6 +616,14 @@ class _TimedPipeline:
         if hasattr(pipeline, "submit_batch"):
             self.submit_batch = self._submit_batch
             self.fetch_batch = self._fetch_batch
+
+    def __getattr__(self, name):
+        # delegate the rest of the pipeline surface (restart(), control
+        # plane) — the hot-path methods are bound explicitly above so
+        # delegation can't bypass the timing wrap
+        if name == "_pipeline":  # not yet set — avoid recursion
+            raise AttributeError(name)
+        return getattr(self._pipeline, name)
 
     @property
     def frame_buffer_size(self) -> int:
@@ -658,6 +748,7 @@ async def on_startup(app):
             mesh=mesh,
         )
     app["pcs"] = set()
+    app["supervisors"] = {}
     app["stream_event_handler"] = StreamEventHandler()
     app["state"] = {
         "source_track": None,
@@ -675,6 +766,9 @@ async def on_startup(app):
 
 
 async def on_shutdown(app):
+    for sup in app.get("supervisors", {}).values():
+        sup.stop()
+    app.get("supervisors", {}).clear()
     pcs = app["pcs"]
     await asyncio.gather(*[pc.close() for pc in pcs])
     pcs.clear()
@@ -728,6 +822,7 @@ def build_app(
     app.router.add_post("/offer", offer)
     app.router.add_post("/config", update_config)
     app.router.add_get("/", health)
+    app.router.add_get("/health", health_detail)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/demo", demo)
     return app

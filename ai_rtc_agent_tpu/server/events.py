@@ -36,6 +36,24 @@ class StreamEndedEvent(WebhookEvent):
     event: str = "StreamEnded"
 
 
+class StreamDegradedEvent(WebhookEvent):
+    """Supervisor moved the session out of HEALTHY (resilience/supervisor):
+    ``state`` is the new state (DEGRADED or FAILED), ``reason`` the trigger.
+    The stream is still flowing — in passthrough — when state=DEGRADED."""
+
+    event: str = "StreamDegraded"
+    state: str = "DEGRADED"
+    reason: str = ""
+
+
+class StreamRecoveredEvent(WebhookEvent):
+    """Supervisor returned the session to HEALTHY after a degradation."""
+
+    event: str = "StreamRecovered"
+    state: str = "HEALTHY"
+    reason: str = ""
+
+
 class StreamEventHandler:
     def __init__(self, session_factory=None):
         self.webhook_url = env.get_str("WEBHOOK_URL")
@@ -43,13 +61,23 @@ class StreamEventHandler:
         self._session_factory = session_factory
         self._tasks: set = set()
 
-    def _event(self, event_name: str, stream_id: str, room_id: str) -> WebhookEvent:
-        cls = {"StreamStarted": StreamStartedEvent, "StreamEnded": StreamEndedEvent}.get(
-            event_name
-        )
+    def _event(
+        self, event_name: str, stream_id: str, room_id: str, **extra
+    ) -> WebhookEvent:
+        cls = {
+            "StreamStarted": StreamStartedEvent,
+            "StreamEnded": StreamEndedEvent,
+            "StreamDegraded": StreamDegradedEvent,
+            "StreamRecovered": StreamRecoveredEvent,
+        }.get(event_name)
         if cls is None:
             raise ValueError(f"unknown event: {event_name}")
-        return cls(stream_id=stream_id, room_id=room_id, timestamp=int(time.time()))
+        return cls(
+            stream_id=stream_id,
+            room_id=room_id,
+            timestamp=int(time.time()),
+            **extra,
+        )
 
     async def _post(self, event: WebhookEvent):
         import aiohttp
@@ -79,11 +107,11 @@ class StreamEventHandler:
         except Exception as e:
             logger.error("webhook %s failed: %s", event.event, e)
 
-    def send_request(self, event_name: str, stream_id: str, room_id: str):
+    def send_request(self, event_name: str, stream_id: str, room_id: str, **extra):
         """Fire-and-forget; returns the task (or None when unconfigured)."""
         if self.webhook_url is None or self.token is None:
             return None
-        ev = self._event(event_name, stream_id, room_id)
+        ev = self._event(event_name, stream_id, room_id, **extra)
         try:
             task = asyncio.get_running_loop().create_task(self._post(ev))
             self._tasks.add(task)
@@ -99,3 +127,14 @@ class StreamEventHandler:
 
     def handle_stream_ended(self, stream_id: str, room_id: str):
         return self.send_request("StreamEnded", stream_id, room_id)
+
+    def handle_session_state(
+        self, stream_id: str, room_id: str, state: str, reason: str
+    ):
+        """Supervisor transition -> webhook: non-HEALTHY states emit
+        StreamDegraded (state carries DEGRADED/RECOVERING/FAILED), a return
+        to HEALTHY emits StreamRecovered."""
+        name = "StreamRecovered" if state == "HEALTHY" else "StreamDegraded"
+        return self.send_request(
+            name, stream_id, room_id, state=state, reason=reason
+        )

@@ -140,7 +140,7 @@ class _RtcpState:
         self._rtx_in_window += 1
         return True
 
-    def on_rtcp(self, payload: bytes, resend) -> bool:
+    def on_rtcp(self, payload: bytes, resend, allow_wildcard_pli: bool = False) -> bool:
         """Handle one inbound compound RTCP datagram.  `resend` transmits a
         cached WIRE packet.  Returns True when the sender should IDR
         (PLI, or a NACK for packets that aged out of the cache).
@@ -152,11 +152,16 @@ class _RtcpState:
         force_idr = False
         for item in rtcp_mod.parse_compound(payload):
             if item["type"] == "pli":
-                # exact SSRC match only: a media_ssrc=0 wildcard would keep
-                # the forged-PLI door the filter exists to close open (code
-                # review r5); our own receive path PLIs with the publisher's
-                # real SSRC, and browsers always set it
-                if item.get("media_ssrc") == self.ssrc:
+                # Secure tier: exact SSRC match only — a media_ssrc=0
+                # wildcard would keep the forged-PLI door the filter exists
+                # to close open (code review r5).  Plain tier
+                # (allow_wildcard_pli): media_ssrc==0 is honored — it is
+                # what pre-r5 clients (and this repo's own media/rtp.py
+                # make_pli default) emit, and on an unauthenticated LAN
+                # socket the exact-match defense buys nothing while
+                # silently breaking legacy keyframe recovery (ADVICE r5).
+                m = item.get("media_ssrc")
+                if m == self.ssrc or (allow_wildcard_pli and not m):
                     force_idr = True
             elif item["type"] == "nack":
                 if item.get("media_ssrc") != self.ssrc:
@@ -219,6 +224,12 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         self._on_pli = on_pli
         self._last_addr = None
         self._last_pli_sent = 0.0
+        # fault injection hook (resilience/faults.py): None unless a plan
+        # targeting inbound datagrams is active — the disabled hot path
+        # costs exactly one is-None test
+        from ..resilience import faults as _faults
+
+        self._rx_faults = _faults.scope("rx")
         self._q: asyncio.Queue = asyncio.Queue(maxsize=256)
         self._task = asyncio.ensure_future(self._decode_loop())
         self._loop = asyncio.get_event_loop()
@@ -277,8 +288,18 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         return True
 
     def datagram_received(self, data, addr):
-        from ..media import rtp as R
+        if self._rx_faults is not None:
+            # injected loss/dup/reorder/delay/truncation (chaos testing);
+            # delayed copies re-enter via _ingest so they are not re-faulted
+            for d, delay in self._rx_faults.apply(data):
+                if delay > 0:
+                    self._loop.call_later(delay, self._ingest, d, addr)
+                else:
+                    self._ingest(d, addr)
+            return
+        self._ingest(data, addr)
 
+    def _ingest(self, data, addr):
         if self.session is not None:
             outs, kind, payload = self.session.handle(data, addr)
             for d, a in outs:
@@ -296,17 +317,33 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
             data = payload
             self._last_addr = self.session.peer_addr or addr
         else:
-            self._last_addr = addr
             if _looks_like_rtcp(data):
+                self._last_addr = addr
                 force = self._rtcp_state.on_rtcp(
-                    data, lambda w: self.transport.sendto(w, addr)
+                    data,
+                    lambda w: self.transport.sendto(w, addr),
+                    allow_wildcard_pli=True,  # plain tier: legacy peers
                 )
                 if force and self._on_pli is not None:
                     self._on_pli()
                 return
-        if len(data) >= 12:
-            self._last_rx_ssrc = int.from_bytes(data[8:12], "big")
-            self._rtcp_state.recv.received(data)
+        # RTP version gate (ADVICE r5): a stray non-RTP datagram (probe,
+        # junk aimed at the open port) must not lock ReceiverStats onto a
+        # bogus SSRC, point PLIs at garbage, redirect the PLI return
+        # address, or reach the depacketizer
+        if len(data) < 12 or (data[0] >> 6) != 2:
+            return
+        if self.session is None:
+            # plain tier: trust the source address only once the datagram
+            # proved RTP-shaped (the secure tier latches via ICE instead)
+            self._last_addr = addr
+        self._rtcp_state.recv.received(data)
+        # PLIs name the stream the stats are LOCKED on (which re-locks if
+        # the locked stream goes silent — rtcp.ReceiverStats), not blindly
+        # the last datagram's SSRC
+        self._last_rx_ssrc = self._rtcp_state.recv.ssrc or int.from_bytes(
+            data[8:12], "big"
+        )
         try:
             # reorder + depacketize inline (microseconds); queue only
             # COMPLETED access units so the worker hop is per frame
@@ -349,8 +386,10 @@ class _PliListenerProtocol(asyncio.DatagramProtocol):
     def datagram_received(self, data, addr):
         if self.transport is None:
             return
+        # plain-tier return channel: wildcard (media_ssrc=0) PLIs are
+        # honored — legacy/LAN clients emit them (ADVICE r5)
         force = self._rtcp_state.on_rtcp(
-            data, lambda w: self.transport.sendto(w)
+            data, lambda w: self.transport.sendto(w), allow_wildcard_pli=True
         )
         if force:
             self._on_pli()
@@ -463,7 +502,16 @@ class NativeRtpPeerConnection:
                         "only sha-256 DTLS fingerprints are supported "
                         f"(offer used {offer.fingerprint_algo!r})"
                     )
-                from .secure import SecureMediaSession
+                try:
+                    from .secure import SecureMediaSession
+                except ImportError as e:
+                    # no crypto backend on this box: a clean 400 with the
+                    # reason beats a 500 mid-handshake (the session could
+                    # never complete DTLS anyway)
+                    raise ValueError(
+                        "offer requires the encrypted tier but its crypto "
+                        f"backend is unavailable ({e})"
+                    ) from e
 
                 self._secure_session = SecureMediaSession(
                     certificate=self._provider.dtls_certificate,

@@ -12,9 +12,33 @@ framework implements the three protocols itself on top of the
                  RFC 5705 keying-material exporter
   * srtp.py      RFC 3711 SRTP/SRTCP, AES128_CM_HMAC_SHA1_80
   * endpoint.py  RFC 7983 demux glueing the three onto one UDP socket
+  * sctp.py      RFC 9260 subset + DCEP datachannels (pure stdlib)
+
+Exports resolve lazily (PEP 562): importing the crypto-free members
+(``sctp``) or probing for availability must not explode on a box without
+``cryptography`` — the signaling tier degrades to loopback there instead
+of dying at import (resilience PR; previously 8 test files failed at
+COLLECTION on such boxes).
 """
 
-from .stun import StunMessage, IceLiteResponder  # noqa: F401
-from .srtp import SrtpContext, derive_srtp_contexts  # noqa: F401
-from .dtls import DtlsEndpoint, generate_certificate  # noqa: F401
-from .endpoint import SecureMediaSession, classify  # noqa: F401
+_EXPORTS = {
+    "StunMessage": "stun",
+    "IceLiteResponder": "stun",
+    "SrtpContext": "srtp",
+    "derive_srtp_contexts": "srtp",
+    "DtlsEndpoint": "dtls",
+    "generate_certificate": "dtls",
+    "SecureMediaSession": "endpoint",
+    "classify": "endpoint",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

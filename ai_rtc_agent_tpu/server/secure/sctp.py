@@ -400,6 +400,18 @@ class SctpAssociation:
     def _on_init(self, value: bytes) -> list:
         if len(value) < 16:
             return []
+        if self.established:
+            # Retransmitted/duplicate INIT on a live association (RFC 9260
+            # s5.2.2): answer with the EXISTING tag and cookie, mutating
+            # nothing — resetting _peer_tag/_cum_in here would silently
+            # desync TSN tracking of the established association (ADVICE
+            # r5).
+            if self._cookie is None:
+                return []
+            params = self._init_params() + self._chunk_param(
+                PARAM_STATE_COOKIE, self._cookie
+            )
+            return [self._packet(self._chunk(CT_INIT_ACK, 0, params))]
         peer_tag, _rwnd, _os, _mis, peer_tsn = struct.unpack_from("!IIHHI", value, 0)
         self._peer_tag = peer_tag
         self._cum_in = (peer_tsn - 1) & 0xFFFFFFFF

@@ -11,6 +11,7 @@ from __future__ import annotations
 import base64
 import logging
 
+from ..resilience.retry import RetryError, transient_policy
 from ..utils import env
 
 logger = logging.getLogger(__name__)
@@ -18,11 +19,18 @@ logger = logging.getLogger(__name__)
 TWILIO_TOKEN_URL = "https://api.twilio.com/2010-04-01/Accounts/{sid}/Tokens.json"
 
 
+class _TransientHttp(Exception):
+    """5xx / transport trouble — worth another try under backoff."""
+
+
 def get_twilio_token(http_post=None):
     """POST /Tokens.json with basic auth; returns parsed token dict or None.
 
     ``http_post(url, headers) -> (status, json_dict)`` is injectable for
-    tests; default implementation uses requests.
+    tests; default implementation uses requests.  Transient failures
+    (exceptions, 5xx) retry under the shared backoff policy
+    (resilience/retry.py); 4xx fails immediately — credentials won't get
+    better by waiting.
     """
     sid = env.get_str("TWILIO_ACCOUNT_SID")
     auth = env.get_str("TWILIO_AUTH_TOKEN")
@@ -39,15 +47,25 @@ def get_twilio_token(http_post=None):
             r = requests.post(u, headers=h, timeout=10)
             return r.status_code, r.json()
 
-    try:
-        status, body = http_post(url, headers)
-    except Exception as e:
-        logger.error("twilio token request failed: %s", e)
-        return None
-    if status not in (200, 201):
+    def fetch():
+        try:
+            status, body = http_post(url, headers)
+        except Exception as e:
+            raise _TransientHttp(str(e)) from e
+        if status in (200, 201):
+            return body
+        if status >= 500:
+            raise _TransientHttp(f"twilio returned {status}")
         logger.error("twilio token request returned %s", status)
         return None
-    return body
+
+    try:
+        return transient_policy(attempts=3).run(
+            fetch, retry_on=(_TransientHttp,), label="twilio token"
+        )
+    except RetryError as e:
+        logger.error("twilio token request failed: %s", e.last)
+        return None
 
 
 def get_ice_servers(http_post=None) -> list[dict]:

@@ -27,34 +27,44 @@ import time
 import urllib.error
 import urllib.request
 
+from ..resilience.retry import poll_policy, transient_policy
 from ..utils import env
 
 logger = logging.getLogger(__name__)
 
 HEALTH_BUDGET_S = 60  # reference runpod/handler.py gives the agent 60s
 POLL_INTERVAL_S = 1.0
+PUBLISH_ATTEMPTS = 3
 
 
 def check_server(url: str, budget_s: float = HEALTH_BUDGET_S) -> bool:
     """Poll the agent health endpoint until OK or budget exhausted
-    (reference check_server, runpod/handler.py:11-27)."""
-    deadline = time.monotonic() + budget_s
-    while time.monotonic() < deadline:
-        try:
-            with urllib.request.urlopen(url, timeout=2) as r:
-                if r.status == 200:
-                    logger.info("agent is up at %s", url)
-                    return True
-        except (urllib.error.URLError, OSError):
-            pass
-        time.sleep(POLL_INTERVAL_S)
-    logger.error("agent did not come up within %.0fs", budget_s)
-    return False
+    (reference check_server, runpod/handler.py:11-27) — the unified
+    retry helper owns the schedule (resilience/retry.py)."""
+
+    def probe():
+        with urllib.request.urlopen(url, timeout=2) as r:
+            if r.status != 200:
+                raise OSError(f"health returned {r.status}")
+        return True
+
+    ok = poll_policy(budget_s, POLL_INTERVAL_S).run(
+        probe,
+        retry_on=(urllib.error.URLError, OSError),
+        default=False,
+        label="agent health",
+    )
+    if ok:
+        logger.info("agent is up at %s", url)
+    else:
+        logger.error("agent did not come up within %.0fs", budget_s)
+    return ok
 
 
 def default_publish(info: dict) -> bool:
     """POST connection info to WORKER_PUBLISH_URL (Bearer AUTH_TOKEN) —
-    the generic analog of Runpod's progress_update.  Returns success."""
+    the generic analog of Runpod's progress_update.  Retries transient
+    failures under the shared backoff policy; returns success."""
     url = env.get_str("WORKER_PUBLISH_URL")
     if not url:
         logger.info("no WORKER_PUBLISH_URL; connection info: %s", info)
@@ -71,13 +81,23 @@ def default_publish(info: dict) -> bool:
             ),
         },
     )
-    try:
+
+    def post():
         with urllib.request.urlopen(req, timeout=5) as r:
+            if not 200 <= r.status < 300:
+                raise OSError(f"publish returned {r.status}")
             logger.info("published worker info (%d)", r.status)
-            return 200 <= r.status < 300
-    except (urllib.error.URLError, OSError) as e:
-        logger.warning("worker publish failed: %s", e)
-        return False
+        return True
+
+    ok = transient_policy(attempts=PUBLISH_ATTEMPTS).run(
+        post,
+        retry_on=(urllib.error.URLError, OSError),
+        default=False,
+        label="worker publish",
+    )
+    if not ok:
+        logger.warning("worker publish failed after %d attempts", PUBLISH_ATTEMPTS)
+    return ok
 
 
 def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:

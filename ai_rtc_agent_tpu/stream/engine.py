@@ -628,6 +628,12 @@ class StreamEngine:
         self._skip_count = 0
         self._last_out = None
         self._last_submitted = None
+        # compute-path fault injection (resilience/faults.py): None unless
+        # a plan targeting the engine is active — disabled injection costs
+        # one is-None test per submit
+        from ..resilience import faults as _faults
+
+        self._fault_scope = _faults.scope("engine")
         self._prev_frame_small = None
         self._skip_rng = np.random.default_rng(0)  # similarity-filter draws
         # submit() is a read-modify-write of self.state; concurrent tracks
@@ -810,6 +816,21 @@ class StreamEngine:
         """
         if self.state is None:
             raise RuntimeError("call prepare() first")
+        if self._fault_scope is not None:
+            # injected slow step (blocks this worker thread, simulating a
+            # wedged device dispatch), DeviceLostError, or NaN output —
+            # BEFORE the lock so an injected stall doesn't also wedge
+            # concurrent control-plane updates
+            action = self._fault_scope.step()
+            if action == "nan":
+                h, w = self.cfg.height, self.cfg.width
+                shape = (
+                    (h, w, 3)
+                    if frame_u8.ndim == 3
+                    else (frame_u8.shape[0], h, w, 3)
+                )
+                poisoned = np.full(shape, np.nan, np.float32)
+                return ("fault", poisoned, frame_u8.ndim == 3)
         with self._submit_lock:
             if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
                 # skip the device step entirely: the handle DUPLICATES the

@@ -109,6 +109,7 @@ class StreamDiffusionPipeline:
             return eng
 
         self.t_index_list = list(cfg.t_index_list)
+        self._seed = seed
         self.engine = build(cfg)
         cfg = self._probe_pallas_fallback(cfg, build)
         self.config = cfg
@@ -184,6 +185,37 @@ class StreamDiffusionPipeline:
         self.engine(probe)  # a failure here is structural: let it raise
         _finish_probe(self.engine)
         return safe_cfg
+
+    # -- recovery (resilience/supervisor.py restart hook) --------------------
+
+    def restart(self):
+        """Re-prepare the engine in place: a fresh stream state (clearing
+        poisoned latents / desynced ring state after a fault) on the SAME
+        compiled executables — seconds, not the minutes a full rebuild
+        costs.  Takes the submit lock (bounded) so a late in-flight step
+        can't clobber the fresh state with a stale one."""
+        lock = self.engine._submit_lock
+        got = lock.acquire(timeout=10.0)
+        if not got:
+            # a wedged step still holds the dispatch lock: preparing
+            # UNLOCKED would let its eventual state write clobber the fresh
+            # state — fail this attempt and let the supervisor's RetryPolicy
+            # come back when the lock is free (or give up -> FAILED)
+            raise RuntimeError(
+                "engine restart blocked: submit lock still held by a "
+                "wedged step"
+            )
+        try:
+            # prepare() rebuilds coefficients from the engine's tracked
+            # t_index_list, so runtime t-index updates survive the restart
+            self.engine.prepare(
+                prompt=self.prompt,
+                guidance_scale=DEFAULT_GUIDANCE_SCALE,
+                delta=DEFAULT_DELTA,
+                seed=self._seed,
+            )
+        finally:
+            lock.release()
 
     # -- control plane (reference lib/pipeline.py:44-48) --------------------
 

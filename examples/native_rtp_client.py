@@ -21,17 +21,29 @@ import argparse
 import asyncio
 import json
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
 
 from ai_rtc_agent_tpu.media.rtp_client import NativeRtpClient
+from ai_rtc_agent_tpu.resilience.retry import transient_policy
 
 
 def _post(url: str, body: bytes, ctype: str) -> bytes:
-    req = urllib.request.Request(url, data=body, headers={"Content-Type": ctype})
-    with urllib.request.urlopen(req, timeout=30) as r:
-        return r.read()
+    """Signaling POST with the shared reconnect/backoff policy — an agent
+    mid-restart answers the retry instead of killing the client."""
+
+    def once() -> bytes:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": ctype}
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.read()
+
+    return transient_policy(attempts=5, base_delay_s=1.0).run(
+        once, retry_on=(urllib.error.URLError, OSError), label=f"POST {url}"
+    )
 
 
 async def main():

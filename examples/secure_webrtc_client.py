@@ -24,6 +24,7 @@ import asyncio
 import json
 import re
 import sys
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, ".")
@@ -100,7 +101,16 @@ async def run(
         ).encode(),
         headers={"Content-Type": "application/json"},
     )
-    answer = json.loads(urllib.request.urlopen(req, timeout=15).read())["sdp"]
+    from ai_rtc_agent_tpu.resilience.retry import transient_policy
+
+    # signaling rides the shared reconnect policy: an agent mid-restart or
+    # a transient network blip answers the retry instead of aborting the run
+    body = transient_policy(attempts=5, base_delay_s=1.0).run(
+        lambda: urllib.request.urlopen(req, timeout=15).read(),
+        retry_on=(urllib.error.URLError, OSError),
+        label="POST /offer",
+    )
+    answer = json.loads(body)["sdp"]
     m = re.search(r"^m=video (\d+) UDP/TLS/RTP/SAVPF", answer, re.M)
     if not m:
         print("agent did not answer with a secure media section:\n" + answer)

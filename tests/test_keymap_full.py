@@ -38,6 +38,7 @@ def _roundtrip(params, km):
     return out
 
 
+@pytest.mark.slow  # full-geometry UNet builds: ~2.5 min on the 1-core box
 @pytest.mark.parametrize("fam", ["sd15", "sd21", "sdxl"])
 def test_unet_keymap_full_geometry(fam):
     cfg = getattr(U.UNetConfig, fam)()

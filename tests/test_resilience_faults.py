@@ -1,0 +1,277 @@
+"""Fault injection (resilience/faults.py): deterministic seeded plans,
+window gating, media-path hooks, engine-path hooks, and the zero-cost-
+when-disabled guarantee.  No wall-clock sleeps — injected sleep fns."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.resilience import faults
+from ai_rtc_agent_tpu.resilience.faults import (
+    DeviceLostError,
+    FaultPlan,
+    FaultSpec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# plan parsing + determinism
+# ---------------------------------------------------------------------------
+
+def test_plan_json_roundtrip_and_validation():
+    plan = FaultPlan.from_json(
+        json.dumps(
+            {
+                "seed": 11,
+                "faults": [
+                    {"target": "rx", "kind": "drop", "p": 0.5, "start": 2, "stop": 6},
+                    {"target": "engine", "kind": "nan", "start": 1, "stop": 2},
+                ],
+            }
+        )
+    )
+    assert plan.seed == 11
+    assert len(plan.specs) == 2
+    assert plan.for_target("rx")[0].kind == "drop"
+    with pytest.raises(ValueError):
+        FaultSpec(target="rx", kind="nan")  # engine kind on a net target
+    with pytest.raises(ValueError):
+        FaultSpec(target="bogus", kind="drop")
+    with pytest.raises(ValueError):
+        FaultSpec(target="rx", kind="drop", p=1.5)
+
+
+def test_seeded_plan_replays_identically():
+    plan = FaultPlan(
+        specs=(FaultSpec(target="rx", kind="drop", p=0.3),), seed=42
+    )
+
+    def run():
+        faults.activate(plan)
+        s = faults.scope("rx")
+        return [len(s.apply(bytes([i % 256]) * 16)) for i in range(200)]
+
+    assert run() == run()
+
+
+def test_window_gating_exact():
+    plan = FaultPlan(
+        specs=(FaultSpec(target="rx", kind="drop", p=1.0, start=3, stop=6),),
+        seed=0,
+    )
+    faults.activate(plan)
+    s = faults.scope("rx")
+    kept = [len(s.apply(b"p" * 16)) for i in range(10)]
+    # packets 3,4,5 dropped, everything else passes
+    assert kept == [1, 1, 1, 0, 0, 0, 1, 1, 1, 1]
+    assert s.stats["drop"] == 3
+
+
+def test_dup_delay_truncate_reorder_transforms():
+    faults.activate(
+        FaultPlan(specs=(FaultSpec(target="rx", kind="dup", p=1.0),), seed=0)
+    )
+    s = faults.scope("rx")
+    out = s.apply(b"abc")
+    assert [d for d, _ in out] == [b"abc", b"abc"]
+
+    faults.activate(
+        FaultPlan(
+            specs=(FaultSpec(target="rx", kind="delay", p=1.0, delay_s=0.2),),
+            seed=0,
+        )
+    )
+    s = faults.scope("rx")
+    ((d, delay),) = s.apply(b"abc")
+    assert d == b"abc" and delay == pytest.approx(0.2)
+
+    faults.activate(
+        FaultPlan(
+            specs=(FaultSpec(target="rx", kind="truncate", p=1.0, keep=2),),
+            seed=0,
+        )
+    )
+    s = faults.scope("rx")
+    assert s.apply(b"abcdef")[0][0] == b"ab"
+
+    # reorder: pkt0 held, released after pkt1 — order on the wire is 1, 0
+    faults.activate(
+        FaultPlan(
+            specs=(FaultSpec(target="rx", kind="reorder", p=1.0, stop=1),),
+            seed=0,
+        )
+    )
+    s = faults.scope("rx")
+    assert s.apply(b"first") == []
+    out = s.apply(b"second")
+    assert [d for d, _ in out] == [b"second", b"first"]
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off
+# ---------------------------------------------------------------------------
+
+def test_disabled_injection_is_free():
+    """No active plan -> scope() is None, so hook sites carry exactly one
+    is-None test and never touch fault code."""
+    assert faults.active() is None
+    assert faults.scope("rx") is None
+    assert faults.scope("tx") is None
+    assert faults.scope("engine") is None
+    # a plan with only engine faults keeps the media hooks free too
+    faults.activate(
+        FaultPlan(specs=(FaultSpec(target="engine", kind="nan"),), seed=0)
+    )
+    assert faults.scope("rx") is None
+    assert faults.scope("engine") is not None
+
+
+def test_rtp_receiver_hook_absent_when_disabled():
+    from ai_rtc_agent_tpu.server.rtc_native import (
+        _RtcpState,
+        _RtpReceiverProtocol,
+    )
+
+    class FakeSource:
+        def __init__(self):
+            self.fed = []
+
+        def depacketize(self, pkt):
+            self.fed.append(pkt)
+            return []
+
+        def on(self, *a, **k):
+            pass
+
+    async def go():
+        proto = _RtpReceiverProtocol(FakeSource(), _RtcpState())
+        assert proto._rx_faults is None  # zero-cost path
+        proto.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# media-path hook (server receive socket)
+# ---------------------------------------------------------------------------
+
+def _rtp_packet(seq: int, ssrc: int = 0xABC, pt: int = 96) -> bytes:
+    return bytes(
+        [0x80, pt, (seq >> 8) & 0xFF, seq & 0xFF]
+    ) + (0).to_bytes(4, "big") + ssrc.to_bytes(4, "big") + b"payload"
+
+
+def test_rtp_receiver_drop_burst_is_deterministic():
+    from ai_rtc_agent_tpu.server.rtc_native import (
+        _RtcpState,
+        _RtpReceiverProtocol,
+    )
+
+    class FakeSource:
+        def __init__(self):
+            self.fed = []
+
+        def depacketize(self, pkt):
+            self.fed.append(pkt)
+            return []
+
+        def on(self, *a, **k):
+            pass
+
+    faults.activate(
+        FaultPlan(
+            specs=(FaultSpec(target="rx", kind="drop", p=1.0, start=5, stop=10),),
+            seed=9,
+        )
+    )
+
+    async def go():
+        src = FakeSource()
+        proto = _RtpReceiverProtocol(src, _RtcpState())
+        for i in range(20):
+            proto.datagram_received(_rtp_packet(i), ("127.0.0.1", 1))
+        proto.close()
+        return src.fed
+
+    fed = asyncio.run(go())
+    assert len(fed) == 15  # 5 packets of the burst never reached the stack
+    seqs = [(p[2] << 8) | p[3] for p in fed]
+    assert seqs == [0, 1, 2, 3, 4, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19]
+
+
+# ---------------------------------------------------------------------------
+# engine-path hook
+# ---------------------------------------------------------------------------
+
+def _tiny_engine():
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config("tiny-test")
+    eng = StreamEngine(
+        models=bundle.stream_models,
+        params=bundle.params,
+        cfg=cfg,
+        encode_prompt=bundle.encode_prompt,
+    )
+    eng.prepare("chaos", seed=0)
+    return eng
+
+
+def test_engine_nan_fault_yields_non_finite_output():
+    faults.activate(
+        FaultPlan(
+            specs=(FaultSpec(target="engine", kind="nan", start=1, stop=2),),
+            seed=0,
+        )
+    )
+    eng = _tiny_engine()
+    frame = np.zeros((64, 64, 3), np.uint8)
+    out0 = eng(frame)
+    assert out0.dtype == np.uint8  # step 0 clean
+    out1 = eng(frame)
+    assert out1.dtype.kind == "f" and not np.isfinite(out1).all()
+    out2 = eng(frame)
+    assert out2.dtype == np.uint8  # window closed
+
+
+def test_engine_device_lost_fault_raises():
+    faults.activate(
+        FaultPlan(
+            specs=(FaultSpec(target="engine", kind="device_lost", start=0),),
+            seed=0,
+        )
+    )
+    eng = _tiny_engine()
+    with pytest.raises(DeviceLostError):
+        eng(np.zeros((64, 64, 3), np.uint8))
+
+
+def test_engine_slow_step_uses_injected_sleep():
+    from ai_rtc_agent_tpu.resilience.faults import EngineFaultScope
+
+    slept = []
+    scope = EngineFaultScope(
+        (FaultSpec(target="engine", kind="slow_step", delay_s=2.5),),
+        __import__("random").Random(0),
+        sleep=slept.append,
+    )
+    assert scope.step() == "slow_step"
+    assert slept == [2.5]
+
+
+def test_engine_without_plan_has_no_scope():
+    eng = _tiny_engine()
+    assert eng._fault_scope is None
+    out = eng(np.zeros((64, 64, 3), np.uint8))
+    assert out.dtype == np.uint8

@@ -1,0 +1,155 @@
+"""Unified retry/backoff policy (resilience/retry.py) — deterministic,
+injected clocks only, no wall-time sleeps."""
+
+import asyncio
+import random
+
+import pytest
+
+from ai_rtc_agent_tpu.resilience.retry import (
+    RetryError,
+    RetryPolicy,
+    poll_policy,
+    transient_policy,
+)
+
+
+def test_backoff_schedule_grows_and_caps():
+    p = RetryPolicy(
+        attempts=10, base_delay_s=1.0, multiplier=2.0, max_delay_s=8.0, jitter=0.0
+    )
+    g = p.delays()
+    assert [next(g) for _ in range(6)] == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+def test_jitter_is_bounded_and_seeded():
+    p = RetryPolicy(attempts=3, base_delay_s=1.0, jitter=0.2)
+    a = [next(p.delays(random.Random(7))) for _ in range(1)]
+    b = [next(p.delays(random.Random(7))) for _ in range(1)]
+    assert a == b  # same seed, same schedule
+    for _ in range(100):
+        d = next(p.delays(random.Random()))
+        assert 0.8 <= d <= 1.2
+
+
+def test_run_retries_then_succeeds():
+    calls = []
+    slept = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(attempts=5, base_delay_s=0.5, jitter=0.0)
+    out = p.run(fn, sleep=slept.append)
+    assert out == "ok"
+    assert len(calls) == 3
+    assert slept == [0.5, 1.0]
+
+
+def test_run_exhausts_raises_retryerror_with_cause():
+    def fn():
+        raise ValueError("nope")
+
+    p = RetryPolicy(attempts=3, base_delay_s=0.1, jitter=0.0)
+    with pytest.raises(RetryError) as ei:
+        p.run(fn, sleep=lambda s: None)
+    assert isinstance(ei.value.last, ValueError)
+
+
+def test_run_default_instead_of_raise():
+    p = RetryPolicy(attempts=2, base_delay_s=0.1, jitter=0.0)
+    out = p.run(lambda: 1 / 0, retry_on=(ZeroDivisionError,),
+                sleep=lambda s: None, default="fallback")
+    assert out == "fallback"
+
+
+def test_non_retryable_exception_propagates_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise KeyError("fatal")
+
+    p = RetryPolicy(attempts=5, base_delay_s=0.1, jitter=0.0)
+    with pytest.raises(KeyError):
+        p.run(fn, retry_on=(OSError,), sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_deadline_stops_unbounded_poll():
+    """poll_policy: fixed interval, deadline-bound — the health-poll shape."""
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        now[0] += s
+
+    calls = []
+
+    def fn():
+        calls.append(now[0])
+        raise OSError("still down")
+
+    p = poll_policy(budget_s=5.0, interval_s=1.0)
+    out = p.run(fn, sleep=sleep, clock=clock, default=False)
+    assert out is False
+    # one probe per second until the budget: no backoff growth
+    assert calls == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_deadline_clamps_final_sleep():
+    now = [0.0]
+    slept = []
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        slept.append(s)
+        now[0] += s
+
+    p = RetryPolicy(
+        attempts=None, base_delay_s=10.0, multiplier=1.0, jitter=0.0, deadline_s=4.0
+    )
+    p.run(lambda: (_ for _ in ()).throw(OSError()), sleep=sleep, clock=clock,
+          default=None)
+    assert slept == [4.0]  # clamped to the remaining budget, then stop
+
+
+def test_unbounded_requires_deadline():
+    with pytest.raises(ValueError):
+        RetryPolicy(attempts=None)
+
+
+def test_arun_async_retry():
+    calls = []
+
+    async def fn():
+        calls.append(1)
+        if len(calls) < 2:
+            raise OSError("transient")
+        return 42
+
+    async def go():
+        p = transient_policy(attempts=3, base_delay_s=0.001)
+        return await p.arun(fn)
+
+    assert asyncio.run(go()) == 42
+    assert len(calls) == 2
+
+
+def test_on_retry_observability_hook():
+    seen = []
+    p = RetryPolicy(attempts=3, base_delay_s=0.5, jitter=0.0)
+    p.run(
+        lambda: (_ for _ in ()).throw(OSError("x")),
+        sleep=lambda s: None,
+        on_retry=lambda i, exc, d: seen.append((i, type(exc).__name__, d)),
+        default=None,
+    )
+    assert seen == [(1, "OSError", 0.5), (2, "OSError", 1.0)]

@@ -1,0 +1,277 @@
+"""Session supervisor + ResilientPipeline (resilience/supervisor.py):
+state machine transitions, passthrough degradation, background recovery,
+watchdog ticks — driven with injected clocks and tiny timeouts so the
+whole file runs in a few seconds of wall time."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.resilience.faults import DeviceLostError
+from ai_rtc_agent_tpu.resilience.supervisor import (
+    DEGRADED,
+    FAILED,
+    HEALTHY,
+    RECOVERING,
+    ResilientPipeline,
+    SessionSupervisor,
+    worst_state,
+)
+
+
+class ScriptedPipeline:
+    """Pipeline whose per-call behavior is a script: numbers are sleeps,
+    exceptions raise, 'nan' returns a poisoned frame, None is a clean step."""
+
+    def __init__(self, script=()):
+        self.script = list(script)
+        self.calls = 0
+        self.restarts = 0
+
+    def __call__(self, frame):
+        self.calls += 1
+        action = self.script.pop(0) if self.script else None
+        if action is None:
+            return 255 - frame
+        if isinstance(action, (int, float)):
+            time.sleep(action)
+            return 255 - frame
+        if action == "nan":
+            return np.full(frame.shape, np.nan, np.float32)
+        raise action
+
+    def restart(self):
+        self.restarts += 1
+
+
+def _sup(**kw):
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("probe_interval_s", 0.0)
+    return SessionSupervisor("test-session", **kw)
+
+
+def _rp(pipe, sup, timeout=0.2):
+    return ResilientPipeline(
+        pipe, sup, step_timeout_s=timeout, first_step_timeout_s=timeout
+    )
+
+
+FRAME = np.zeros((4, 4, 3), np.uint8)
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_healthy_steps_pass_through_engine():
+    pipe = ScriptedPipeline()
+    sup = _sup()
+    rp = _rp(pipe, sup)
+    out = rp(FRAME)
+    assert out.max() == 255  # inverted — the engine ran
+    assert sup.state == HEALTHY
+    assert sup.processed_frames == 1
+
+
+def test_stall_degrades_to_passthrough_and_recovers():
+    pipe = ScriptedPipeline(script=[10.0])  # first step wedges
+    sup = _sup(healthy_after=2)
+    rp = _rp(pipe, sup, timeout=0.05)
+
+    out = rp(FRAME)
+    # stream did NOT freeze: the source frame came back instead
+    assert out is FRAME
+    assert sup.state in (DEGRADED, RECOVERING)
+    assert sup.passthrough_frames == 1
+
+    # background restart (pipe.restart) completes -> RECOVERING
+    assert _wait_for(lambda: sup.state == RECOVERING)
+    assert pipe.restarts == 1
+
+    # healthy steps climb back to HEALTHY
+    rp(FRAME)
+    out = rp(FRAME)
+    assert sup.state == HEALTHY
+    assert out.max() == 255
+
+
+def test_error_burst_triggers_recovery_single_error_does_not():
+    pipe = ScriptedPipeline(
+        script=[RuntimeError("x"), None, RuntimeError("a"),
+                RuntimeError("b"), RuntimeError("c")]
+    )
+    sup = _sup(error_burst=3, healthy_after=1)
+    rp = _rp(pipe, sup)
+    assert rp(FRAME) is FRAME  # error 1 -> passthrough, still HEALTHY
+    assert sup.state == HEALTHY
+    rp(FRAME)  # clean step resets the burst counter
+    assert sup.state == HEALTHY
+    for _ in range(3):
+        rp(FRAME)
+    assert sup.state in (DEGRADED, RECOVERING)
+    assert _wait_for(lambda: pipe.restarts >= 1)
+
+
+def test_device_lost_degrades_immediately():
+    pipe = ScriptedPipeline(script=[DeviceLostError("gone")])
+    sup = _sup()
+    rp = _rp(pipe, sup)
+    assert rp(FRAME) is FRAME
+    assert sup.state in (DEGRADED, RECOVERING)
+
+
+def test_nan_output_counts_as_step_error():
+    pipe = ScriptedPipeline(script=["nan", "nan", "nan"])
+    sup = _sup(error_burst=3)
+    rp = _rp(pipe, sup)
+    for _ in range(3):
+        out = rp(FRAME)
+        assert out is FRAME  # poisoned frames never reach the encoder
+    assert sup.state in (DEGRADED, RECOVERING)
+
+
+def test_restart_budget_exhaustion_fails_session_but_stream_flows():
+    class AlwaysBroken:
+        def __call__(self, frame):
+            raise RuntimeError("dead engine")
+
+        def restart(self):
+            raise RuntimeError("restart also dead")
+
+    sup = _sup(error_burst=1, max_restarts=2)
+    rp = _rp(AlwaysBroken(), sup)
+    rp(FRAME)
+    assert _wait_for(lambda: sup.state == FAILED)
+    # FAILED is terminal for the engine, not the stream
+    out = rp(FRAME)
+    assert out is FRAME
+    assert sup.snapshot()["state"] == FAILED
+
+
+def test_watchdog_detects_output_stall_and_fires_resync():
+    now = [0.0]
+    resyncs = []
+    sup = SessionSupervisor(
+        "wd",
+        stall_after_s=2.0,
+        clock=lambda: now[0],
+        resync=lambda: resyncs.append(now[0]),
+    )
+    sup.note_frame_out()
+    assert sup.check(now[0]) == HEALTHY
+    now[0] = 1.0
+    assert sup.check() == HEALTHY
+    now[0] = 3.5  # frame age 3.5s > 2s
+    assert sup.check() == DEGRADED
+    assert resyncs == [3.5]
+    # frames resume -> probe succeeds -> RECOVERING -> HEALTHY
+    sup.on_step_ok()
+    assert sup.state == RECOVERING
+    for _ in range(3):
+        sup.on_step_ok()
+    assert sup.state == HEALTHY
+
+
+def test_transitions_are_observable():
+    seen = []
+    pipe = ScriptedPipeline(script=[10.0])
+    sup = _sup(on_transition=lambda a, b, r: seen.append((a, b)),
+               healthy_after=1)
+    rp = _rp(pipe, sup, timeout=0.05)
+    rp(FRAME)
+    assert _wait_for(lambda: sup.state == RECOVERING)
+    rp(FRAME)
+    assert (HEALTHY, DEGRADED) in seen
+    assert (DEGRADED, RECOVERING) in seen
+    assert (RECOVERING, HEALTHY) in seen
+    snap = sup.snapshot()
+    assert snap["restarts"] == 1
+    assert len(snap["transitions"]) >= 3
+
+
+def test_pipelined_surface_passthrough_on_stall():
+    class PipelinedStall:
+        def __init__(self):
+            self.stall = False
+
+        def submit(self, frame):
+            return ("h", frame)
+
+        def fetch(self, handle, src=None):
+            if self.stall:
+                time.sleep(10.0)
+            return 255 - handle[1]
+
+        def restart(self):
+            self.stall = False
+
+    inner = PipelinedStall()
+    sup = _sup(healthy_after=1)
+    rp = _rp(inner, sup, timeout=0.05)
+    h = rp.submit(FRAME)
+    assert rp.fetch(h, FRAME).max() == 255
+    inner.stall = True
+    h = rp.submit(FRAME)
+    out = rp.fetch(h, FRAME)
+    assert out is FRAME  # stalled fetch -> source frame, stream alive
+    assert sup.state in (DEGRADED, RECOVERING)
+    assert _wait_for(lambda: sup.state == RECOVERING)
+    h = rp.submit(FRAME)
+    assert rp.fetch(h, FRAME).max() == 255
+    assert sup.state == HEALTHY
+
+
+def test_resync_marshalled_to_loop_when_bound():
+    import asyncio
+
+    fired = {}
+
+    async def go():
+        sup = SessionSupervisor(
+            "loop-bound",
+            resync=lambda: fired.setdefault(
+                "thread", threading.current_thread().name
+            ),
+        )
+        sup.start_watchdog()
+        # resync requested from a worker thread must land on the loop
+        t = threading.Thread(target=sup._fire_resync)
+        t.start()
+        t.join()
+        await asyncio.sleep(0.05)
+        sup.stop()
+
+    asyncio.run(go())
+    assert fired["thread"] == "MainThread"
+
+
+def test_worst_state_rollup():
+    assert worst_state([]) == HEALTHY
+    assert worst_state([HEALTHY, RECOVERING]) == RECOVERING
+    assert worst_state([HEALTHY, DEGRADED, RECOVERING]) == DEGRADED
+    assert worst_state([FAILED, DEGRADED]) == FAILED
+
+
+def test_control_plane_delegation():
+    class WithControls:
+        frame_buffer_size = 4
+
+        def __call__(self, f):
+            return f
+
+        def update_prompt(self, p):
+            self.prompt = p
+
+    inner = WithControls()
+    rp = ResilientPipeline(inner, _sup())
+    rp.update_prompt("hello")
+    assert inner.prompt == "hello"
+    assert rp.frame_buffer_size == 4
+    assert not hasattr(rp, "submit")  # no pipelined surface to forward

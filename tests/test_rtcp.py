@@ -425,3 +425,81 @@ class TestReceiverStats:
         rr = make_rr(1, 2)
         assert len(rr) > 32  # RR body is 32 bytes; the SDES chunk follows
         assert rr[33] == 202 and b"tpu-rtc-agent" in rr  # PT_SDES + CNAME
+
+
+class TestReceiverStatsDuplicatesAndRelock:
+    """ADVICE r5 regressions: duplicate/late packets must not inflate
+    ``_received`` (RFC 3550 A.3 counts unique receptions), and a stats lock
+    won by a stray datagram must release when the real stream keeps
+    talking."""
+
+    def _pkt(self, seq, ts=0, ssrc=0xCAFE):
+        return struct.pack("!BBHII", 0x80, 102, seq, ts, ssrc) + b"d"
+
+    def test_duplicates_do_not_mask_loss(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        # 20 unique packets with 5 lost (100..119 minus 5), every delivered
+        # packet duplicated once — pre-fix the dups cancelled the loss
+        lost = {103, 107, 111, 115, 119}
+        for s in range(100, 120):
+            if s in lost:
+                continue
+            rs.received(self._pkt(s, s * 3000), arrival=10.0 + s / 30)
+            rs.received(self._pkt(s, s * 3000), arrival=10.0 + s / 30)
+        blk = rs.report_block()
+        assert blk["cumulative_lost"] == 4  # 119 lost is past highest_seq
+        assert blk["fraction_lost"] > 0
+
+    def test_reordered_first_arrival_still_counts(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        # 10..19 delivered with 14 arriving late (reordered, NOT lost)
+        order = [10, 11, 12, 13, 15, 16, 17, 14, 18, 19]
+        for s in order:
+            rs.received(self._pkt(s, s * 3000), arrival=20.0 + s / 30)
+        blk = rs.report_block()
+        assert blk["cumulative_lost"] == 0
+        assert blk["fraction_lost"] == 0
+
+    def test_late_duplicate_rejected_late_original_accepted(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        for s in (50, 51, 52, 53):
+            rs.received(self._pkt(s, s * 3000), arrival=30.0 + s / 30)
+        rs.received(self._pkt(51, 51 * 3000), arrival=31.0)  # late DUP
+        blk = rs.report_block()
+        assert blk["cumulative_lost"] == 0
+        assert rs._received == 4  # the replay did not count
+
+    def test_relock_when_locked_stream_goes_silent(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        # one stray probe datagram wins the lock...
+        rs.received(self._pkt(9, 0, ssrc=0xDEAD), arrival=40.0)
+        assert rs.ssrc == 0xDEAD
+        # ...then the real publisher talks and the ghost stays silent:
+        # after RELOCK_AFTER consecutive foreign packets the stats re-lock
+        for i in range(ReceiverStats.RELOCK_AFTER + 5):
+            rs.received(
+                self._pkt(200 + i, i * 3000, ssrc=0xCAFE), arrival=41.0 + i / 30
+            )
+        assert rs.ssrc == 0xCAFE
+        blk = rs.report_block()
+        assert blk["ssrc"] == 0xCAFE
+        assert blk["cumulative_lost"] == 0  # fresh lock, clean accounting
+
+    def test_no_relock_while_locked_stream_is_alive(self):
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        rs = ReceiverStats()
+        for i in range(100):
+            rs.received(self._pkt(10 + i, i * 3000), arrival=50.0 + i / 30)
+            # interleaved foreign chatter never reaches RELOCK_AFTER in a row
+            rs.received(self._pkt(7000 + i, 0, ssrc=0xBAD), arrival=50.0 + i / 30)
+        assert rs.ssrc == 0xCAFE
+        assert rs.report_block()["cumulative_lost"] == 0

@@ -8,6 +8,13 @@ DCEP open/ack, ordered delivery, fragmentation, retransmission, checksum
 real UDP, config JSON arriving through the agent's datachannel handler.
 """
 
+import pytest
+
+# the secure tier's crypto backend is optional at the package level
+# (signaling degrades to loopback without it) — these tests must SKIP,
+# not fail collection, on a box without it (resilience PR satellite)
+pytest.importorskip("cryptography", reason="secure tier needs cryptography")
+
 import asyncio
 import json
 

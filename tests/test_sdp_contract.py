@@ -96,6 +96,16 @@ async def _client():
     return app, client
 
 
+def _has_crypto() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("cryptography") is not None
+
+
+@pytest.mark.skipif(
+    not _has_crypto(),
+    reason="fingerprinted offers route to the secure tier (needs cryptography)",
+)
 @pytest.mark.parametrize(
     "name", ["browser_whip_offer.sdp", "obs_whip_offer.sdp"]
 )
@@ -279,3 +289,31 @@ def test_bundle_group_echoed_for_accepted_mid():
         sdp.parse(text), host="127.0.0.1", video_port=4000
     )
     assert "BUNDLE" not in answer2
+
+
+@pytest.mark.skipif(
+    _has_crypto(),
+    reason="exercises the no-crypto degrade path (cryptography installed here)",
+)
+def test_secure_offer_without_crypto_backend_is_clean_400():
+    """A fingerprinted (secure) offer on a box without the crypto backend
+    must be refused with a 400 naming the reason — not a 500 (resilience
+    PR; was the seed's only way to answer browser-shaped WHIP here)."""
+
+    async def go():
+        app = build_app(pipeline=lambda f: f, provider=NativeRtpProvider())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/whip",
+                data=fixture("browser_whip_offer.sdp"),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 400
+            assert "encrypted tier" in await r.text()
+            assert len(app["pcs"]) == 0  # the half-built pc did not leak
+        finally:
+            await client.close()
+
+    run(go())

@@ -4,6 +4,13 @@ browser's WebRTC brings, which is what the reference's aiortc tier
 ultimately speaks (reference agent.py:13-20).
 """
 
+import pytest
+
+# the secure tier's crypto backend is optional at the package level
+# (signaling degrades to loopback without it) — these tests must SKIP,
+# not fail collection, on a box without it (resilience PR satellite)
+pytest.importorskip("cryptography", reason="secure tier needs cryptography")
+
 import json
 import os
 import shutil

@@ -7,6 +7,13 @@ process; every peer gets ITS OWN processed stream back (distinct DTLS
 associations, distinct SRTP keys), teardown releases cleanly.
 """
 
+import pytest
+
+# the secure tier's crypto backend is optional at the package level
+# (signaling degrades to loopback without it) — these tests must SKIP,
+# not fail collection, on a box without it (resilience PR satellite)
+pytest.importorskip("cryptography", reason="secure tier needs cryptography")
+
 import asyncio
 import json
 

@@ -1,0 +1,185 @@
+"""ADVICE round-5 hardening regressions that live above the pure parsers:
+
+* RTP version gate on the native receive socket — stray non-RTP datagrams
+  must not wedge ReceiverStats / PLI targeting (rtc_native.py:307).
+* Wildcard (media_ssrc=0) PLIs honored on the PLAIN tier only — legacy
+  clients keep keyframe recovery; the secure tier stays exact-match
+  (rtc_native.py:153, docs/connect.md).
+* Duplicate INIT on an established SCTP association answers with the
+  existing tag/cookie without resetting TSN state (RFC 9260 s5.2.2,
+  sctp.py:406).
+"""
+
+import asyncio
+import struct
+
+from ai_rtc_agent_tpu.server.rtc_native import _RtcpState, _RtpReceiverProtocol
+from ai_rtc_agent_tpu.server.secure.sctp import SctpAssociation
+
+
+def _rtp(seq, ssrc=0xCAFE, pt=102):
+    return struct.pack("!BBHII", 0x80, pt, seq, seq * 3000, ssrc) + b"d"
+
+
+def _pli(media_ssrc):
+    return struct.pack("!BBH", 0x81, 206, 2) + struct.pack("!II", 1, media_ssrc)
+
+
+class FakeSource:
+    def __init__(self):
+        self.fed = []
+
+    def depacketize(self, pkt):
+        self.fed.append(pkt)
+        return []
+
+    def on(self, *a, **k):
+        pass
+
+
+def _proto():
+    return _RtpReceiverProtocol(FakeSource(), _RtcpState())
+
+
+# ---------------------------------------------------------------------------
+# RTP version gate
+# ---------------------------------------------------------------------------
+
+def test_stray_datagram_does_not_lock_ssrc_or_reach_depacketizer():
+    async def go():
+        p = _proto()
+        # a 16-byte junk probe (version bits 0) arrives FIRST
+        junk = b"\x00" * 16
+        p.datagram_received(junk, ("10.0.0.9", 5))
+        assert p._last_rx_ssrc == 0
+        assert p._rtcp_state.recv._base_seq is None
+        assert p.source.fed == []
+        # then the real publisher: stats lock onto IT, PLIs name IT
+        p.datagram_received(_rtp(100), ("10.0.0.1", 4))
+        assert p._last_rx_ssrc == 0xCAFE
+        assert p._rtcp_state.recv.ssrc == 0xCAFE
+        assert len(p.source.fed) == 1
+        p.close()
+
+    asyncio.run(go())
+
+
+def test_relock_updates_pli_target():
+    async def go():
+        p = _proto()
+        # RTP-shaped stray wins the lock first (version bits valid)
+        p.datagram_received(_rtp(7, ssrc=0xDEAD), ("10.0.0.9", 5))
+        assert p._last_rx_ssrc == 0xDEAD
+        # the real stream keeps talking; after the re-lock threshold the
+        # PLI target follows the stats onto the live stream
+        from ai_rtc_agent_tpu.media.rtcp import ReceiverStats
+
+        for i in range(ReceiverStats.RELOCK_AFTER + 1):
+            p.datagram_received(_rtp(200 + i, ssrc=0xCAFE), ("10.0.0.1", 4))
+        assert p._last_rx_ssrc == 0xCAFE
+        p.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# wildcard PLI: plain tier honors, secure tier stays exact
+# ---------------------------------------------------------------------------
+
+def test_plain_tier_honors_wildcard_pli():
+    st = _RtcpState()
+    assert st.on_rtcp(_pli(0), lambda w: None, allow_wildcard_pli=True) is True
+
+
+def test_secure_path_ignores_wildcard_pli():
+    st = _RtcpState()
+    assert st.on_rtcp(_pli(0), lambda w: None) is False
+    # exact match still forces the IDR on both tiers
+    st2 = _RtcpState()
+    assert st2.on_rtcp(_pli(st2.ssrc), lambda w: None) is True
+
+
+def test_plain_receive_socket_forwards_wildcard_pli():
+    async def go():
+        plis = []
+        p = _RtpReceiverProtocol(
+            FakeSource(), _RtcpState(), on_pli=lambda: plis.append(1)
+        )
+        p.datagram_received(_pli(0), ("10.0.0.2", 6))
+        p.close()
+        return plis
+
+    assert asyncio.run(go()) == [1]
+
+
+# ---------------------------------------------------------------------------
+# SCTP: duplicate INIT on an established association
+# ---------------------------------------------------------------------------
+
+def _establish_pair():
+    server = SctpAssociation("server")
+    client = SctpAssociation("client")
+    inflight = [(server, p) for p in client.start()]
+    n = 0
+    while inflight and n < 50:
+        n += 1
+        dst, pkt = inflight.pop(0)
+        src = client if dst is server else server
+        for reply in dst.handle_packet(pkt):
+            inflight.append((src, reply))
+    assert server.established and client.established
+    return server, client
+
+
+def test_retransmitted_init_does_not_reset_established_association():
+    server, client = _establish_pair()
+    peer_tag, cum_in, cookie = server._peer_tag, server._cum_in, server._cookie
+
+    # a duplicate INIT (same shape the client's start() emits) slips through
+    dup_init = client._packet(
+        client._chunk(1, 0, client._init_params()), vtag=0
+    )
+    replies = server.handle_packet(dup_init)
+
+    # RFC 9260 s5.2.2: answered with an INIT ACK carrying the EXISTING
+    # cookie, association state untouched
+    assert server.established
+    assert server._peer_tag == peer_tag
+    assert server._cum_in == cum_in
+    assert server._cookie == cookie
+    assert len(replies) == 1
+    ctype = replies[0][12]
+    assert ctype == 2  # CT_INIT_ACK
+    assert cookie in replies[0]
+
+    # and the data path still works end-to-end afterwards
+    got = []
+    server.on_message = lambda ch, m: got.append(m)
+    ch, packets = client.open_channel("config")
+    inflight = [(server, p) for p in packets]
+    n = 0
+    while inflight and n < 50:
+        n += 1
+        dst, pkt = inflight.pop(0)
+        src = client if dst is server else server
+        for reply in dst.handle_packet(pkt):
+            inflight.append((src, reply))
+    for p in client.send(ch.sid, 51, b'{"prompt": "still alive"}'):
+        server.handle_packet(p)
+    assert got and "still alive" in got[0]
+
+
+def test_stray_datagram_does_not_redirect_pli_return_address():
+    """The PLI return address must only latch onto RTP-shaped (or RTCP)
+    datagrams — a junk probe must not become the keyframe-request target
+    (code review this PR, extending the r5 version gate)."""
+
+    async def go():
+        p = _proto()
+        p.datagram_received(_rtp(5), ("10.0.0.1", 4))  # real publisher
+        assert p._last_addr == ("10.0.0.1", 4)
+        p.datagram_received(b"\x00" * 40, ("6.6.6.6", 666))  # junk probe
+        assert p._last_addr == ("10.0.0.1", 4)  # unchanged
+        p.close()
+
+    asyncio.run(go())
