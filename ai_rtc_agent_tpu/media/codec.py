@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import re
 import struct
 
 import numpy as np
@@ -180,18 +181,37 @@ class H264Decoder:
             pass
 
 
+_EPB_ESCAPE = re.compile(rb"\x00\x00(?=[\x00-\x03])")
+_EPB_UNESCAPE = re.compile(rb"\x00\x00\x03(?=[\x00-\x03])")
+
+
 class NullCodec:
-    """Raw passthrough codec (hermetic fallback + tests): frame <-> bytes."""
+    """Raw passthrough codec (hermetic fallback + tests): frame <-> bytes.
+
+    AUs are annex-B framed (one NAL per frame, start code + emulation
+    prevention per H.264 s7.4.1) so they flow through the SAME RTP
+    packetize/FU-A/depacketize plane as real H.264 — on a box without
+    libavcodec the media path still carries frames end to end instead of
+    silently producing zero packets (round-6 host-plane PR)."""
 
     MAGIC = b"TRAW"
 
     @staticmethod
     def encode(rgb: np.ndarray, pts: int = 0) -> bytes:
         h, w, _ = rgb.shape
-        return NullCodec.MAGIC + struct.pack("<HHq", w, h, pts) + rgb.tobytes()
+        raw = NullCodec.MAGIC + struct.pack("<HHq", w, h, pts) + rgb.tobytes()
+        # escape 00 00 0x runs so raw pixels can never fake a start code
+        # mid-AU (the packetizer's NAL scanner would split the frame)
+        return b"\x00\x00\x00\x01" + _EPB_ESCAPE.sub(b"\x00\x00\x03", raw)
 
     @staticmethod
     def decode(data: bytes):
+        data = bytes(data)
+        if data[:4] == b"\x00\x00\x00\x01":
+            data = data[4:]
+        elif data[:3] == b"\x00\x00\x01":
+            data = data[3:]
+        data = _EPB_UNESCAPE.sub(b"\x00\x00", data)
         if data[:4] != NullCodec.MAGIC:
             raise ValueError("not a NullCodec frame")
         w, h, pts = struct.unpack("<HHq", data[4:16])

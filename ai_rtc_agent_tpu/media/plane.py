@@ -28,13 +28,19 @@ from fractions import Fraction
 
 import numpy as np
 
+from ..utils import env as env_util
 from ..utils.profiling import FrameStats
 from . import native
 from .codec import H264Decoder, H264Encoder, NullCodec
 from .frames import VideoFrame
 from .ring import FrameRing
 from .rtcp import is_rtcp
-from .rtp import RtpDepacketizer, RtpPacketizer, RtpReorderBuffer
+from .rtp import (
+    BatchedRtpPacketizer,
+    RtpDepacketizer,
+    RtpPacketizer,
+    RtpReorderBuffer,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -246,11 +252,14 @@ class H264Sink:
         use_h264: bool | None = None,
         ssrc: int = 0x5EED,
         payload_type: int = 96,
+        plane_stats: FrameStats | None = None,
     ):
         """``payload_type``: RTP PT for outgoing packets — real-SDP answers
         echo the client's offered H264 payload number (server/sdp.py), so
-        the wire must carry the same value."""
+        the wire must carry the same value.  ``plane_stats``: per-session
+        host-plane stage gauges (packetize µs histograms at /metrics)."""
         self.stats = stats or FrameStats()
+        self.plane_stats = plane_stats
         self.use_h264 = native.h264_available() if use_h264 is None else use_h264
         self._enc = H264Encoder(width, height, fps) if self.use_h264 else None
         self._wh = (height, width)
@@ -260,11 +269,19 @@ class H264Sink:
         # arrive from the event loop (PLI path) — the encoder swap on a
         # geometry change must not free a handle another thread is using
         self._enc_lock = threading.Lock()
-        self._pkt = (
-            RtpPacketizer(ssrc=ssrc, payload_type=payload_type)
-            if native.load()
-            else None
-        )
+        # HOST_PLANE_BATCH (default on): the vectorized frame-granular
+        # packetizer — wire-identical to the native per-packet one
+        # (tests/test_host_plane.py) and native-toolchain-independent.
+        # Packets are memoryviews into its rotating pool: valid until the
+        # pool wraps (HOST_PLANE_POOL_SLOTS more frames); holders copy.
+        if env_util.get_bool("HOST_PLANE_BATCH", True):
+            self._pkt = BatchedRtpPacketizer(ssrc=ssrc, payload_type=payload_type)
+        else:
+            self._pkt = (
+                RtpPacketizer(ssrc=ssrc, payload_type=payload_type)
+                if native.load()
+                else None
+            )
         self._pts = 0
         self._pts_step = CLOCK_RATE // max(1, fps)
 
@@ -308,7 +325,13 @@ class H264Sink:
         with self._enc_lock:  # close() frees the native packetizer too
             if self._pkt is None:
                 return [au] if not self._closed else []
-            return self._pkt.packetize(au, int(pts))
+            t1 = time.perf_counter()
+            pkts = self._pkt.packetize(au, int(pts))
+            if self.plane_stats is not None:
+                self.plane_stats.record_stage(
+                    "packetize", time.perf_counter() - t1
+                )
+            return pkts
 
     def force_keyframe(self):
         """Next consumed frame encodes as an IDR (PLI recovery — safe from

@@ -36,10 +36,27 @@ class FrameRing:
     """numpy-facing wrapper over the native SPSC ring (python fallback when
     the native lib is unavailable)."""
 
-    def __init__(self, frame_shape, n_slots: int = 4):
+    def __init__(self, frame_shape, n_slots: int = 4, pop_pool: int | None = None):
         self.frame_shape = tuple(frame_shape)
         self.slot_bytes = int(np.prod(self.frame_shape))
         self._lib = native.load()
+        # pop() allocates a fresh frame per call by default.  With
+        # ``pop_pool=N`` (or HOST_PLANE_RING_POP_POOL=N) frames rotate
+        # through N preallocated buffers instead — zero steady-state
+        # allocation, but a popped frame is only valid until N more pops,
+        # so ONLY consumers that hand the pixels off (device_put) before
+        # then may opt in.  Off by default: plenty of callers retain
+        # frames (tests, quality probes).
+        if pop_pool is None:
+            from ..utils import env as env_util
+
+            pop_pool = env_util.get_int("HOST_PLANE_RING_POP_POOL", 0)
+        self._pop_pool = (
+            [np.empty(self.slot_bytes, np.uint8) for _ in range(pop_pool)]
+            if pop_pool and pop_pool >= 2
+            else None
+        )
+        self._pop_i = 0
         if self._lib is not None:
             self._ring = self._lib.tr_ring_create(self.slot_bytes, n_slots)
         else:
@@ -69,7 +86,11 @@ class FrameRing:
         if getattr(self, "_destroyed", False):
             return None
         if self._ring:
-            out = np.empty(self.slot_bytes, np.uint8)
+            if self._pop_pool is not None:
+                out = self._pop_pool[self._pop_i]
+                self._pop_i = (self._pop_i + 1) % len(self._pop_pool)
+            else:
+                out = np.empty(self.slot_bytes, np.uint8)
             meta = ctypes.c_int64(0)
             n = self._lib.tr_ring_try_pop(
                 self._ring,

@@ -20,6 +20,7 @@ import numpy as np
 from ..resilience import faults as _faults
 from .frames import VideoFrame
 from .plane import H264RingSource, H264Sink
+from .sockio import CoalescedFlush
 
 
 class NativeRtpClient:
@@ -34,6 +35,7 @@ class NativeRtpClient:
         self._send_tr = None
         self.sink: H264Sink | None = None
         self.back: H264RingSource | None = None
+        self._out = CoalescedFlush()  # per-frame coalesced uplink flush
         # chaos hooks (resilience/faults.py): impair this client's uplink
         # ("tx") and downlink ("rx") when a fault plan is active; both are
         # None — one is-None test per packet — otherwise
@@ -75,6 +77,7 @@ class NativeRtpClient:
         self._send_tr, _ = await loop.create_datagram_endpoint(
             asyncio.DatagramProtocol, remote_addr=(host, server_port)
         )
+        self._out.bind(self._send_tr)
         self.sink = H264Sink(
             self.width, self.height, fps=self.fps, use_h264=self._use_h264
         )
@@ -82,16 +85,37 @@ class NativeRtpClient:
     def send(self, arr_u8: np.ndarray, index: int):
         frame = VideoFrame.from_ndarray(np.ascontiguousarray(arr_u8))
         frame.pts = index * (90_000 // self.fps)
-        for pkt in self.sink.consume(frame):
-            if self._tx_faults is not None:
-                loop = asyncio.get_event_loop()
-                for d, delay in self._tx_faults.apply(pkt):
-                    if delay > 0:
-                        loop.call_later(delay, self._send_tr.sendto, d)
-                    else:
-                        self._send_tr.sendto(d)
-                continue
-            self._send_tr.sendto(pkt)
+        pkts = self.sink.consume(frame)
+        if not pkts:
+            return
+        if self._tx_faults is None:
+            self._flush(pkts)
+            return
+        # chaos path: apply per-packet faults, but pace at FRAME
+        # granularity — delayed survivors ride ONE timer per frame (at
+        # the latest injected delay) instead of one call_later per
+        # fragment (ISSUE 2 satellite); copies stabilize pooled views
+        # across the timer hop
+        immediate, delayed, due = [], [], 0.0
+        for pkt in pkts:
+            # the injector can HOLD a packet across calls (reorder fault)
+            # — pooled views must be stabilized before they reach it
+            if not isinstance(pkt, (bytes, bytearray)):
+                pkt = bytes(pkt)
+            for d, delay in self._tx_faults.apply(pkt):
+                if delay > 0:
+                    delayed.append(bytes(d))
+                    due = max(due, delay)
+                else:
+                    immediate.append(d)
+        self._flush(immediate)
+        if delayed:
+            asyncio.get_event_loop().call_later(due, self._flush, delayed)
+
+    def _flush(self, pkts):
+        """One coalesced flush of a frame's packets on the connected send
+        socket (sendmmsg when available, sendto loop otherwise)."""
+        self._out.flush(pkts)
 
     def drain(self) -> int:
         """Feed every queued packet, polling decoded frames AFTER EACH feed
@@ -121,6 +145,7 @@ class NativeRtpClient:
         for c in (self.sink, self.back):
             if c is not None:
                 c.close()
+        self._out.close()
         for t in (self._send_tr, self._recv_tr):
             if t is not None:
                 t.close()

@@ -597,7 +597,15 @@ async def demo(_):
 
 
 async def metrics(request):
-    return web.json_response(request.app["stats"].snapshot())
+    out = request.app["stats"].snapshot()
+    # per-session host-plane stage histograms (packetize/protect/send/recv
+    # µs — ISSUE 2): native provider only; absent key means the provider
+    # has no batched host plane, empty dict means no live sessions
+    provider = request.app.get("provider")
+    snapshot = getattr(provider, "host_plane_snapshot", None)
+    if snapshot is not None:
+        out["host_plane_sessions"] = snapshot()
+    return web.json_response(out)
 
 
 class _TimedPipeline:

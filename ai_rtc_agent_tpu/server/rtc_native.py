@@ -45,7 +45,9 @@ import time
 import uuid
 
 from ..media import rtcp as rtcp_mod
+from ..media import sockio
 from ..media.plane import H264RingSource, H264Sink
+from ..utils import env as env_util
 from ..utils.profiling import FrameStats
 from . import sdp
 
@@ -98,6 +100,10 @@ class _RtcpState:
         if len(plain_pkt) >= 8:
             self.last_rtp_ts = int.from_bytes(plain_pkt[4:8], "big")
             self.last_sent_wall = time.time()
+        if not isinstance(wire, (bytes, bytearray)):
+            # the batched packetizer hands out pooled memoryviews; the
+            # NACK cache outlives the pool, so it must own stable bytes
+            wire = bytes(wire)
         self.cache.add(plain_pkt, wire)
 
     def make_report(self) -> bytes | None:
@@ -211,11 +217,12 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
     PLI_MIN_INTERVAL = 0.25  # s — bound the PLI storm under loss bursts
 
     def __init__(self, source: H264RingSource | None, rtcp_state: _RtcpState,
-                 on_pli=None, session=None):
+                 on_pli=None, session=None, plane_stats: FrameStats | None = None):
         """`session`: a secure.SecureMediaSession — when given, this socket
         speaks the full RFC 7983 mux (STUN + DTLS + SRTP/SRTCP) instead of
         plain RTP; `source` may be None for a send-only (WHEP) secure peer
-        whose socket still has to answer ICE checks and the handshake."""
+        whose socket still has to answer ICE checks and the handshake.
+        `plane_stats`: per-session host-plane stage gauges (/metrics)."""
         self.source = source
         self.session = session
         self._rtcp_state = rtcp_state
@@ -224,6 +231,17 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         self._on_pli = on_pli
         self._last_addr = None
         self._last_pli_sent = 0.0
+        self._plane_stats = plane_stats
+        # coalesced I/O (ISSUE 2): after asyncio hands over the tick's
+        # first datagram, drain the rest of the burst through pooled
+        # buffers in the same callback; outbound frames flush as one
+        # sendmmsg batch.  HOST_PLANE_RX_BATCH=0 restores per-callback RX.
+        self._drain = (
+            sockio.DatagramDrain()
+            if env_util.get_bool("HOST_PLANE_RX_BATCH", True)
+            else None
+        )
+        self._flush = sockio.CoalescedFlush()
         # fault injection hook (resilience/faults.py): None unless a plan
         # targeting inbound datagrams is active — the disabled hot path
         # costs exactly one is-None test
@@ -239,6 +257,7 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
     def connection_made(self, transport):
         self.transport = transport
+        self._flush.bind(transport)
 
     def _request_keyframe_threadsafe(self):
         try:
@@ -287,7 +306,56 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         self.transport.sendto(wire, addr)
         return True
 
+    def send_media_batch(self, packets) -> bool:
+        """Outbound RTP, one whole frame at a time: frame-granular SRTP
+        (protect_frame — one keystream pass for every fragment) and a
+        single coalesced socket flush.  Returns False while the handshake
+        has not yet produced keys / an ICE-latched peer."""
+        if self.transport is None or self.session is None or not packets:
+            return False
+        stats = self._plane_stats
+        t0 = time.perf_counter()
+        wires = self.session.protect_rtp_frame(packets)
+        addr = self.session.peer_addr
+        if wires is None or addr is None:
+            return False
+        t1 = time.perf_counter()
+        for plain, wire in zip(packets, wires):
+            self._rtcp_state.sent(plain, wire)
+        self._flush.flush(wires, addr)
+        if stats is not None:
+            t2 = time.perf_counter()
+            stats.record_stage("protect", t1 - t0)
+            stats.record_stage("send", t2 - t1)
+            stats.count("tx_packets", len(wires))
+        return True
+
     def datagram_received(self, data, addr):
+        if self._drain is None or self._flush.sock is None:
+            self._one(data, addr)
+            return
+        # batched drain: asyncio delivers the tick's first datagram, the
+        # rest of the burst is slurped here through pooled buffers — one
+        # event-loop callback per burst instead of one per packet
+        t0 = time.perf_counter() if self._plane_stats is not None else 0.0
+        self._one(data, addr)
+        n = 1 + self._drain.drain(self._flush.sock, self._drained)
+        if self._plane_stats is not None:
+            self._plane_stats.record_stage("recv", time.perf_counter() - t0)
+            self._plane_stats.count("rx_datagrams", n)
+
+    def _drained(self, view, addr):
+        # pooled view: stabilize whenever something downstream may hold it
+        # past this call — fault-injected delayed redelivery, and the
+        # DTLS/STUN handshake paths (reassembly buffers).  RTP/RTCP either
+        # consume synchronously or copy on their own (reorder-buffer hold,
+        # SRTP unprotect).
+        if self._rx_faults is not None or (len(view) > 0 and view[0] < 128):
+            self._one(bytes(view), addr)
+        else:
+            self._one(view, addr)
+
+    def _one(self, data, addr):
         if self._rx_faults is not None:
             # injected loss/dup/reorder/delay/truncation (chaos testing);
             # delayed copies re-enter via _ingest so they are not re-faulted
@@ -367,6 +435,7 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
 
     def close(self):
         self._task.cancel()
+        self._flush.close()  # our dup'd fd, not the transport's
 
 
 class _PliListenerProtocol(asyncio.DatagramProtocol):
@@ -427,6 +496,14 @@ class NativeRtpPeerConnection:
         self._sr_task = None
         self.server_port: int | None = None
         self.pc_id = str(uuid.uuid4())
+        # host-plane instrumentation + batching (ISSUE 2): per-session
+        # packetize/protect/send/recv µs histograms, surfaced at /metrics
+        # under host_plane_sessions; HOST_PLANE_BATCH=0 restores the
+        # per-packet TX path end to end
+        self.plane_stats = FrameStats()
+        self._batch_tx = env_util.get_bool("HOST_PLANE_BATCH", True)
+        self._plain_flush = sockio.CoalescedFlush()
+        provider.register_plane_session(self.pc_id, self.plane_stats)
 
     # -- events --------------------------------------------------------------
 
@@ -559,6 +636,7 @@ class NativeRtpPeerConnection:
                         self._rtcp_state,
                         on_pli=self._force_sink_keyframe,
                         session=self._secure_session,
+                        plane_stats=self.plane_stats,
                     ),
                     local_addr=("0.0.0.0", 0),
                 )
@@ -692,6 +770,7 @@ class NativeRtpPeerConnection:
             local_addr=("0.0.0.0", 0),
             remote_addr=self._client_addr,
         )
+        self._plain_flush.bind(self._send_transport)
 
     async def _start_senders(self):
         if not self.out_tracks:
@@ -708,6 +787,7 @@ class NativeRtpPeerConnection:
         self._sink = H264Sink(
             w, h, stats=self._provider.stats, use_h264=self._provider.use_h264,
             payload_type=self._h264_pt or 96, ssrc=OUT_SSRC,
+            plane_stats=self.plane_stats,
         )
         for track in self.out_tracks:
             self._sender_tasks.append(
@@ -753,21 +833,42 @@ class NativeRtpPeerConnection:
     async def _pump(self, track, sink: H264Sink):
         """The RTP sender loop (the aiortc-internal loop the reference relies
         on, SURVEY.md section 3.3 'aiortc RTP sender loop').  The H.264
-        encode runs on a worker thread — only the sendto touches the loop."""
+        encode runs on a worker thread; the whole frame's packet batch
+        then flushes in ONE loop hop (frame-granular SRTP + sendmmsg)
+        instead of one sendto per fragment (ISSUE 2)."""
         try:
             while self.connectionState != "closed":
                 frame = await track.recv()
-                for pkt in await asyncio.to_thread(sink.consume, frame):
-                    if self._secure_session is not None:
-                        # drops silently until DTLS keys + ICE latch exist
-                        self._recv_protocol.send_media(pkt)
+                pkts = await asyncio.to_thread(sink.consume, frame)
+                if not pkts:
+                    continue
+                if self._secure_session is not None:
+                    # drops silently until DTLS keys + ICE latch exist
+                    if self._batch_tx:
+                        self._recv_protocol.send_media_batch(pkts)
                     else:
-                        self._rtcp_state.sent(pkt, pkt)
-                        self._send_transport.sendto(pkt)
+                        for pkt in pkts:
+                            self._recv_protocol.send_media(pkt)
+                else:
+                    self._send_plain(pkts)
         except (ConnectionError, asyncio.CancelledError):
             pass
         except Exception:
             logger.exception("sender pump failed")
+
+    def _send_plain(self, pkts) -> None:
+        """Plain-tier frame flush: one coalesced batch on the connected
+        send socket (per-packet sendto when batching is off)."""
+        t0 = time.perf_counter()
+        for pkt in pkts:
+            self._rtcp_state.sent(pkt, pkt)
+        if self._batch_tx:
+            self._plain_flush.flush(pkts)
+        else:
+            for pkt in pkts:
+                self._send_transport.sendto(pkt)
+        self.plane_stats.record_stage("send", time.perf_counter() - t0)
+        self.plane_stats.count("tx_packets", len(pkts))
 
     # OBS full-gather parity — nothing to gather on plain UDP
     async def _RTCPeerConnection__gather(self):
@@ -777,6 +878,7 @@ class NativeRtpPeerConnection:
         if self.connectionState == "closed":
             return
         self.connectionState = "closed"
+        self._provider.unregister_plane_session(self.pc_id)
         for t in self._sender_tasks:
             t.cancel()
         if self._sctp_timer_task is not None:
@@ -797,6 +899,7 @@ class NativeRtpPeerConnection:
             self._recv_protocol.close()
         if self._recv_transport:
             self._recv_transport.close()
+        self._plain_flush.close()
         if self._send_transport:
             self._send_transport.close()
         await self._emit("connectionstatechange")
@@ -823,6 +926,25 @@ class NativeRtpProvider:
             "ADVERTISE_HOST", "127.0.0.1"
         )
         self._dtls_certificate = None
+        # pc_id -> per-session host-plane FrameStats (ISSUE 2): the
+        # packetize/protect/send/recv µs histograms behind /metrics'
+        # host_plane_sessions block
+        self._plane_sessions: dict = {}
+
+    def register_plane_session(self, pc_id: str, stats: FrameStats) -> None:
+        self._plane_sessions[pc_id] = stats
+
+    def unregister_plane_session(self, pc_id: str) -> None:
+        self._plane_sessions.pop(pc_id, None)
+
+    def host_plane_snapshot(self) -> dict:
+        """{pc_id: stage µs percentiles} for every live session."""
+        return {
+            pc_id: stats.stage_snapshot_us(
+                ("packetize", "protect", "send", "recv")
+            )
+            for pc_id, stats in self._plane_sessions.items()
+        }
 
     @property
     def dtls_certificate(self):

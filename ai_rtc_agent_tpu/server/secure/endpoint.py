@@ -174,6 +174,14 @@ class SecureMediaSession:
             return None
         return self.tx_srtp.protect(packet)
 
+    def protect_rtp_frame(self, packets) -> list | None:
+        """Frame-granular SRTP (ISSUE 2): protect every fragment of one
+        access unit in a single pass — one keystream computation, cached
+        cipher/HMAC objects.  None until the handshake derives keys."""
+        if self.tx_srtp is None:
+            return None
+        return self.tx_srtp.protect_frame(packets)
+
     def protect_rtcp(self, packet: bytes) -> bytes | None:
         if self.tx_srtp is None:
             return None

@@ -19,11 +19,13 @@ import hashlib
 import hmac
 import struct
 
+import numpy as np
 from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
 AUTH_TAG_LEN = 10  # HMAC-SHA1-80
 SRTCP_INDEX_LEN = 4
+_MASK128 = (1 << 128) - 1
 
 LABEL_RTP_ENCRYPTION = 0x00
 LABEL_RTP_AUTH = 0x01
@@ -75,6 +77,19 @@ class SrtpContext:
         # window over the 48-bit packet index, per SSRC; one more for SRTCP
         self._replay: dict = {}  # ssrc -> [max_index, mask]
         self._rtcp_replay = [-1, 0]
+        # cached primitives (ISSUE 2 quick win): per-packet Cipher/HMAC
+        # CONSTRUCTION was the dominant python cost at streaming rates —
+        # key the objects once per context, copy/reuse per packet
+        self._aes = algorithms.AES(self.session_key)
+        self._rtcp_aes = algorithms.AES(self.rtcp_key)
+        self._hmac_base = hmac.new(self.session_auth, b"", hashlib.sha1)
+        self._rtcp_hmac_base = hmac.new(self.rtcp_auth, b"", hashlib.sha1)
+        # ECB over precomputed counter blocks IS the CTR keystream: one
+        # stateless encryptor serves every protect_frame call (never
+        # finalized; each 16-byte block is independent)
+        self._ecb = Cipher(self._aes, modes.ECB()).encryptor()
+        self._salt_int = int.from_bytes(self.session_salt, "big")
+        self._scratch = bytearray(0)  # counter blocks + payload staging
 
     # -- packet index (RFC 3711 s3.3.1 + appendix A) --------------------
 
@@ -135,7 +150,106 @@ class SrtpContext:
             raise ValueError("truncated RTP packet")
         return off
 
+    def _frame_indexes(self, pkts) -> list[tuple[int, int, int]]:
+        """One pass of (ssrc, seq, index) for a frame's packets.
+
+        The packetizer emits consecutive seqs on one SSRC, so after the
+        first packet's full RFC 3711 index estimation the rest are
+        ``index0 + i`` with a single ROC-state write at the end; any
+        packet that breaks the pattern falls back to per-packet
+        estimation (identical state transitions either way)."""
+        p0 = pkts[0]
+        ssrc0 = struct.unpack_from("!I", p0, 8)[0]
+        seq0 = struct.unpack_from("!H", p0, 2)[0]
+        index0 = self._estimate_index(ssrc0, seq0, update=True)
+        metas = [(ssrc0, seq0, index0)]
+        run = True
+        for i, pkt in enumerate(pkts[1:], 1):
+            ssrc = struct.unpack_from("!I", pkt, 8)[0]
+            seq = struct.unpack_from("!H", pkt, 2)[0]
+            if run and ssrc == ssrc0 and seq == ((seq0 + i) & 0xFFFF):
+                metas.append((ssrc, seq, index0 + i))
+            else:
+                run = False
+                metas.append((ssrc, seq, self._estimate_index(ssrc, seq, True)))
+        if run and len(pkts) > 1:
+            last_index = index0 + len(pkts) - 1
+            self._roc[ssrc0] = (last_index >> 16, last_index & 0xFFFF)
+        return metas
+
+    def protect_frame(self, pkts) -> list:
+        """SRTP-protect all fragments of one access unit in a single
+        pass: per-packet IVs precomputed together, ONE AES call for the
+        whole frame's CTR keystream (ECB over the precomputed counter
+        blocks), one numpy XOR, and per-packet tags from the pre-keyed
+        HMAC.  Byte-identical to N x legacy ``protect`` (pinned by
+        tests/test_host_plane.py).  Accepts bytes or memoryviews;
+        returns freshly-allocated bytearrays the caller owns."""
+        if not pkts:
+            return []
+        metas = self._frame_indexes(pkts)
+        offs, plens, bases = [], [], []
+        total = 0  # counter blocks across the frame
+        for pkt in pkts:
+            off = self._payload_offset(pkt)
+            plen = len(pkt) - off
+            offs.append(off)
+            plens.append(plen)
+            bases.append(total)
+            total += (plen + 15) >> 4
+        need = total * 32  # [counter blocks | staged payloads]
+        if len(self._scratch) < need:
+            self._scratch = bytearray(max(need, 4096))
+        scratch = self._scratch
+        np_s = np.frombuffer(scratch, np.uint8)
+        blocks = np_s[: total * 16].reshape(total, 16)
+        stage = np_s[total * 16 : total * 32]
+        stage_mv = memoryview(scratch)[total * 16 : total * 32]
+        salt16 = self._salt_int << 16
+        ctr = np.arange(0, dtype=np.uint32)
+        for pkt, (ssrc, _seq, index), off, plen, base in zip(
+            pkts, metas, offs, plens, bases
+        ):
+            nb = (plen + 15) >> 4
+            iv = (salt16 ^ (ssrc << 64) ^ (index << 16)) & _MASK128
+            b = blocks[base : base + nb]
+            b[:, :14] = np.frombuffer(iv.to_bytes(16, "big"), np.uint8)[:14]
+            if len(ctr) < nb:
+                ctr = np.arange(max(nb, 256), dtype=np.uint32)
+            b[:, 14] = ctr[:nb] >> 8
+            b[:, 15] = ctr[:nb] & 0xFF
+            stage_mv[base * 16 : base * 16 + plen] = pkt[off:]
+        ks = self._ecb.update(memoryview(scratch)[: total * 16])
+        np.bitwise_xor(stage, np.frombuffer(ks, np.uint8), out=stage)
+        out = []
+        auth = self.session_auth
+        for pkt, (ssrc, _seq, index), off, plen, base in zip(
+            pkts, metas, offs, plens, bases
+        ):
+            # wire = header | encrypted payload | tag; the ROC rides the
+            # tag input after the ciphertext (RFC 3711 s4.2), staged in
+            # the tag's slot so hmac runs over ONE contiguous buffer
+            wire = bytearray(off + plen + AUTH_TAG_LEN)
+            wire[:off] = pkt[:off]
+            wire[off : off + plen] = stage_mv[base * 16 : base * 16 + plen]
+            struct.pack_into("!I", wire, off + plen, index >> 16)
+            tag = hmac.digest(auth, memoryview(wire)[: off + plen + 4], "sha1")
+            wire[off + plen :] = tag[:AUTH_TAG_LEN]
+            # freshly-built, exclusively-owned: hand out the bytearray
+            # itself (send/cache consumers take any buffer; a bytes()
+            # here would re-copy every packet of the hot path)
+            out.append(wire)
+        return out
+
     def protect(self, pkt: bytes) -> bytes:
+        """Per-packet API: thin wrapper over the frame path."""
+        return self.protect_frame((pkt,))[0]
+
+    def _protect_legacy(self, pkt: bytes) -> bytes:
+        """The pre-batching per-packet path (fresh cipher + HMAC per
+        packet).  Kept verbatim as the baseline for
+        scripts/host_plane_bench.py and the wire-compat pins — not used
+        by the serving path."""
         ssrc = struct.unpack_from("!I", pkt, 8)[0]
         seq = struct.unpack_from("!H", pkt, 2)[0]
         index = self._estimate_index(ssrc, seq, update=True)
@@ -151,15 +265,16 @@ class SrtpContext:
     def unprotect(self, pkt: bytes) -> bytes:
         if len(pkt) < 12 + AUTH_TAG_LEN:
             raise ValueError("short SRTP packet")
+        if not isinstance(pkt, (bytes, bytearray)):
+            pkt = bytes(pkt)  # pooled RX views: stabilize once up front
         enc, tag = pkt[:-AUTH_TAG_LEN], pkt[-AUTH_TAG_LEN:]
         ssrc = struct.unpack_from("!I", enc, 8)[0]
         seq = struct.unpack_from("!H", enc, 2)[0]
         index = self._estimate_index(ssrc, seq, update=False)
-        roc = index >> 16
-        expect = hmac.new(
-            self.session_auth, enc + struct.pack("!I", roc), hashlib.sha1
-        ).digest()[:AUTH_TAG_LEN]
-        if not hmac.compare_digest(expect, tag):
+        h = self._hmac_base.copy()
+        h.update(enc)
+        h.update(struct.pack("!I", index >> 16))
+        if not hmac.compare_digest(h.digest()[:AUTH_TAG_LEN], tag):
             raise ValueError("SRTP auth failure")
         # replay check only after the tag verified (unauthenticated noise
         # must not advance the window)
@@ -167,7 +282,8 @@ class SrtpContext:
         self._estimate_index(ssrc, seq, update=True)
         off = self._payload_offset(enc)
         iv = self._keystream_iv(self.session_salt, ssrc, index)
-        return enc[:off] + _aes_ctr(self.session_key, iv, enc[off:])
+        dec = Cipher(self._aes, modes.CTR(iv)).encryptor()
+        return enc[:off] + dec.update(enc[off:]) + dec.finalize()
 
     # -- SRTCP (RFC 3711 s3.4) -------------------------------------------
 
@@ -178,23 +294,26 @@ class SrtpContext:
         self._rtcp_index = (self._rtcp_index + 1) & 0x7FFFFFFF
         index = self._rtcp_index
         iv = self._keystream_iv(self.rtcp_salt, ssrc, index)
-        enc = pkt[:8] + _aes_ctr(self.rtcp_key, iv, pkt[8:])
+        enc_c = Cipher(self._rtcp_aes, modes.CTR(iv)).encryptor()
+        enc = pkt[:8] + enc_c.update(pkt[8:]) + enc_c.finalize()
         e_index = struct.pack("!I", index | 0x80000000)  # E=1: encrypted
-        tag = hmac.new(self.rtcp_auth, enc + e_index, hashlib.sha1).digest()[
-            :AUTH_TAG_LEN
-        ]
-        return enc + e_index + tag
+        h = self._rtcp_hmac_base.copy()
+        h.update(enc)
+        h.update(e_index)
+        return enc + e_index + h.digest()[:AUTH_TAG_LEN]
 
     def unprotect_rtcp(self, pkt: bytes) -> bytes:
         if len(pkt) < 8 + SRTCP_INDEX_LEN + AUTH_TAG_LEN:
             raise ValueError("short SRTCP packet")
+        if not isinstance(pkt, (bytes, bytearray)):
+            pkt = bytes(pkt)
         tag = pkt[-AUTH_TAG_LEN:]
         e_index = pkt[-(AUTH_TAG_LEN + SRTCP_INDEX_LEN) : -AUTH_TAG_LEN]
         enc = pkt[: -(AUTH_TAG_LEN + SRTCP_INDEX_LEN)]
-        expect = hmac.new(
-            self.rtcp_auth, enc + e_index, hashlib.sha1
-        ).digest()[:AUTH_TAG_LEN]
-        if not hmac.compare_digest(expect, tag):
+        h = self._rtcp_hmac_base.copy()
+        h.update(enc)
+        h.update(e_index)
+        if not hmac.compare_digest(h.digest()[:AUTH_TAG_LEN], tag):
             raise ValueError("SRTCP auth failure")
         raw_index = struct.unpack("!I", e_index)[0]
         index = raw_index & 0x7FFFFFFF
@@ -203,7 +322,8 @@ class SrtpContext:
             return enc
         ssrc = struct.unpack_from("!I", enc, 4)[0]
         iv = self._keystream_iv(self.rtcp_salt, ssrc, index)
-        return enc[:8] + _aes_ctr(self.rtcp_key, iv, enc[8:])
+        dec = Cipher(self._rtcp_aes, modes.CTR(iv)).encryptor()
+        return enc[:8] + dec.update(enc[8:]) + dec.finalize()
 
 
 PROFILE_AES128_CM_SHA1_80 = 0x0001
@@ -244,6 +364,7 @@ class AeadSrtpContext:
         self.rtcp_salt = kdf(master_key, kdf_salt, LABEL_RTCP_SALT, 12)
         self._aead = AESGCM(self.session_key)
         self._aead_rtcp = AESGCM(self.rtcp_key)
+        self._salt_int = int.from_bytes(self.session_salt, "big")
         self._roc: dict = {}
         self._rtcp_index = 0
         self._replay: dict = {}
@@ -252,28 +373,43 @@ class AeadSrtpContext:
     _estimate_index = SrtpContext._estimate_index
     _replay_check = staticmethod(SrtpContext._replay_check)
     _payload_offset = staticmethod(SrtpContext._payload_offset)
+    _frame_indexes = SrtpContext._frame_indexes
 
     def _iv(self, salt: bytes, ssrc: int, roc: int, seq: int) -> bytes:
-        raw = (
-            b"\x00\x00"
-            + struct.pack("!I", ssrc)
-            + struct.pack("!I", roc)
-            + struct.pack("!H", seq)
-        )
-        return bytes(a ^ b for a, b in zip(raw, salt))
+        # 96-bit layout (s8.1): 00 00 | ssrc | roc | seq, XOR session salt
+        raw = (ssrc << 48) | ((roc & 0xFFFFFFFF) << 16) | (seq & 0xFFFF)
+        return (raw ^ int.from_bytes(salt, "big")).to_bytes(12, "big")
+
+    def protect_frame(self, pkts) -> list[bytes]:
+        """Frame-granular AEAD protect: indexes and IVs computed in one
+        pass; the AEAD itself is per-packet (GCM needs one seal per
+        distinct nonce) but rides the ONE cached AESGCM object.
+        Byte-identical to N x ``protect``."""
+        if not pkts:
+            return []
+        metas = self._frame_indexes(pkts)
+        out = []
+        seal = self._aead.encrypt
+        salt_int = self._salt_int
+        for pkt, (ssrc, seq, index) in zip(pkts, metas):
+            off = self._payload_offset(pkt)
+            raw = (ssrc << 48) | (((index >> 16) & 0xFFFFFFFF) << 16) | seq
+            iv = (raw ^ salt_int).to_bytes(12, "big")
+            hdr = bytes(pkt[:off])
+            payload = pkt[off:]
+            if not isinstance(payload, bytes):
+                payload = bytes(payload)
+            out.append(hdr + seal(iv, payload, hdr))
+        return out
 
     def protect(self, pkt: bytes) -> bytes:
-        ssrc = struct.unpack_from("!I", pkt, 8)[0]
-        seq = struct.unpack_from("!H", pkt, 2)[0]
-        index = self._estimate_index(ssrc, seq, update=True)
-        off = self._payload_offset(pkt)
-        iv = self._iv(self.session_salt, ssrc, index >> 16, seq)
-        ct = self._aead.encrypt(iv, pkt[off:], pkt[:off])
-        return pkt[:off] + ct
+        return self.protect_frame((pkt,))[0]
 
     def unprotect(self, pkt: bytes) -> bytes:
         if len(pkt) < 12 + self.TAG_LEN:
             raise ValueError("short SRTP packet")
+        if not isinstance(pkt, (bytes, bytearray)):
+            pkt = bytes(pkt)
         ssrc = struct.unpack_from("!I", pkt, 8)[0]
         seq = struct.unpack_from("!H", pkt, 2)[0]
         index = self._estimate_index(ssrc, seq, update=False)
@@ -302,6 +438,8 @@ class AeadSrtpContext:
     def unprotect_rtcp(self, pkt: bytes) -> bytes:
         if len(pkt) < 8 + SRTCP_INDEX_LEN + self.TAG_LEN:
             raise ValueError("short SRTCP packet")
+        if not isinstance(pkt, (bytes, bytearray)):
+            pkt = bytes(pkt)
         e_index = pkt[-SRTCP_INDEX_LEN:]
         enc = pkt[8:-SRTCP_INDEX_LEN]
         raw_index = struct.unpack("!I", e_index)[0]

@@ -58,6 +58,30 @@ class FrameStats:
         with self._lock:
             self._gauges[name] = value
 
+    def stage_snapshot_us(self, stages=None) -> dict:
+        """Microsecond-resolution stage percentiles (p50/p90/p99) for the
+        host-media-plane stages (packetize/protect/send/recv) — these run
+        in single-digit µs, so the ms-scaled main snapshot floors them to
+        noise.  ``stages=None`` includes every recorded stage; counters
+        ride along as ``<name>_total``."""
+        with self._lock:
+            items = {
+                k: sorted(q)
+                for k, q in self._stages.items()
+                if q and (stages is None or k in stages)
+            }
+            counts = dict(self._counts)
+        out: dict = {}
+        for name, q in items.items():
+            n = len(q)
+            out[f"{name}_p50_us"] = round(1e6 * q[n // 2], 2)
+            out[f"{name}_p90_us"] = round(1e6 * q[min(n - 1, int(n * 0.9))], 2)
+            out[f"{name}_p99_us"] = round(1e6 * q[min(n - 1, int(n * 0.99))], 2)
+            out[f"{name}_count"] = n
+        for name, c in counts.items():
+            out[f"{name}_total"] = c
+        return out
+
     def timed(self):
         """Context manager: with stats.timed(): process(frame)."""
         stats = self

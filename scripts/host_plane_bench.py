@@ -1,0 +1,227 @@
+"""Host media-plane throughput: per-packet baseline vs batched path.
+
+Measures the three TX stages ISSUE 2 rebuilt at frame granularity —
+packetize (RTP/FU-A), protect (SRTP AES128_CM_HMAC_SHA1_80), send (UDP
+socket flush) — over synthetic 512²-rate access units (default ~24 KiB
+-> 21 FU-A fragments at the 1200-byte MTU, 30 fps shape):
+
+  per-packet: PyRtpPacketizer (one struct.pack per fragment) +
+              SrtpContext._protect_legacy (fresh cipher + HMAC per
+              packet) + one sendto per packet — the pure-Python per
+              packet cost model the motivation describes.
+  batched:    BatchedRtpPacketizer (numpy header fills into a pooled
+              slot) + protect_frame (one keystream pass per frame) +
+              BatchSender (sendmmsg).
+
+Prints ONE JSON line (bank-and-commit contract) and appends it to
+PERF_LOG.jsonl (PERF_LOG_PATH overrides; empty value disables).  On a
+box without ``cryptography`` the protect legs are skipped and the line
+says so (secure:false) — packetize+send still measure.
+
+Env knobs: HOST_PLANE_BENCH_FRAMES (default 300), HOST_PLANE_BENCH_AU
+(default 24000 bytes), HOST_PLANE_BENCH_MTU (default 1200).
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ai_rtc_agent_tpu.media.rtp import BatchedRtpPacketizer, PyRtpPacketizer
+from ai_rtc_agent_tpu.media.sockio import BatchSender
+from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+FRAMES = int(os.getenv("HOST_PLANE_BENCH_FRAMES") or 300)
+AU_BYTES = int(os.getenv("HOST_PLANE_BENCH_AU") or 24000)
+MTU = int(os.getenv("HOST_PLANE_BENCH_MTU") or 1200)
+
+
+def _synthetic_au(rng_state: int) -> bytes:
+    """One 512²-shaped access unit: SPS+PPS-sized small NALs + one large
+    IDR NAL that fragments (the dominant streaming shape)."""
+    body = bytes((rng_state * 2654435761 + i * 97) & 0xFF for i in range(256))
+    big = (body * (AU_BYTES // 256 + 1))[: AU_BYTES - 40]
+    return (
+        b"\x00\x00\x00\x01" + b"\x67" + body[:12]
+        + b"\x00\x00\x00\x01" + b"\x68" + body[:4]
+        + b"\x00\x00\x00\x01" + b"\x65" + big
+    )
+
+
+def _srtp_pair():
+    try:
+        from ai_rtc_agent_tpu.server.secure.srtp import derive_srtp_contexts
+    except ImportError:
+        return None, None
+    km = b"\x5a" * 60
+    tx_batched, _ = derive_srtp_contexts(km, is_server=True)
+    tx_legacy, _ = derive_srtp_contexts(km, is_server=True)
+    return tx_batched, tx_legacy
+
+
+def _sink_socket():
+    sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    try:
+        sink.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 22)
+    except OSError:
+        pass
+    return sink, sink.getsockname()
+
+
+def run() -> dict:
+    au = _synthetic_au(7)
+    tx_batched, tx_legacy = _srtp_pair()
+    secure = tx_batched is not None
+
+    sink, addr = _sink_socket()
+    out_pp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    out_pp.setblocking(False)  # both paths drop on EAGAIN (real-time)
+    out_b = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    out_b.setblocking(False)
+    sender = BatchSender()
+
+    pp_pkt = PyRtpPacketizer(ssrc=0x5EED, payload_type=102, mtu=MTU)
+    b_pkt = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=102, mtu=MTU)
+
+    # warmup (scratch growth, pool growth, numpy import costs)
+    for i in range(3):
+        pkts = b_pkt.packetize(au, i * 3000)
+        if secure:
+            tx_batched.protect_frame(pkts)
+        pkts = pp_pkt.packetize(au, i * 3000)
+        if secure:
+            [tx_legacy._protect_legacy(p) for p in pkts]
+
+    n_pkts = len(pp_pkt.packetize(au, 0))
+
+    STAGES = ("packetize", "protect", "send")
+
+    def _per_packet_rep() -> dict:
+        t = dict.fromkeys(STAGES, 0.0)
+        t0 = time.perf_counter()
+        for i in range(FRAMES):
+            pkts = pp_pkt.packetize(au, i * 3000)
+            t1 = time.perf_counter()
+            if secure:
+                wires = [tx_legacy._protect_legacy(p) for p in pkts]
+            else:
+                wires = pkts
+            t2 = time.perf_counter()
+            for w in wires:
+                try:
+                    out_pp.sendto(w, addr)
+                except OSError:
+                    pass
+            t3 = time.perf_counter()
+            t["packetize"] += t1 - t0
+            t["protect"] += t2 - t1
+            t["send"] += t3 - t2
+            t0 = t3
+        return t
+
+    def _batched_rep() -> dict:
+        t = dict.fromkeys(STAGES, 0.0)
+        t0 = time.perf_counter()
+        for i in range(FRAMES):
+            pkts = b_pkt.packetize(au, i * 3000)
+            t1 = time.perf_counter()
+            wires = tx_batched.protect_frame(pkts) if secure else pkts
+            t2 = time.perf_counter()
+            sender.send(out_b, wires, addr)
+            t3 = time.perf_counter()
+            t["packetize"] += t1 - t0
+            t["protect"] += t2 - t1
+            t["send"] += t3 - t2
+            t0 = t3
+        return t
+
+    # interleaved best-of: the shared CI boxes throttle in bursts, so
+    # measuring the two paths in separate phases skews the ratio — run
+    # them alternately and take each LEG's min across reps (same
+    # min-robustness policy as tests/test_secure_rate.py)
+    pp_reps, b_reps = [], []
+    for _ in range(5):
+        pp_reps.append(_per_packet_rep())
+        b_reps.append(_batched_rep())
+    pp = {k: min(r[k] for r in pp_reps) for k in STAGES}
+    bt = {k: min(r[k] for r in b_reps) for k in STAGES}
+    per_packet_s = sum(pp.values())
+    batched_s = sum(bt.values())
+
+    for s in (sink, out_pp, out_b):
+        s.close()
+
+    pp_us = 1e6 * per_packet_s / FRAMES
+    b_us = 1e6 * batched_s / FRAMES
+    speedup = pp_us / b_us if b_us > 0 else 0.0
+    return {
+        "check": "host_plane_bench",
+        "secure": secure,
+        "mtu": MTU,
+        "au_bytes": len(au),
+        "pkts_per_frame": n_pkts,
+        "frames": FRAMES,
+        "per_packet_us_per_frame": round(pp_us, 1),
+        "batched_us_per_frame": round(b_us, 1),
+        "per_packet_leg_us": {
+            k: round(1e6 * v / FRAMES, 1) for k, v in pp.items()
+        },
+        "batched_leg_us": {
+            k: round(1e6 * v / FRAMES, 1) for k, v in bt.items()
+        },
+        "per_packet_pkts_per_s": round(n_pkts * FRAMES / per_packet_s),
+        "batched_pkts_per_s": round(n_pkts * FRAMES / batched_s),
+        "stages": "packetize+protect+send" if secure else "packetize+send",
+        # the contract quartet
+        "metric": "host_plane_batched_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup, 2),
+        "backend": "cpu",
+        "live": True,
+        "label": f"host_plane_{'full' if secure else 'nosrtp'}_{FRAMES}f",
+        "recorded_at": datetime.now(timezone.utc).isoformat(),
+    }
+
+
+def _bank(entry: dict) -> None:
+    path = os.getenv("PERF_LOG_PATH")
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "PERF_LOG.jsonl",
+        )
+    if not path or path == os.devnull:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        entry["bank_error"] = str(e)
+
+
+def main():
+    sigterm_to_exception("host_plane_bench timeout")
+    entry = {
+        "check": "host_plane_bench",
+        "metric": "host_plane_batched_speedup",
+        "value": 0.0,
+        "unit": "x",
+        "vs_baseline": 0.0,
+    }
+    try:
+        entry = run()
+        _bank(entry)
+    except Exception as e:  # contract: one JSON line on EVERY exit path
+        entry["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(entry))
+
+
+if __name__ == "__main__":
+    main()

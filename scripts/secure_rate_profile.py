@@ -21,10 +21,14 @@ PKT_SIZE = 1200  # MTU-filling FU-A fragment — the dominant media packet
 N = 5000
 
 
+FRAME_PKTS = 21  # ~24 KiB AU at a 1200-byte MTU (512² FU-A rate shape)
+
+
 def _profile_contexts(profile):
     km = b"\x5a" * 60
     tx, _rx = derive_srtp_contexts(km, is_server=True, profile=profile)
     _tx2, rx = derive_srtp_contexts(km, is_server=False, profile=profile)
+    txf, _rx2 = derive_srtp_contexts(km, is_server=True, profile=profile)
     import struct
 
     pkts = [
@@ -38,7 +42,16 @@ def _profile_contexts(profile):
     for w in wires:
         rx.unprotect(w)
     t2 = time.perf_counter()
-    return 1e6 * (t1 - t0) / N, 1e6 * (t2 - t1) / N
+    # frame-granular batch (ISSUE 2): whole 21-packet frames per call
+    frames = [
+        pkts[i : i + FRAME_PKTS] for i in range(0, N - FRAME_PKTS, FRAME_PKTS)
+    ]
+    t3 = time.perf_counter()
+    for f in frames:
+        txf.protect_frame(f)
+    t4 = time.perf_counter()
+    frame_us = 1e6 * (t4 - t3) / max(1, len(frames)) / FRAME_PKTS
+    return 1e6 * (t1 - t0) / N, 1e6 * (t2 - t1) / N, frame_us
 
 
 def _profile_handshake():
@@ -66,8 +79,8 @@ def _profile_handshake():
 
 
 def main():
-    cm_p, cm_u = _profile_contexts(PROFILE_AES128_CM_SHA1_80)
-    gcm_p, gcm_u = _profile_contexts(PROFILE_AEAD_AES_128_GCM)
+    cm_p, cm_u, cm_f = _profile_contexts(PROFILE_AES128_CM_SHA1_80)
+    gcm_p, gcm_u, gcm_f = _profile_contexts(PROFILE_AEAD_AES_128_GCM)
     hs_ms = _profile_handshake()
     # 30 fps 512² H.264 at realistic diffusion-output bitrates: every frame
     # spans several MTU packets; bound with a generous 400 pkt/s each way
@@ -80,8 +93,12 @@ def main():
                 "pkt_bytes": PKT_SIZE,
                 "srtp_cm_protect_us": round(cm_p, 2),
                 "srtp_cm_unprotect_us": round(cm_u, 2),
+                # batched tier (protect_frame, ISSUE 2): µs per packet
+                # when whole 21-packet frames protect in one call
+                "srtp_cm_protect_frame_us": round(cm_f, 2),
                 "srtp_gcm_protect_us": round(gcm_p, 2),
                 "srtp_gcm_unprotect_us": round(gcm_u, 2),
+                "srtp_gcm_protect_frame_us": round(gcm_f, 2),
                 "dtls_handshake_ms": round(hs_ms, 2),
                 "assumed_pkts_per_s": pkts_per_s,
                 "core_share_at_rate": round(core_share, 4),

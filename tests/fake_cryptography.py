@@ -1,0 +1,174 @@
+"""A faithful-semantics stand-in for the ``cryptography`` package.
+
+The image this repo grows on does not always ship ``cryptography`` (the
+secure tier degrades and its tests skip).  That would leave the batched
+SRTP path (srtp.protect_frame — ISSUE 2) completely unexercised on such
+boxes, so this fake implements the exact *mode semantics* the batch
+logic depends on while replacing the block function with a keyed hash:
+
+* CTR keystream block j == ECB(counter_block_0 + j) with 128-bit
+  big-endian increment — the identity protect_frame's precomputed
+  counter blocks rely on.  If the batch layout/IV math is wrong, batch
+  vs per-packet outputs diverge under this fake exactly as they would
+  under OpenSSL.
+* AESGCM: deterministic stream + hash tag over (key, iv, aad, ct).
+
+This is NOT cryptography and must never ship outside tests: install()
+only ever runs when the real package is absent, and uninstall() removes
+every injected module again so later tests see the true environment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import sys
+import types
+
+_MASK128 = (1 << 128) - 1
+
+
+def _ecb_block(key: bytes, block: bytes) -> bytes:
+    return hashlib.sha256(b"ECB" + key + block).digest()[:16]
+
+
+class _Encryptor:
+    def __init__(self, key: bytes, mode):
+        self._key = key
+        self._mode = mode
+        self._ctr = (
+            int.from_bytes(mode.iv, "big") if mode.kind == "ctr" else None
+        )
+
+    def update(self, data) -> bytes:
+        data = bytes(data)
+        if self._mode.kind == "ecb":
+            assert len(data) % 16 == 0, "ECB input must be block-aligned"
+            return b"".join(
+                _ecb_block(self._key, data[i : i + 16])
+                for i in range(0, len(data), 16)
+            )
+        n = (len(data) + 15) // 16
+        c = self._ctr
+        ks = b"".join(
+            _ecb_block(self._key, ((c + j) & _MASK128).to_bytes(16, "big"))
+            for j in range(n)
+        )
+        self._ctr = c + n
+        return bytes(bytearray(a ^ b for a, b in zip(data, ks)))
+
+    def finalize(self) -> bytes:
+        return b""
+
+
+class Cipher:
+    def __init__(self, algorithm, mode):
+        self._algorithm = algorithm
+        self._mode = mode
+
+    def encryptor(self):
+        return _Encryptor(self._algorithm.key, self._mode)
+
+    decryptor = encryptor  # CTR/ECB are symmetric here
+
+
+class AES:
+    def __init__(self, key):
+        self.key = bytes(key)
+
+
+class ECB:
+    kind = "ecb"
+
+
+class CTR:
+    kind = "ctr"
+
+    def __init__(self, iv):
+        self.iv = bytes(iv)
+
+
+class AESGCM:
+    def __init__(self, key):
+        self._key = bytes(key)
+
+    def _keystream(self, iv: bytes, n: int) -> bytes:
+        base = int.from_bytes(iv + b"\x00\x00\x00\x02", "big")
+        return b"".join(
+            _ecb_block(self._key, ((base + j) & _MASK128).to_bytes(16, "big"))
+            for j in range(n)
+        )
+
+    def _tag(self, iv: bytes, aad: bytes, ct: bytes) -> bytes:
+        return hashlib.sha256(
+            b"GCM" + self._key + iv + (aad or b"") + ct
+        ).digest()[:16]
+
+    def encrypt(self, iv, data, aad):
+        data = bytes(data)
+        ks = self._keystream(bytes(iv), (len(data) + 15) // 16)
+        ct = bytes(a ^ b for a, b in zip(data, ks))
+        return ct + self._tag(bytes(iv), bytes(aad or b""), ct)
+
+    def decrypt(self, iv, data, aad):
+        data = bytes(data)
+        ct, tag = data[:-16], data[-16:]
+        if self._tag(bytes(iv), bytes(aad or b""), ct) != tag:
+            raise ValueError("fake-GCM tag mismatch")
+        ks = self._keystream(bytes(iv), (len(ct) + 15) // 16)
+        return bytes(a ^ b for a, b in zip(ct, ks))
+
+
+_INJECTED: list[str] = []
+
+
+def install() -> None:
+    """Register the fake under the ``cryptography`` names.  Refuses to
+    shadow a real installation."""
+    if importlib.util.find_spec("cryptography") is not None:
+        raise RuntimeError("real cryptography present; refusing to shadow")
+    mods = {
+        "cryptography": types.ModuleType("cryptography"),
+        "cryptography.hazmat": types.ModuleType("cryptography.hazmat"),
+        "cryptography.hazmat.primitives": types.ModuleType(
+            "cryptography.hazmat.primitives"
+        ),
+        "cryptography.hazmat.primitives.ciphers": types.ModuleType(
+            "cryptography.hazmat.primitives.ciphers"
+        ),
+        "cryptography.hazmat.primitives.ciphers.aead": types.ModuleType(
+            "cryptography.hazmat.primitives.ciphers.aead"
+        ),
+    }
+    algorithms = types.SimpleNamespace(AES=AES)
+    modes = types.SimpleNamespace(ECB=ECB, CTR=CTR)
+    ciphers = mods["cryptography.hazmat.primitives.ciphers"]
+    ciphers.Cipher = Cipher
+    ciphers.algorithms = algorithms
+    ciphers.modes = modes
+    mods["cryptography.hazmat.primitives.ciphers.aead"].AESGCM = AESGCM
+    for name, mod in mods.items():
+        sys.modules[name] = mod
+        _INJECTED.append(name)
+
+
+def uninstall() -> None:
+    """Remove every injected module so later imports see the truth."""
+    while _INJECTED:
+        sys.modules.pop(_INJECTED.pop(), None)
+
+
+def load_srtp():
+    """Import server/secure/srtp.py as a PRIVATE module instance bound to
+    whatever ``cryptography`` is currently importable (the fake, inside
+    an install()/uninstall() window).  The real package-level module is
+    never touched, so nothing leaks into other tests."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ai_rtc_agent_tpu", "server", "secure", "srtp.py",
+    )
+    spec = importlib.util.spec_from_file_location("_srtp_under_fake_crypto", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

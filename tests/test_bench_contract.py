@@ -226,6 +226,43 @@ def test_bench_refuses_to_contend_with_unreleased_claim(tmp_path):
     assert not stop.exists()  # pause file reaped even on the refusal path
 
 
+def test_host_plane_bench_contract_and_speedup(tmp_path):
+    """Host-plane microbench smoke (ISSUE 2): runs in seconds on CPU,
+    emits exactly one contract line, BANKS it into PERF_LOG_PATH, and the
+    batched path must not be slower than per-packet.  The ratio fence is
+    deliberately loose (the ≥3x acceptance number is measured by a full
+    run on an uncontended box); a regression that makes batching SLOWER
+    than the per-packet loop still fails here."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "PERF_LOG_PATH": str(log),
+            "HOST_PLANE_BENCH_FRAMES": "60",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/host_plane_bench.py"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "host_plane_batched_speedup"
+    assert d["pkts_per_frame"] >= 15  # 512²-rate FU-A shape at 1200 MTU
+    # not-slower fence with headroom for a contended 1-core CI box
+    assert d["value"] >= 0.9, d
+    # banked: the same entry landed in the log
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "host_plane_batched_speedup"
+
+
 def test_unet_cache_prefix_validated():
     """advisor r3: 'foo:3' must not parse as a valid UNET_CACHE spelling."""
     import pytest

@@ -442,7 +442,10 @@ def test_rtp_client_drain_survives_bursts(native_lib):
                 f = VideoFrame.from_ndarray(np.full((64, 64, 3), 20 * i, np.uint8))
                 f.pts = i * 3000
                 for pkt in sink.consume(f):
-                    c._recv_q.put_nowait(pkt)
+                    # queued across frames: outlives the packetizer pool
+                    # window, so take a stable copy (pool contract,
+                    # media/rtp.py module docstring)
+                    c._recv_q.put_nowait(bytes(pkt))
             got = c.drain()
             assert got >= 8, got  # codec delay may hold back 1-2 frames
             assert c.back.dropped == 0

@@ -214,6 +214,36 @@ def test_metrics_endpoint():
     run(go())
 
 
+def test_metrics_exposes_host_plane_sessions():
+    """ISSUE 2: /metrics carries per-session packetize/protect/send/recv
+    µs histograms when the provider runs the batched host plane."""
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+    from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+    async def go():
+        provider = NativeRtpProvider()
+        app = build_app(pipeline=FakePipeline(), provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            st = FrameStats()
+            for us in (3e-6, 5e-6, 8e-6):
+                st.record_stage("packetize", us)
+                st.record_stage("send", us)
+            provider.register_plane_session("pc-test", st)
+            body = await (await client.get("/metrics")).json()
+            sess = body["host_plane_sessions"]["pc-test"]
+            assert sess["packetize_count"] == 3
+            assert sess["send_p90_us"] > sess["send_p50_us"] > 0
+            provider.unregister_plane_session("pc-test")
+            body = await (await client.get("/metrics")).json()
+            assert body["host_plane_sessions"] == {}
+        finally:
+            await client.close()
+
+    run(go())
+
+
 def test_whep_session_scoped_delete(monkeypatch):
     """DELETE /whep/{session} (the Location we return) closes ONLY that
     subscriber; other viewers keep streaming (VERDICT r1 weak #6)."""
@@ -568,13 +598,21 @@ def test_default_provider_without_aiortc_is_native(monkeypatch):
         return real_import(name, *a, **kw)
 
     monkeypatch.setattr(builtins, "__import__", no_aiortc)
+    import importlib.util
+
     from ai_rtc_agent_tpu.media import native
     from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
     from ai_rtc_agent_tpu.server.signaling import LoopbackProvider, get_provider
 
-    if native.load() is None:
-        # toolchain-less box: the documented degrade is a WORKING loopback
-        assert isinstance(get_provider(), LoopbackProvider)
-    else:
+    native_tier_viable = (
+        native.load() is not None
+        # the native tier also needs the secure stack's crypto backend —
+        # without it every browser session would die at setup, so the
+        # documented degrade is a WORKING loopback (signaling.py r5)
+        and importlib.util.find_spec("cryptography") is not None
+    )
+    if native_tier_viable:
         assert isinstance(get_provider(), NativeRtpProvider)
+    else:
+        assert isinstance(get_provider(), LoopbackProvider)
     assert isinstance(get_provider("loopback"), LoopbackProvider)
