@@ -1,0 +1,33 @@
+"""First-party AST static analysis: the repo's cross-cutting invariants,
+enforced by machine (ISSUE 3).
+
+The serving process juggles an asyncio WebRTC plane, daemon step-runner
+threads, pooled zero-copy buffers and jitted TPU code in one address
+space.  Each of those regimes has a lifetime/purity rule that a normal
+linter cannot know — and that has already shipped real bugs when enforced
+only by convention (ROADMAP Open Items; the PR 2 chaos-TX pooled-view
+fix).  This package encodes the rules as checkers over stdlib ``ast``
+(no new dependencies):
+
+  async-blocking     blocking calls lexically inside ``async def``
+  pooled-view        pool-returned memoryviews escaping frame scope
+  trace-purity       host state reads inside jitted/pallas functions
+  env-registry       env knobs <-> docs/environment.md, both directions
+  metrics-registry   /metrics name grammar + collision freedom
+  retry-4xx          permanent HTTP 4xx retried as transient (shipped
+                     bug: server/worker.py default_publish)
+  restart-defaults   recovery paths re-applying compile-time defaults
+                     (shipped bug: stream/pipeline.py restart())
+
+Driver: ``python scripts/check_static.py`` (text/json, --changed,
+shrink-only baseline).  Catalog + suppression syntax:
+docs/static-analysis.md.  Self-tests: tests/test_static_analysis.py.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Project,
+    load_project,
+    run_checkers,
+    ALL_CHECKERS,
+)

@@ -1,0 +1,302 @@
+"""Shared analyzer plumbing: project loading, findings, suppressions.
+
+A finding's :meth:`Finding.key` deliberately excludes the line number, so
+the checked-in baseline survives unrelated edits above a finding; the
+line is still reported for humans.  Suppressions are per-finding inline
+comments with a mandatory reason::
+
+    pkts = self._hold(view)  # tpurtc: allow[pooled-view] -- copied in _hold
+
+placed on the flagged line or the line directly above.  A reasonless or
+unused suppression is itself a finding (checker id ``suppression``) —
+the allowlist can never silently rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+SUPPRESSION_RE = re.compile(
+    r"#\s*tpurtc:\s*allow\[([a-z0-9_,-]+)\]\s*(?:--\s*(\S.*))?$"
+)
+
+# directories never scanned (fixtures are known-bad on purpose)
+SKIP_PARTS = {"__pycache__", ".git", "tests", "node_modules"}
+
+DEFAULT_ROOTS = (
+    "ai_rtc_agent_tpu",
+    "scripts",
+    "examples",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+
+@dataclass
+class Finding:
+    checker: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    name: str  # the offending symbol / knob / metric
+    message: str
+    scope: str = "<module>"  # enclosing function qualname
+
+    def key(self) -> str:
+        """Stable baseline identity (no line number — survives drift)."""
+        return f"{self.checker}:{self.path}:{self.scope}:{self.name}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+            f" (in {self.scope})"
+        )
+
+
+@dataclass
+class Suppression:
+    line: int
+    checkers: tuple
+    reason: str | None
+    used: bool = False
+
+
+@dataclass
+class Module:
+    path: Path
+    rel: str
+    source: str
+    tree: ast.Module
+    suppressions: list = field(default_factory=list)
+
+    def suppression_for(self, checker: str, line: int):
+        """The suppression covering ``checker`` at ``line`` (same line or
+        the line directly above), or None."""
+        for s in self.suppressions:
+            if s.line in (line, line - 1) and checker in s.checkers:
+                return s
+        return None
+
+
+@dataclass
+class Project:
+    root: Path
+    modules: list
+
+    def module(self, rel: str):
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+    def doc_text(self, rel: str) -> str:
+        p = self.root / rel
+        return p.read_text() if p.exists() else ""
+
+
+def _parse_suppressions(source: str) -> list:
+    """Real COMMENT tokens only — a suppression example quoted in a
+    docstring must not become a live allow."""
+    out = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESSION_RE.search(tok.string)
+            if m:
+                checkers = tuple(c.strip() for c in m.group(1).split(","))
+                out.append(Suppression(tok.start[0], checkers, m.group(2)))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # ast.parse accepted it; lose suppressions, not the run
+    return out
+
+
+def load_module(path: Path, root: Path):
+    """-> (Module | None, Finding | None): unparseable files become a
+    ``parse-error`` finding instead of killing the run."""
+    try:
+        rel = path.relative_to(root).as_posix()
+    except ValueError:  # outside the repo (fixture / probe runs)
+        rel = path.as_posix()
+    source = path.read_text(errors="replace")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return None, Finding(
+            "parse-error", rel, e.lineno or 1, path.name,
+            f"cannot parse: {e.msg}",
+        )
+    except ValueError as e:  # e.g. NUL bytes in the source
+        return None, Finding(
+            "parse-error", rel, 1, path.name, f"cannot parse: {e}",
+        )
+    return Module(path, rel, source, tree, _parse_suppressions(source)), None
+
+
+def iter_py_files(root: Path, roots=DEFAULT_ROOTS):
+    for r in roots:
+        p = root / r
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                # skip-list applies to REPO-relative parts only — a
+                # checkout under a directory named tests/ must not
+                # skip the whole repo
+                if not (set(f.relative_to(root).parts) & SKIP_PARTS):
+                    yield f
+
+
+def load_project(root, roots=DEFAULT_ROOTS, files=None):
+    """Load the scan set.  ``files`` (explicit paths, e.g. --changed mode)
+    overrides ``roots``.  -> (Project, [parse-error findings])."""
+    root = Path(root).resolve()
+    errors = []
+    modules = []
+    paths = (
+        [Path(f).resolve() for f in files]
+        if files is not None
+        else iter_py_files(root, roots)
+    )
+    for p in paths:
+        if not p.exists() or p.suffix != ".py":
+            continue
+        mod, err = load_module(p, root)
+        if err is not None:
+            errors.append(err)
+        else:
+            modules.append(mod)
+    return Project(root, modules), errors
+
+
+def apply_suppressions(project: Project, findings: list, ran=None) -> list:
+    """Drop findings covered by an inline allow; then add suppression-
+    hygiene findings (missing reason, unused allow).  ``ran`` is the set
+    of checkers that actually executed — an allow for a checker that was
+    skipped this run (--changed / explicit files) cannot be proven
+    unused."""
+    kept = []
+    for f in findings:
+        mod = project.module(f.path)
+        s = mod.suppression_for(f.checker, f.line) if mod else None
+        if s is not None:
+            s.used = True
+            if s.reason:  # reasonless allows do NOT suppress
+                continue
+        kept.append(f)
+    for mod in project.modules:
+        for s in mod.suppressions:
+            if not s.reason:
+                kept.append(Finding(
+                    "suppression", mod.rel, s.line,
+                    ",".join(s.checkers),
+                    "suppression without a reason — append "
+                    "'-- <why this is safe>'",
+                ))
+            elif not s.used and (
+                ran is None or set(s.checkers) & set(ran)
+            ):
+                kept.append(Finding(
+                    "suppression", mod.rel, s.line,
+                    ",".join(s.checkers),
+                    "unused suppression — the finding it allowed is gone; "
+                    "delete the comment",
+                ))
+    return kept
+
+
+def run_checkers(project: Project, checkers=None) -> list:
+    from . import (
+        async_blocking,
+        env_registry,
+        metrics_registry,
+        pooled_views,
+        regressions,
+        trace_purity,
+    )
+
+    registry = {
+        "async-blocking": async_blocking.check,
+        "pooled-view": pooled_views.check,
+        "trace-purity": trace_purity.check,
+        "env-registry": env_registry.check,
+        "metrics-registry": metrics_registry.check,
+        "retry-4xx": regressions.check_retry_4xx,
+        "restart-defaults": regressions.check_restart_defaults,
+    }
+    findings = []
+    ran = tuple(checkers or registry)
+    for name in ran:
+        findings.extend(registry[name](project))
+    findings = apply_suppressions(project, findings, ran=ran)
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings
+
+
+ALL_CHECKERS = (
+    "async-blocking",
+    "pooled-view",
+    "trace-purity",
+    "env-registry",
+    "metrics-registry",
+    "retry-4xx",
+    "restart-defaults",
+)
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def dotted(node) -> str:
+    """Best-effort dotted name of an expression ('time.sleep',
+    'self._pool.acquire'); '' when it has no static name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def terminal_name(node) -> str:
+    """Rightmost identifier of a Name/Attribute ('self._pool' -> '_pool')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def const_str(node):
+    """The literal string value of a node, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor tracking the enclosing function qualname in
+    ``self.scope`` ('Class.method' / '<module>')."""
+
+    def __init__(self):
+        self._stack = []
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._stack) or "<module>"
+
+    def _in_named(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _in_named
+    visit_AsyncFunctionDef = _in_named
+    visit_ClassDef = _in_named

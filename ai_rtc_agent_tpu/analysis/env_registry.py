@@ -1,0 +1,152 @@
+"""Checker: env knobs <-> docs/environment.md, in both directions.
+
+The reference repo's config story was "grep the source"; this repo's is
+docs/environment.md — useful exactly as long as it is complete.  Three
+rules keep it that way:
+
+* **undocumented-knob** — every ``env.get_*("NAME", ...)`` call site
+  (including the canonical accessors inside utils/env.py and the
+  ``get_*_aliased`` legacy names) must name a knob documented in
+  docs/environment.md.
+* **unread-knob** — every knob documented there must have at least one
+  read site anywhere in the scan set (typed accessor, ``os.getenv``,
+  ``os.environ.get``/``[...]`` all count).
+* **raw-read** — inside the ``ai_rtc_agent_tpu`` package (utils/env.py
+  itself exempt), env reads must go through the typed accessor tier;
+  bare ``os.getenv``/``os.environ`` reads reintroduce exactly the
+  unconverted-string class of bug (the reference's WARMUP_FRAMES
+  TypeError) the tier exists to kill.  Operator scripts and bench.py may
+  read raw (their knobs are process-lifecycle, not serving config).
+* **dynamic-knob** — a non-literal knob name defeats the registry;
+  suppress with a reason if truly needed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, ScopedVisitor, const_str, dotted
+
+CHECKER = "env-registry"
+
+DOC_PATH = "docs/environment.md"
+_DOC_NAME_RE = re.compile(r"`([A-Z][A-Z0-9_]{2,})`")
+_GETTERS = {
+    "get_str", "get_int", "get_float", "get_bool",
+    "get_str_aliased", "get_int_aliased",
+}
+# knobs consumed by external tooling (the doc documents them for
+# operators even though no code in the scan set reads them)
+_EXTERNAL_OK = {"HF_HUB_CACHE"}
+
+
+def documented_knobs(doc_text: str) -> dict:
+    """knob name -> first doc line number, from table rows only."""
+    out = {}
+    for i, line in enumerate(doc_text.splitlines(), start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cell = line.split("|")[1] if line.count("|") >= 2 else line
+        for m in _DOC_NAME_RE.finditer(cell):
+            out.setdefault(m.group(1), i)
+    return out
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, mod):
+        super().__init__()
+        self.mod = mod
+        self.reads = []  # (name, line, scope, via_typed)
+        self.dynamic = []  # (line, scope, call repr)
+        self.raw = []  # (name_or_?, line, scope)
+
+    def visit_Call(self, node):
+        name = dotted(node.func)
+        tail = name.split(".")[-1] if name else ""
+        if tail in _GETTERS and isinstance(node.func, ast.Attribute):
+            lits = []
+            for a in node.args[: 2 if tail.endswith("_aliased") else 1]:
+                s = const_str(a)
+                if s is not None:
+                    lits.append(s)
+                elif a is node.args[0]:
+                    self.dynamic.append((node.lineno, self.scope, name))
+            for s in lits:
+                self.reads.append((s, node.lineno, self.scope))
+        elif tail in _GETTERS and isinstance(node.func, ast.Name):
+            # `from ..utils.env import get_str` style — same rules
+            s = const_str(node.args[0]) if node.args else None
+            if s is None:
+                self.dynamic.append((node.lineno, self.scope, tail))
+            else:
+                self.reads.append((s, node.lineno, self.scope))
+        elif name in ("os.getenv", "os.environ.get"):
+            s = const_str(node.args[0]) if node.args else None
+            self.raw.append((s or "?", node.lineno, self.scope))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if (
+            dotted(node.value) == "os.environ"
+            and isinstance(node.ctx, ast.Load)
+        ):
+            s = const_str(node.slice)
+            self.raw.append((s or "?", node.lineno, self.scope))
+        self.generic_visit(node)
+
+
+def check(project) -> list:
+    doc_text = project.doc_text(DOC_PATH)
+    documented = documented_knobs(doc_text)
+    findings = []
+    read_names = set()
+    for mod in project.modules:
+        v = _Visitor(mod)
+        v.visit(mod.tree)
+        in_pkg = mod.rel.startswith("ai_rtc_agent_tpu/")
+        is_env_tier = mod.rel == "ai_rtc_agent_tpu/utils/env.py"
+        for name, line, scope in v.reads:
+            read_names.add(name)
+            if name not in documented:
+                findings.append(Finding(
+                    CHECKER, mod.rel, line, name,
+                    f"env knob {name} is read here but not documented in "
+                    f"{DOC_PATH} — add a table row", scope,
+                ))
+        for line, scope, call in v.dynamic:
+            if is_env_tier:
+                # the accessor tier's own plumbing (get_*_aliased
+                # forwarding `name`) is the one legitimate dynamic reader
+                continue
+            findings.append(Finding(
+                CHECKER, mod.rel, line, "<dynamic>",
+                f"{call} with a non-literal knob name defeats the "
+                "registry — use a literal or suppress with a reason",
+                scope,
+            ))
+        for name, line, scope in v.raw:
+            if name != "?":
+                read_names.add(name)
+            if in_pkg and not is_env_tier:
+                findings.append(Finding(
+                    CHECKER, mod.rel, line, name,
+                    f"raw env read of {name} — use the typed accessor "
+                    "tier (utils/env.py) so parse bugs cannot exist",
+                    scope,
+                ))
+    if doc_text:
+        for name, line in sorted(documented.items()):
+            if name not in read_names and name not in _EXTERNAL_OK:
+                findings.append(Finding(
+                    CHECKER, DOC_PATH, line, name,
+                    f"documented env knob {name} has no read site in the "
+                    "scan set — stale doc row or dead knob",
+                    "<doc>",
+                ))
+    return findings
+
+
+def _suppression_site_note():
+    """docs/environment.md is not python, so unread-knob findings cannot
+    be inline-suppressed; fix the doc (or the code) instead."""

@@ -149,7 +149,9 @@ def find_hed_checkpoint() -> str | None:
     import glob
     import os
 
-    explicit = os.getenv("HED_CHECKPOINT")
+    from ..utils import env as env_util
+
+    explicit = env_util.get_str("HED_CHECKPOINT")
     if explicit and os.path.exists(explicit):
         return explicit
     from . import registry

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import env as env_util
 from ..stream.engine import (
     StreamConfig,
     StreamModels,
@@ -139,7 +140,7 @@ def default_stream_config(model_id: str, **overrides) -> StreamConfig:
     # DeepCache-style temporal UNet feature reuse: UNET_CACHE=N (or
     # "deepcache:N") runs the full UNet every Nth frame and only the
     # outermost tier between — opt-in; see StreamConfig.unet_cache_interval
-    env_cache = os.getenv("UNET_CACHE", "")
+    env_cache = env_util.get_str("UNET_CACHE") or ""
     if env_cache and "unet_cache_interval" not in base:
         prefix, _, n = env_cache.rpartition(":")
         if prefix not in ("", "deepcache"):
@@ -206,10 +207,10 @@ def cast_params(params, dtype: str):
             lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
             params,
         )
-    if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
+    if (env_util.get_str("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
         from . import quant
 
-        min_size = int(os.getenv("QUANT_MIN_SIZE") or quant.MIN_SIZE)
+        min_size = env_util.get_int("QUANT_MIN_SIZE", quant.MIN_SIZE)
         params, n = quant.quantize_params(params, min_size=min_size)
         logger.info("quantized %d kernels to int8 (w8a16)", n)
     return params
@@ -220,7 +221,7 @@ def resolve_snapshot_dir(model_id: str) -> str | None:
     parity with reference Dockerfile:50)."""
     if os.path.isdir(model_id):
         return model_id
-    cache = os.getenv("HF_HUB_CACHE") or os.path.expanduser(
+    cache = env_util.get_str("HF_HUB_CACHE") or os.path.expanduser(
         "~/.cache/huggingface/hub"
     )
     safe = "models--" + model_id.replace("/", "--")

@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import random
 import time
 from dataclasses import dataclass
@@ -230,7 +229,9 @@ class EngineFaultScope:
 
 
 def _install_from_env() -> None:
-    raw = os.getenv("FAULT_PLAN")
+    from ..utils import env as env_util
+
+    raw = env_util.get_str("FAULT_PLAN")
     if not raw:
         return
     try:

@@ -155,6 +155,17 @@ class SessionSupervisor:
                 self._next_probe = now + self.probe_interval_s
             return True
 
+    def may_finish_inflight(self) -> bool:
+        """A frame whose submit was granted keeps that grant through its
+        fetch.  The probe throttle is a TOKEN consumed at submit time —
+        the token rides with the in-flight frame, so re-checking
+        :meth:`should_try_engine` at fetch would always see the window
+        closed and discard every pipelined probe as passthrough, pinning
+        the session DEGRADED forever (ROADMAP open item 1).  Only FAILED
+        revokes work already in flight."""
+        with self._lock:
+            return self._state != FAILED
+
     def snapshot(self) -> dict:
         with self._lock:
             now = self._clock()
@@ -591,7 +602,9 @@ class ResilientPipeline:
             )
         _, inner_handle, frame = handle
         src = src_frame if src_frame is not None else frame
-        if not self._engine_enabled():
+        # a "live" handle carries its submit-time grant (probe token
+        # included) — do NOT re-run the throttled gate here
+        if not self.supervisor.may_finish_inflight():
             return self._passthrough(src)
         t0 = time.monotonic()
         ok, out = self._run_bounded(self._inner.fetch, inner_handle, src_frame)
@@ -621,7 +634,7 @@ class ResilientPipeline:
             return list(srcs)
         _, inner_handle, frames = handle
         srcs = src_frames if src_frames is not None else frames
-        if not self._engine_enabled():
+        if not self.supervisor.may_finish_inflight():
             self.supervisor.note_frame_out(len(srcs), processed=False)
             return list(srcs)
         t0 = time.monotonic()

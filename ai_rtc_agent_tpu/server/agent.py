@@ -78,6 +78,7 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
             proto._send_pli()
 
     def on_transition(old, new, reason):
+        # tpurtc: allow[metrics-registry] -- closed enum: new is one of the 4 supervisor states, keys supervisor_{healthy,degraded,recovering,failed}_total
         stats.count(f"supervisor_{new.lower()}")
 
         def fire():
@@ -150,12 +151,27 @@ def patch_loop_datagram(local_ports: List[int]):
 def apply_runtime_config(pipeline, config: dict):
     if not isinstance(config, dict):
         raise ValueError("config must be a JSON object")
+    guidance_scale = config.get("guidance_scale")
+    delta = config.get("delta")
+    update_guidance = getattr(pipeline, "update_guidance", None)
+    # capability AND value checks BEFORE any mutation: a 400 must mean
+    # "nothing was applied", not "the prompt changed but guidance was
+    # refused" — so non-numeric values fail here, not mid-apply
+    if guidance_scale is not None or delta is not None:
+        if update_guidance is None:  # multipeer global plane has no knob
+            raise ValueError(
+                "guidance_scale/delta not supported by this pipeline"
+            )
+        guidance_scale = None if guidance_scale is None else float(guidance_scale)
+        delta = None if delta is None else float(delta)
     t_index_list = config.get("t_index_list")
     if t_index_list is not None:
         pipeline.update_t_index_list(t_index_list)
     prompt = config.get("prompt")
     if prompt is not None:
         pipeline.update_prompt(prompt)
+    if guidance_scale is not None or delta is not None:
+        update_guidance(guidance_scale=guidance_scale, delta=delta)
 
 
 def _wire_datachannel(pipeline, channel, guard=None):

@@ -359,6 +359,7 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         if self._rx_faults is not None:
             # injected loss/dup/reorder/delay/truncation (chaos testing);
             # delayed copies re-enter via _ingest so they are not re-faulted
+            # tpurtc: allow[pooled-view] -- _drained stabilizes to bytes before _one whenever _rx_faults is active; pooled views only reach here when the injector is None
             for d, delay in self._rx_faults.apply(data):
                 if delay > 0:
                     self._loop.call_later(delay, self._ingest, d, addr)
@@ -922,7 +923,7 @@ class NativeRtpProvider:
         self.stats = stats
         # address written into real-SDP answers (c= / a=candidate); plain
         # RTP has no ICE so the operator advertises the reachable interface
-        self.advertise_host = advertise_host or os.getenv(
+        self.advertise_host = advertise_host or env_util.get_str(
             "ADVERTISE_HOST", "127.0.0.1"
         )
         self._dtls_certificate = None

@@ -29,8 +29,9 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import os
 import uuid
+
+from ..utils import env as env_util
 
 logger = logging.getLogger(__name__)
 
@@ -287,7 +288,7 @@ class AiortcProvider:
 
 
 def get_provider(name: str | None = None):
-    name = name or os.getenv("WEBRTC_PROVIDER")
+    name = name or env_util.get_str("WEBRTC_PROVIDER")
 
     def native():
         from .rtc_native import NativeRtpProvider

@@ -43,9 +43,14 @@ def check_server(url: str, budget_s: float = HEALTH_BUDGET_S) -> bool:
     retry helper owns the schedule (resilience/retry.py)."""
 
     def probe():
-        with urllib.request.urlopen(url, timeout=2) as r:
-            if r.status != 200:
-                raise OSError(f"health returned {r.status}")
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                if r.status != 200:
+                    raise OSError(f"health returned {r.status}")
+        except urllib.error.HTTPError as e:
+            # a poll is the one place 4xx IS retryable: routes mount
+            # after the socket opens, so early probes can 404 briefly
+            raise OSError(f"health returned {e.code}") from e
         return True
 
     ok = poll_policy(budget_s, POLL_INTERVAL_S).run(
@@ -61,10 +66,20 @@ def check_server(url: str, budget_s: float = HEALTH_BUDGET_S) -> bool:
     return ok
 
 
+class _PermanentPublishError(Exception):
+    """Publish rejected with HTTP 4xx: re-POSTing the identical request
+    cannot succeed, so it must not consume retry attempts."""
+
+
 def default_publish(info: dict) -> bool:
     """POST connection info to WORKER_PUBLISH_URL (Bearer AUTH_TOKEN) —
     the generic analog of Runpod's progress_update.  Retries transient
-    failures under the shared backoff policy; returns success."""
+    failures under the shared backoff policy; a permanent 4xx rejection
+    fails after exactly one attempt (urlopen raises HTTPError — a
+    URLError subclass — BEFORE the status check, so without the explicit
+    catch the retry_on tuple would re-POST a 404 until the budget burned:
+    ROADMAP open item 3, now also held by the retry-4xx checker).
+    Returns success."""
     url = env.get_str("WORKER_PUBLISH_URL")
     if not url:
         logger.info("no WORKER_PUBLISH_URL; connection info: %s", info)
@@ -83,18 +98,27 @@ def default_publish(info: dict) -> bool:
     )
 
     def post():
-        with urllib.request.urlopen(req, timeout=5) as r:
-            if not 200 <= r.status < 300:
-                raise OSError(f"publish returned {r.status}")
-            logger.info("published worker info (%d)", r.status)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                if not 200 <= r.status < 300:
+                    raise OSError(f"publish returned {r.status}")
+                logger.info("published worker info (%d)", r.status)
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                raise _PermanentPublishError(f"publish returned {e.code}") from e
+            raise  # 5xx stays retryable (HTTPError is a URLError)
         return True
 
-    ok = transient_policy(attempts=PUBLISH_ATTEMPTS).run(
-        post,
-        retry_on=(urllib.error.URLError, OSError),
-        default=False,
-        label="worker publish",
-    )
+    try:
+        ok = transient_policy(attempts=PUBLISH_ATTEMPTS).run(
+            post,
+            retry_on=(urllib.error.URLError, OSError),
+            default=False,
+            label="worker publish",
+        )
+    except _PermanentPublishError as e:
+        logger.error("worker publish rejected (terminal): %s", e)
+        return False
     if not ok:
         logger.warning("worker publish failed after %d attempts", PUBLISH_ATTEMPTS)
     return ok
@@ -111,9 +135,9 @@ def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
         return 1
     ok = publish(
         {
-            "worker_id": os.getenv("WORKER_ID", os.uname().nodename),
-            "public_ip": os.getenv("PUBLIC_IP", ""),
-            "public_port": os.getenv("PUBLIC_PORT", str(agent_port)),
+            "worker_id": env.get_str("WORKER_ID", os.uname().nodename),
+            "public_ip": env.get_str("PUBLIC_IP", ""),
+            "public_port": env.get_str("PUBLIC_PORT", str(agent_port)),
             "status": "ready",
         }
     )

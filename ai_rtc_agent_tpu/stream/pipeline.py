@@ -56,6 +56,11 @@ class StreamDiffusionPipeline:
     ):
         self.prompt = prompt
         self.model_id = model_id
+        # live control-plane params — restart() restores THESE, never the
+        # module defaults (a fault recovery must not revert /config:
+        # ROADMAP open item 2, held by the restart-defaults checker)
+        self.guidance_scale = DEFAULT_GUIDANCE_SCALE
+        self.delta = DEFAULT_DELTA
         # optional NSFW gate (reference use_safety_checker,
         # lib/wrapper.py:930-942); env SAFETY_CHECKER enables it globally
         self.safety_checker = maybe_load_safety_checker(model_id, use_safety_checker)
@@ -86,8 +91,8 @@ class StreamDiffusionPipeline:
             )
             eng.prepare(
                 prompt=prompt,
-                guidance_scale=DEFAULT_GUIDANCE_SCALE,
-                delta=DEFAULT_DELTA,
+                guidance_scale=self.guidance_scale,
+                delta=self.delta,
                 seed=seed,
             )
             # Serving fast path: adopt a prebuilt AOT engine when one exists
@@ -207,11 +212,13 @@ class StreamDiffusionPipeline:
             )
         try:
             # prepare() rebuilds coefficients from the engine's tracked
-            # t_index_list, so runtime t-index updates survive the restart
+            # t_index_list, so runtime t-index updates survive the restart;
+            # prompt/guidance/delta restore from the live snapshots this
+            # façade tracks (update_prompt / update_guidance)
             self.engine.prepare(
                 prompt=self.prompt,
-                guidance_scale=DEFAULT_GUIDANCE_SCALE,
-                delta=DEFAULT_DELTA,
+                guidance_scale=self.guidance_scale,
+                delta=self.delta,
                 seed=self._seed,
             )
         finally:
@@ -220,12 +227,29 @@ class StreamDiffusionPipeline:
     # -- control plane (reference lib/pipeline.py:44-48) --------------------
 
     def update_prompt(self, prompt: str):
-        self.prompt = prompt
+        # engine first, snapshot after — restart() restores self.prompt,
+        # and a rejected update must never be what it restores (same
+        # accept-then-snapshot rule as update_guidance)
         self.engine.update_prompt(prompt)
+        self.prompt = prompt
 
     def update_t_index_list(self, t_index_list: Sequence[int]):
         self.engine.update_t_index_list(t_index_list)
         self.t_index_list = list(t_index_list)
+
+    def update_guidance(self, guidance_scale=None, delta=None):
+        """Runtime guidance/delta update (POST /config) — tracked here so
+        a supervisor-driven restart() re-prepares with the LIVE values.
+        Values convert (and so can fail) BEFORE anything mutates, and the
+        façade snapshot updates only after the engine accepted them — a
+        rejected update must never be what a later restart() restores."""
+        g = None if guidance_scale is None else float(guidance_scale)
+        d = None if delta is None else float(delta)
+        self.engine.update_guidance(guidance_scale=g, delta=d)
+        if g is not None:
+            self.guidance_scale = g
+        if d is not None:
+            self.delta = d
 
     # -- frame path (reference lib/pipeline.py:50-96) -----------------------
 

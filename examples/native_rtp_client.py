@@ -38,8 +38,15 @@ def _post(url: str, body: bytes, ctype: str) -> bytes:
         req = urllib.request.Request(
             url, data=body, headers={"Content-Type": ctype}
         )
-        with urllib.request.urlopen(req, timeout=30) as r:
-            return r.read()
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                # a rejected offer will be rejected again — don't re-POST
+                # it through the whole backoff budget (retry-4xx checker)
+                raise RuntimeError(f"signaling rejected: HTTP {e.code}") from e
+            raise  # 5xx / mid-restart answers stay retryable
 
     return transient_policy(attempts=5, base_delay_s=1.0).run(
         once, retry_on=(urllib.error.URLError, OSError), label=f"POST {url}"

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Run the in-repo invariant analyzer (ai_rtc_agent_tpu/analysis).
+
+    python scripts/check_static.py                 # full scan, text report
+    python scripts/check_static.py --format=json   # machine-readable
+    python scripts/check_static.py --changed       # git-diff-scoped (fast
+                                                   # pre-commit loop)
+    python scripts/check_static.py --update-baseline
+
+Exit codes: 0 clean, 1 findings (or baseline violations), 2 usage/internal.
+
+The baseline (scripts/static_analysis_baseline.json) may only SHRINK: a
+finding not listed there fails the run, and a listed finding that no
+longer fires must be removed (``--update-baseline`` does it; it refuses
+to *add* entries).  The repo ships with an empty baseline — keep it that
+way.  Catalog + suppression syntax: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from ai_rtc_agent_tpu.analysis import load_project, run_checkers  # noqa: E402
+from ai_rtc_agent_tpu.analysis.core import DEFAULT_ROOTS  # noqa: E402
+
+BASELINE_PATH = REPO_ROOT / "scripts" / "static_analysis_baseline.json"
+
+
+def load_baseline(path: Path) -> set:
+    if not path.exists():
+        return set()
+    return set(json.loads(path.read_text()).get("findings", []))
+
+
+def changed_files(root: Path) -> list:
+    """Tracked-modified + staged + untracked .py files under the scan
+    roots (the pre-commit scope)."""
+    out = subprocess.run(
+        # -uall: expand untracked DIRECTORIES to their files (a plain
+        # porcelain listing compacts a new package to one "?? dir/" row,
+        # which would silently skip every file in it)
+        ["git", "-C", str(root), "status", "--porcelain", "-uall"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    files = []
+    top = {r.split("/")[0] for r in DEFAULT_ROOTS}
+    for line in out.splitlines():
+        rel = line[3:].split(" -> ")[-1].strip().strip('"')
+        if not rel.endswith(".py"):
+            continue
+        if rel.split("/")[0] not in top and rel not in DEFAULT_ROOTS:
+            continue
+        p = root / rel
+        if p.exists():
+            files.append(str(p))
+    return files
+
+
+def classify(findings, baseline: set):
+    """-> (new findings, stale baseline keys)."""
+    current = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in baseline]
+    stale = sorted(baseline - current)
+    return new, stale
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--changed", action="store_true",
+                    help="scan only git-changed files (baseline still "
+                    "applies; cross-file rules see a partial world, so "
+                    "registry checkers are skipped)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings; "
+                    "refuses to grow it")
+    ap.add_argument("--baseline", default=str(BASELINE_PATH))
+    ap.add_argument("--root", default=str(REPO_ROOT),
+                    help="repo root to scan (tests point this at throwaway "
+                    "trees; default: this repo)")
+    ap.add_argument("paths", nargs="*", help="explicit files (overrides "
+                    "the default roots)")
+    args = ap.parse_args(argv)
+    root = Path(args.root).resolve()
+
+    baseline_path = Path(args.baseline)
+    baseline = load_baseline(baseline_path)
+
+    checkers = None
+    files = None
+    if args.paths:
+        files = args.paths
+    elif args.changed:
+        files = changed_files(root)
+        if not files:
+            print("check_static: no changed files")
+            return 0
+    if files is not None:
+        # a partial scan set cannot prove registry completeness (unread
+        # knobs / metric collisions live across files) — per-file rules only
+        checkers = ("async-blocking", "pooled-view", "trace-purity",
+                    "retry-4xx", "restart-defaults")
+
+    project, parse_errors = load_project(root, files=files)
+    findings = list(parse_errors) + run_checkers(project, checkers)
+    new, stale = classify(findings, baseline)
+    if args.changed:
+        stale = []  # partial scan cannot prove a baseline entry is gone
+
+    if args.update_baseline:
+        if files is not None:
+            # a partial scan can't see findings in unscanned files —
+            # rewriting from it would drop their baseline entries, and
+            # the shrink-only rule then forbids putting them back
+            print("--update-baseline requires a full scan (drop "
+                  "--changed / explicit paths)", file=sys.stderr)
+            return 2
+        grown = [f.key() for f in new]
+        if grown:
+            print("refusing to grow the baseline; fix or suppress "
+                  "(with a reason) these findings:", file=sys.stderr)
+            for f in new:
+                print("  " + f.render(), file=sys.stderr)
+            return 1
+        baseline_path.write_text(json.dumps(
+            {"findings": sorted(f.key() for f in findings)}, indent=2
+        ) + "\n")
+        print(f"baseline written: {len(findings)} entr"
+              f"{'y' if len(findings) == 1 else 'ies'}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) | {"key": f.key()} for f in findings],
+            "new": [f.key() for f in new],
+            "stale_baseline": stale,
+            "scanned_files": len(project.modules),
+        }, indent=2))
+    else:
+        for f in findings:
+            marker = "" if f.key() in baseline else " [NEW]"
+            print(f.render() + marker)
+        if stale:
+            print("\nbaseline entries that no longer fire (the baseline "
+                  "must only shrink — run --update-baseline):")
+            for k in stale:
+                print("  " + k)
+        print(f"\ncheck_static: {len(project.modules)} files, "
+              f"{len(findings)} finding(s), {len(new)} new, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        sys.exit(2)

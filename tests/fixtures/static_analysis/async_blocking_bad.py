@@ -1,0 +1,32 @@
+"""Known-bad fixture: every async-blocking pattern the checker covers.
+
+Parsed by tests/test_static_analysis.py, never imported or executed —
+this is what the reference actually shipped (blocking requests.post on
+the event loop, SURVEY.md section 5)."""
+
+import subprocess
+import time
+import urllib.request
+
+
+async def wedge_the_loop(sock, state_lock):
+    time.sleep(0.5)  # BAD: parks every session in the process
+    urllib.request.urlopen("http://orchestrator/health")  # BAD
+    pkt = sock.recvfrom(2048)  # BAD: raw socket on the loop
+    subprocess.run(["ffprobe", "x.h264"])  # BAD
+    state_lock.acquire()  # BAD: no timeout
+    with open("dump.bin") as f:
+        payload = f.read()  # BAD: unbounded read
+    return pkt, payload
+
+
+async def fine_patterns(sock, state_lock):
+    # the non-blocking spellings are NOT flagged
+    state_lock.acquire(timeout=0.1)
+    payload = b""
+
+    def worker():  # nested sync def: runs via to_thread, blocking is fine
+        time.sleep(0.5)
+        return urllib.request.urlopen("http://x").read()
+
+    return worker, payload

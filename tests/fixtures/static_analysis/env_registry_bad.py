@@ -1,0 +1,11 @@
+"""Known-bad fixture: env knobs that bypass the registry contract."""
+
+from ai_rtc_agent_tpu.utils import env
+
+KNOB = "PICKED_AT_RUNTIME"
+
+
+def read_config():
+    secret = env.get_str("TOTALLY_UNDOCUMENTED_KNOB")  # BAD: not in docs
+    dyn = env.get_int(KNOB, 0)  # BAD: dynamic name defeats the registry
+    return secret, dyn

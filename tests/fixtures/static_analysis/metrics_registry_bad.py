@@ -1,0 +1,11 @@
+"""Known-bad fixture: /metrics names that violate grammar or collide."""
+
+
+def record(stats, label):
+    stats.count("TX-Packets")  # BAD: not snake_case
+    stats.gauge("srtp_handshakes")  # BAD: kind conflict with the counter
+    stats.count("srtp_handshakes")
+    stats.gauge("rx_bursts_total")  # BAD: collides with counter's _total
+    stats.count("rx_bursts")
+    stats.count(label)  # BAD: dynamic name
+    stats.gauge("rr_jitter_ms")  # fine

@@ -1,0 +1,43 @@
+"""Known-bad fixture: pool-returned views escaping frame scope.
+
+``chaos_send`` reproduces the PR 2 chaos-TX bug byte-for-byte in shape:
+pooled packetizer views handed to the fault injector, which holds
+packets across calls when a reorder fault is active (the fix —
+media/rtp_client.py — stabilizes with bytes() first)."""
+
+
+class BadHolder:
+    def __init__(self, packetizer, ring, pool, loop, tx_faults):
+        self._pkt = packetizer
+        self._ring = ring
+        self._pool = pool
+        self._loop = loop
+        self._tx_faults = tx_faults
+        self._cache = []
+        self.last_frame = None
+
+    def chaos_send(self, au, ts):
+        pkts = self._pkt.packetize(au, ts)
+        for pkt in pkts:
+            self._tx_faults.apply(pkt)  # BAD: injector holds across calls
+
+    def store_frame(self):
+        frame, meta = self._ring.pop()
+        self.last_frame = frame  # BAD: outlives the pop pool rotation
+        return meta
+
+    def queue_packets(self, au, ts):
+        for pkt in self._pkt.packetize(au, ts):
+            self._cache.append(pkt)  # BAD: retransmit cache must copy
+        buf, arr, mv = self._pool.acquire(1500)
+        self._loop.call_later(0.02, self._flush, mv)  # BAD: deferred use
+
+    def _flush(self, pkt):
+        pass
+
+    def good_send(self, au, ts):
+        pkts = self._pkt.packetize(au, ts)
+        for pkt in pkts:
+            pkt = bytes(pkt)  # stabilized: taint cleared
+            self._tx_faults.apply(pkt)
+            self._cache.append(pkt)

@@ -1,0 +1,24 @@
+"""Known-bad fixture: ROADMAP open item 2, reproduced verbatim in shape.
+
+This is stream/pipeline.py restart() as it shipped before the fix: a
+fault-recovery re-prepare that passes the module-level defaults,
+silently reverting every runtime /config guidance/delta update the
+moment the engine heals."""
+
+DEFAULT_GUIDANCE_SCALE = 1.2
+DEFAULT_DELTA = 1.0
+
+
+class ShippedPipeline:
+    def __init__(self, engine, prompt, seed):
+        self.engine = engine
+        self.prompt = prompt
+        self._seed = seed
+
+    def restart(self):
+        self.engine.prepare(
+            prompt=self.prompt,
+            guidance_scale=DEFAULT_GUIDANCE_SCALE,  # BAD: reverts /config
+            delta=DEFAULT_DELTA,  # BAD: reverts /config
+            seed=self._seed,
+        )

@@ -1,0 +1,29 @@
+"""Known-bad fixture: ROADMAP open item 3, reproduced verbatim in shape.
+
+This is server/worker.py default_publish as it shipped before the fix:
+``urlopen`` raises HTTPError (a URLError subclass) BEFORE the status
+check, so ``retry_on=(URLError, OSError)`` re-POSTs a permanent 404
+until the attempt budget burns out."""
+
+import json
+import urllib.error
+import urllib.request
+
+from ai_rtc_agent_tpu.resilience.retry import transient_policy
+
+
+def shipped_default_publish(url: str, info: dict) -> bool:
+    req = urllib.request.Request(url, data=json.dumps(info).encode())
+
+    def post():
+        with urllib.request.urlopen(req, timeout=5) as r:
+            if not 200 <= r.status < 300:
+                raise OSError(f"publish returned {r.status}")
+        return True
+
+    return transient_policy(attempts=3).run(
+        post,
+        retry_on=(urllib.error.URLError, OSError),  # BAD: catches 4xx too
+        default=False,
+        label="worker publish",
+    )

@@ -1,0 +1,18 @@
+"""Fixture for suppression mechanics: one properly allowed finding, one
+reasonless allow, one unused allow."""
+
+import time
+
+
+async def allowed_with_reason():
+    # tpurtc: allow[async-blocking] -- fixture: demonstrates a reasoned allow
+    time.sleep(0.001)
+
+
+async def allowed_without_reason():
+    time.sleep(0.002)  # tpurtc: allow[async-blocking]
+
+
+def nothing_to_allow():
+    # tpurtc: allow[pooled-view] -- stale: nothing here is flagged anymore
+    return 1

@@ -1,0 +1,49 @@
+"""Known-bad fixture: host state read inside traced functions — the
+value is frozen at trace time and the knob silently stops working."""
+
+import os
+import time
+from functools import partial
+
+import jax
+import numpy as np
+
+from ai_rtc_agent_tpu.utils import env
+
+
+def step(x):
+    scale = env.get_float("GUIDANCE_HACK", 1.0)  # BAD: frozen at trace
+    t0 = time.perf_counter()  # BAD: host clock
+    noise = np.random.normal(size=(4,))  # BAD: host RNG
+    return x * scale, t0, noise
+
+
+jitted_step = jax.jit(step)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def decorated_step(x):
+    return x * float(os.environ["SCALE"])  # BAD: env subscript read
+
+
+def make_step(cfg):
+    def inner(x):
+        return x + _helper(x)
+
+    return inner
+
+
+def _helper(x):
+    time.sleep(0.001)  # BAD: reached transitively from the traced inner
+    return x
+
+
+compiled = jax.jit(make_step(None))
+
+
+def pure_step(x):
+    k = jax.random.PRNGKey(0)  # fine: jax RNG is trace-pure
+    return x + jax.random.normal(k, x.shape)
+
+
+pure = jax.jit(pure_step)

@@ -54,6 +54,47 @@ def test_update_prompt_and_t_index(pipe):
         pipe.update_t_index_list([1, 2, 3])
 
 
+def test_restart_preserves_runtime_guidance_and_delta(pipe):
+    """ROADMAP open item 2: restart() used to re-prepare with
+    DEFAULT_GUIDANCE_SCALE/DEFAULT_DELTA, silently reverting runtime
+    /config guidance updates the moment a fault recovery ran.  The live
+    values must survive — exactly like prompt and t_index_list do."""
+    from ai_rtc_agent_tpu.server.agent import apply_runtime_config
+    from ai_rtc_agent_tpu.stream.pipeline import (
+        DEFAULT_DELTA,
+        DEFAULT_GUIDANCE_SCALE,
+    )
+
+    try:
+        apply_runtime_config(pipe, {"guidance_scale": 3.5, "delta": 0.7})
+        assert pipe.guidance_scale == 3.5 and pipe.delta == 0.7
+        assert float(pipe.engine.state["guidance"]) == pytest.approx(3.5)
+        assert float(pipe.engine.state["delta"]) == pytest.approx(0.7)
+
+        # a rejected update must apply NOTHING: neither the prompt (400
+        # means rejected, not half-applied) nor the façade snapshot a
+        # later restart() would silently push into the engine
+        with pytest.raises((TypeError, ValueError)):
+            apply_runtime_config(
+                pipe, {"prompt": "must-not-apply", "delta": "abc"}
+            )
+        assert pipe.prompt != "must-not-apply"
+        assert pipe.guidance_scale == 3.5 and pipe.delta == 0.7
+
+        pipe.restart()  # the supervisor's fault-recovery hook
+
+        assert float(pipe.engine.state["guidance"]) == pytest.approx(3.5)
+        assert float(pipe.engine.state["delta"]) == pytest.approx(0.7)
+        # the engine still steps after the live-param re-prepare
+        out = pipe(np.zeros((64, 64, 3), np.uint8))
+        assert out.shape == (64, 64, 3)
+    finally:
+        # the fixture is module-scoped: later tests must see defaults
+        pipe.update_guidance(
+            guidance_scale=DEFAULT_GUIDANCE_SCALE, delta=DEFAULT_DELTA
+        )
+
+
 def test_fbs2_serving_through_track(monkeypatch):
     """frame_buffer_size=2 in the LIVE serving path: the track batches 2
     consecutive frames per device step and drains outputs one per recv()

@@ -228,6 +228,76 @@ def test_pipelined_surface_passthrough_on_stall():
     assert sup.state == HEALTHY
 
 
+def test_pipelined_probe_carries_grant_through_fetch():
+    """ROADMAP open item 1: the DEGRADED probe token is consumed at
+    submit time; with the old fetch-side re-check (`should_try_engine`
+    again, a frame later) the window was always closed by then, every
+    probe was discarded as passthrough, on_step_ok never fired, and a
+    pipelined session without a restart hook could NEVER leave DEGRADED.
+    The grant must ride with the in-flight frame."""
+    now = [0.0]
+
+    class ProbePipeline:
+        def __init__(self):
+            self.fail = False
+            self.fetches = 0
+
+        def submit(self, frame):
+            return ("h", frame)
+
+        def fetch(self, handle, src=None):
+            if self.fail:
+                raise RuntimeError("wedged")
+            self.fetches += 1
+            return 255 - handle[1]
+
+        # NO restart attr: DEGRADED recovers via throttled probes only
+
+    inner = ProbePipeline()
+    sup = SessionSupervisor(
+        "probe", probe_interval_s=2.0, error_burst=1, healthy_after=1,
+        clock=lambda: now[0],
+    )
+    rp = _rp(inner, sup, timeout=1.0)
+    inner.fail = True
+    h = rp.submit(FRAME)
+    assert rp.fetch(h, FRAME) is FRAME  # error burst of 1 -> DEGRADED
+    assert sup.state == DEGRADED
+    inner.fail = False
+    # probe window still closed: submit passthroughs, nothing consumed
+    assert rp.submit(FRAME)[0] == "passthrough"
+    now[0] = 2.5  # window open: this submit consumes the probe token
+    h = rp.submit(FRAME)
+    assert h[0] == "live"
+    out = rp.fetch(h, FRAME)  # the regression: fetch must HONOR the grant
+    assert out is not FRAME and out.max() == 255
+    assert inner.fetches == 1
+    assert sup.state in (RECOVERING, HEALTHY)
+    # next frame runs normally (RECOVERING is unthrottled) -> HEALTHY
+    h = rp.submit(FRAME)
+    assert rp.fetch(h, FRAME).max() == 255
+    assert sup.state == HEALTHY
+
+
+def test_failed_session_revokes_inflight_fetch():
+    """The probe grant survives DEGRADED but not FAILED — a handle
+    submitted before the session died must come back as passthrough."""
+    class P:
+        def submit(self, frame):
+            return ("h", frame)
+
+        def fetch(self, handle, src=None):
+            raise AssertionError("engine must not run after FAILED")
+
+    sup = _sup()
+    rp = _rp(P(), sup)
+    h = rp.submit(FRAME)
+    assert h[0] == "live"
+    with sup._lock:
+        sup._transition_locked(FAILED, "test")
+    assert rp.fetch(h, FRAME) is FRAME
+
+
 def test_resync_marshalled_to_loop_when_bound():
     import asyncio
 

@@ -87,6 +87,29 @@ def test_config_endpoint_updates_pipeline():
     assert pipe.t_index_list == [1, 2, 3, 4]
 
 
+def test_config_guidance_capability_checked_before_mutation():
+    """A /config body mixing prompt with guidance against a pipeline that
+    cannot do guidance (multipeer global plane) must apply NOTHING —
+    a 400 has to mean 'rejected', never 'half-applied'."""
+    import pytest
+
+    from ai_rtc_agent_tpu.server.agent import apply_runtime_config
+
+    pipe = FakePipeline()  # has no update_guidance
+    with pytest.raises(ValueError):
+        apply_runtime_config(pipe, {"prompt": "late", "guidance_scale": 2.0})
+    assert pipe.prompt is None and pipe.t_index_list is None
+
+    class Guided(FakePipeline):
+        def update_guidance(self, guidance_scale=None, delta=None):
+            self.guidance = guidance_scale
+            self.delta = delta
+
+    g = Guided()
+    apply_runtime_config(g, {"prompt": "p", "guidance_scale": 2.0, "delta": 0.5})
+    assert (g.prompt, g.guidance, g.delta) == ("p", 2.0, 0.5)
+
+
 def test_whep_without_source_is_401_and_delete_200():
     async def go():
         app, client = await _client(FakePipeline())

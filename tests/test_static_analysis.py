@@ -1,0 +1,251 @@
+"""In-repo invariant analyzer (ai_rtc_agent_tpu/analysis): every checker
+catches its known-bad fixture, the suppression/baseline mechanics hold,
+and — the tier-1 gate — the repo itself runs clean with an EMPTY
+baseline.
+
+Two fixtures reproduce bugs this repo actually shipped (ROADMAP Open
+Items): retry_4xx_bad.py is the pre-fix server/worker.py default_publish
+and restart_defaults_bad.py the pre-fix stream/pipeline.py restart() —
+proof the analyzer would have caught both before they landed.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ai_rtc_agent_tpu.analysis import load_project, run_checkers
+from ai_rtc_agent_tpu.analysis.core import DEFAULT_ROOTS, iter_py_files
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "static_analysis"
+DRIVER = REPO / "scripts" / "check_static.py"
+BASELINE = REPO / "scripts" / "static_analysis_baseline.json"
+
+
+def run_on(names, checkers):
+    files = [str(FIXTURES / n) for n in names]
+    project, errs = load_project(REPO, files=files)
+    assert not errs, errs
+    fs = run_checkers(project, checkers)
+    # cross-file registry checkers see a partial world here: keep only
+    # what the fixture itself raised
+    return [f for f in fs if "fixtures/static_analysis" in f.path]
+
+
+# -- the five checkers, each against its known-bad fixture -------------------
+
+def test_async_blocking_catches_every_pattern():
+    fs = run_on(["async_blocking_bad.py"], ("async-blocking",))
+    names = {f.name for f in fs}
+    assert "time.sleep" in names
+    assert "urlopen" in names
+    assert any("recvfrom" in n for n in names)
+    assert "subprocess.run" in names
+    assert any(n.endswith(".acquire") for n in names)
+    assert any(n.endswith(".read") for n in names)
+    # non-blocking spellings and nested worker defs stay clean
+    assert all(f.scope != "fine_patterns" for f in fs)
+
+
+def test_pooled_view_catches_escapes_and_respects_stabilize():
+    fs = run_on(["pooled_view_bad.py"], ("pooled-view",))
+    scopes = {f.scope for f in fs}
+    msgs = " | ".join(f.message for f in fs)
+    assert "BadHolder.chaos_send" in scopes  # the PR 2 chaos-TX shape
+    assert "fault injector" in msgs
+    assert "BadHolder.store_frame" in scopes  # ring pop -> self.*
+    assert "BadHolder.queue_packets" in scopes  # append + call_later
+    assert "call_later" in msgs
+    assert "BadHolder.good_send" not in scopes  # bytes() clears taint
+
+
+def test_trace_purity_catches_impure_and_allows_jax_random():
+    fs = run_on(["trace_purity_bad.py"], ("trace-purity",))
+    by_scope = {}
+    for f in fs:
+        by_scope.setdefault(f.scope, set()).add(f.name)
+    assert "env.get_float" in by_scope.get("step", set())
+    assert "time.perf_counter" in by_scope.get("step", set())
+    assert "np.random.normal" in by_scope.get("step", set())
+    assert "os.environ" in by_scope.get("decorated_step", set())
+    # transitive: inner -> _helper -> time.sleep, plus factory seeding
+    assert "time.sleep" in by_scope.get("_helper", set())
+    assert "pure_step" not in by_scope
+
+
+def test_env_registry_catches_undocumented_and_dynamic():
+    fs = run_on(["env_registry_bad.py"], ("env-registry",))
+    names = {f.name for f in fs}
+    assert "TOTALLY_UNDOCUMENTED_KNOB" in names
+    assert "<dynamic>" in names
+
+
+def test_metrics_registry_grammar_kind_and_collisions():
+    fs = run_on(["metrics_registry_bad.py"], ("metrics-registry",))
+    msgs = " | ".join(f.message for f in fs)
+    names = {f.name for f in fs}
+    assert "TX-Packets" in names  # grammar
+    assert "one name, one kind" in msgs  # kind conflict
+    assert "rx_bursts_total" in msgs and "collides" in msgs
+    assert "<dynamic-counter>" in names
+    assert "rr_jitter_ms" not in names  # well-formed name stays clean
+
+
+# -- shipped-bug reproductions (ROADMAP open items 2 and 3) ------------------
+
+def test_retry_4xx_reproduces_shipped_worker_bug():
+    fs = run_on(["retry_4xx_bad.py"], ("retry-4xx",))
+    assert len(fs) == 1
+    assert fs[0].name == "post"
+    assert "HTTPError" in fs[0].message
+
+
+def test_restart_defaults_reproduces_shipped_pipeline_bug():
+    fs = run_on(["restart_defaults_bad.py"], ("restart-defaults",))
+    names = {f.name for f in fs}
+    assert names == {"DEFAULT_GUIDANCE_SCALE", "DEFAULT_DELTA"}
+
+
+def test_fixed_sources_are_clean():
+    """The shipped-bug sites, post-fix, no longer fire their checkers."""
+    files = [
+        str(REPO / "ai_rtc_agent_tpu" / "server" / "worker.py"),
+        str(REPO / "ai_rtc_agent_tpu" / "stream" / "pipeline.py"),
+        str(REPO / "ai_rtc_agent_tpu" / "resilience" / "supervisor.py"),
+    ]
+    project, errs = load_project(REPO, files=files)
+    assert not errs
+    assert run_checkers(project, ("retry-4xx", "restart-defaults")) == []
+
+
+# -- suppression mechanics ---------------------------------------------------
+
+def test_suppression_with_reason_passes_without_reason_fails():
+    fs = run_on(["suppression_cases.py"], ("async-blocking", "pooled-view"))
+    # the reasoned allow suppressed its finding entirely
+    assert all(f.scope != "allowed_with_reason" for f in fs)
+    # the reasonless allow does NOT suppress, and is itself flagged
+    kinds = {(f.checker, f.scope) for f in fs}
+    assert ("async-blocking", "allowed_without_reason") in kinds
+    sup = [f for f in fs if f.checker == "suppression"]
+    assert any("without a reason" in f.message for f in sup)
+    assert any("unused suppression" in f.message for f in sup)
+
+
+def test_unused_suppression_not_reported_when_checker_skipped():
+    """--changed / explicit-file runs skip some checkers; an allow for a
+    skipped checker cannot be proven unused and must not be flagged."""
+    fs = run_on(["suppression_cases.py"], ("async-blocking",))
+    assert not any(
+        f.checker == "suppression" and "unused" in f.message and
+        "pooled-view" in f.name
+        for f in fs
+    )
+
+
+def test_docstring_mention_is_not_a_suppression():
+    """core.py quotes the allow syntax in a docstring — only real COMMENT
+    tokens count."""
+    files = [str(REPO / "ai_rtc_agent_tpu" / "analysis" / "core.py")]
+    project, _ = load_project(REPO, files=files)
+    fs = run_checkers(project, ("async-blocking",))
+    assert not [f for f in fs if f.checker == "suppression"]
+
+
+# -- baseline mechanics (driver-level) ---------------------------------------
+
+def _driver(args, **kw):
+    return subprocess.run(
+        [sys.executable, str(DRIVER), *args],
+        capture_output=True, text=True, cwd=str(REPO), **kw,
+    )
+
+
+def test_new_unsuppressed_finding_fails_the_gate(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text('{"findings": []}')
+    r = _driver(["--baseline", str(bl),
+                 str(FIXTURES / "retry_4xx_bad.py")])
+    assert r.returncode == 1
+    assert "[retry-4xx]" in r.stdout and "[NEW]" in r.stdout
+
+
+def test_baselined_finding_passes_and_growth_is_rejected(tmp_path):
+    bl = tmp_path / "baseline.json"
+    target = str(FIXTURES / "retry_4xx_bad.py")
+    # learn the real key via json output
+    r = _driver(["--baseline", str(bl), "--format=json", target])
+    keys = json.loads(r.stdout)["new"]
+    assert len(keys) == 1
+    bl.write_text(json.dumps({"findings": keys}))
+    assert _driver(["--baseline", str(bl), target]).returncode == 0
+    # a GROWN baseline (stale entry that never fires) is rejected
+    bl.write_text(json.dumps({"findings": keys + ["retry-4xx:ghost:f:g"]}))
+    r = _driver(["--baseline", str(bl), target])
+    assert r.returncode == 1
+    assert "must only shrink" in r.stdout
+
+
+def test_update_baseline_refuses_partial_scans(tmp_path):
+    """Rewriting from a partial scan would drop entries for unscanned
+    files — and shrink-only then forbids restoring them.  Refused."""
+    bl = tmp_path / "baseline.json"
+    bl.write_text('{"findings": ["retry-4xx:elsewhere:f:g"]}')
+    r = _driver(["--baseline", str(bl), "--update-baseline",
+                 str(FIXTURES / "retry_4xx_bad.py")])
+    assert r.returncode == 2
+    assert "full scan" in r.stderr
+    assert json.loads(bl.read_text())["findings"]  # untouched
+
+
+def test_update_baseline_shrinks_but_never_grows(tmp_path):
+    """Full scan: stale ghost entries shrink away (rc 0); a new finding
+    makes --update-baseline refuse before writing anything."""
+    bl = tmp_path / "baseline.json"
+    bl.write_text('{"findings": ["retry-4xx:ghost:f:g"]}')
+    r = _driver(["--baseline", str(bl), "--update-baseline"])
+    assert r.returncode == 0
+    assert json.loads(bl.read_text()) == {"findings": []}  # shrunk
+    # a throwaway mini-repo with a real finding (never the live tree —
+    # an interrupted run must not be able to poison the tier-1 gate):
+    # update must refuse to grow
+    mini = tmp_path / "mini"
+    (mini / "scripts").mkdir(parents=True)
+    (mini / "scripts" / "bad.py").write_text(
+        "import time\n\n\nasync def bad():\n    time.sleep(1)\n"
+    )
+    r = _driver(["--root", str(mini), "--baseline", str(bl),
+                 "--update-baseline"])
+    assert r.returncode == 1
+    assert "refusing to grow" in r.stderr
+    assert json.loads(bl.read_text()) == {"findings": []}  # untouched
+
+
+def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "nul.py"
+    bad.write_bytes(b"x = 1\n\x00\n")
+    worse = tmp_path / "syntax.py"
+    worse.write_text("def broken(:\n")
+    project, errs = load_project(REPO, files=[str(bad), str(worse)])
+    assert len(errs) == 2
+    assert all(e.checker == "parse-error" for e in errs)
+    assert run_checkers(project, ("async-blocking",)) == []
+
+
+# -- the tier-1 gate: the whole repo runs clean, empty baseline --------------
+
+def test_repo_runs_clean_with_empty_baseline():
+    assert json.loads(BASELINE.read_text()) == {"findings": []}
+    project, errs = load_project(REPO, roots=DEFAULT_ROOTS)
+    assert not errs, [e.render() for e in errs]
+    assert len(project.modules) > 80  # the scan actually covers the repo
+    findings = run_checkers(project)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_scan_set_excludes_fixtures():
+    files = {p.as_posix() for p in iter_py_files(REPO)}
+    assert not any("tests/" in f for f in files)

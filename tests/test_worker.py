@@ -42,6 +42,52 @@ def test_handler_fails_when_agent_down(monkeypatch):
     assert rc == 1
 
 
+def _serve_publish(status, hits):
+    """-> (server, os-assigned port) answering every POST with ``status``."""
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            hits.append(self.path)
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(status)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]
+
+
+def test_publish_404_is_terminal_single_attempt(monkeypatch):
+    """ROADMAP open item 3: urlopen raises HTTPError BEFORE the status
+    check and retry_on used to catch it as URLError, re-POSTing a
+    permanent 404 through the whole backoff budget.  4xx must fail after
+    EXACTLY one attempt."""
+    hits = []
+    srv, port = _serve_publish(404, hits)
+    monkeypatch.setenv("WORKER_PUBLISH_URL", f"http://127.0.0.1:{port}/pub")
+    try:
+        ok = worker.default_publish({"status": "ready"})
+    finally:
+        srv.shutdown()
+    assert ok is False
+    assert len(hits) == 1
+
+
+def test_publish_2xx_succeeds(monkeypatch):
+    hits = []
+    srv, port = _serve_publish(204, hits)
+    monkeypatch.setenv("WORKER_PUBLISH_URL", f"http://127.0.0.1:{port}/pub")
+    try:
+        ok = worker.default_publish({"status": "ready"})
+    finally:
+        srv.shutdown()
+    assert ok is True
+    assert len(hits) == 1
+
+
 def test_check_server_times_out():
     t0 = __import__("time").monotonic()
     assert not worker.check_server("http://127.0.0.1:18998/", budget_s=1.0)
