@@ -213,6 +213,7 @@ def apply_suppressions(project: Project, findings: list, ran=None) -> list:
 def run_checkers(project: Project, checkers=None) -> list:
     from . import (
         async_blocking,
+        bounded_queues,
         env_registry,
         metrics_registry,
         pooled_views,
@@ -222,6 +223,7 @@ def run_checkers(project: Project, checkers=None) -> list:
 
     registry = {
         "async-blocking": async_blocking.check,
+        "bounded-queue": bounded_queues.check,
         "pooled-view": pooled_views.check,
         "trace-purity": trace_purity.check,
         "env-registry": env_registry.check,
@@ -240,6 +242,7 @@ def run_checkers(project: Project, checkers=None) -> list:
 
 ALL_CHECKERS = (
     "async-blocking",
+    "bounded-queue",
     "pooled-view",
     "trace-purity",
     "env-registry",

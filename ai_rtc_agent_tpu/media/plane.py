@@ -189,6 +189,22 @@ class H264RingSource:
 
     # -- pipeline side (asyncio) --------------------------------------------
 
+    def _wrap(self, got) -> VideoFrame:
+        arr, pts = got
+        vf = VideoFrame.from_ndarray(arr)
+        vf.pts = int(pts)
+        vf.time_base = Fraction(1, CLOCK_RATE)
+        vf.wall_ts = self._meta.get(int(pts))
+        return vf
+
+    def recv_nowait(self) -> VideoFrame | None:
+        """Non-blocking pull for the overload ingest hop (server/tracks.py
+        freshest-frame-wins).  The ring is already latest-wins, so this
+        rarely fires — it exists so the track layer can treat every source
+        uniformly."""
+        got = self.poll()
+        return None if got is None else self._wrap(got)
+
     async def recv(self) -> VideoFrame:
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
@@ -196,12 +212,7 @@ class H264RingSource:
         while True:
             got = self.poll()  # ring-lock-protected pop (geometry swaps)
             if got is not None:
-                arr, pts = got
-                vf = VideoFrame.from_ndarray(arr)
-                vf.pts = int(pts)
-                vf.time_base = Fraction(1, CLOCK_RATE)
-                vf.wall_ts = self._meta.get(int(pts))
-                return vf
+                return self._wrap(got)
             if self._ended:
                 raise ConnectionError("source ended")
             # event-driven wait (timeout is only a liveness fallback for
@@ -284,6 +295,22 @@ class H264Sink:
             )
         self._pts = 0
         self._pts_step = CLOCK_RATE // max(1, fps)
+        # encode/TX-hop deadline (resilience/overload.py): a frame whose
+        # decode stamp has aged past this never reaches the encoder — under
+        # overload the oldest work is shed at the LAST hop too, instead of
+        # burning encode + wire on pixels the viewer will discard as stale.
+        # 0 disables; only stamped frames (wall_ts) are ever shed.  Follows
+        # the OVERLOAD_CONTROL kill-switch: with the plane off there is no
+        # shedding ladder to walk a slow session to passthrough, so an
+        # ungated deadline here could shed EVERY frame of a slow-but-
+        # flowing stream — the pre-overload behavior (late beats frozen)
+        # must come back whole.
+        self._deadline_s = (
+            env_util.get_float("OVERLOAD_TX_DEADLINE_MS", 2000.0) / 1e3
+            if env_util.get_bool("OVERLOAD_CONTROL", True)
+            else 0.0
+        )
+        self.shed_stale = 0  # frames dropped at this hop (monotonic)
 
     def consume(self, frame) -> list[bytes]:
         """frame: VideoFrame or [H,W,3] uint8 -> list of RTP packets
@@ -295,6 +322,14 @@ class H264Sink:
         else:
             arr, pts, wall = np.asarray(frame), self._pts, None
         self._pts = int(pts) + self._pts_step
+        if (
+            wall is not None
+            and self._deadline_s
+            and time.monotonic() - wall > self._deadline_s
+        ):
+            self.shed_stale += 1
+            self.stats.count("overload_shed_tx_stale")
+            return []
 
         t0 = time.monotonic()
         with self._enc_lock:

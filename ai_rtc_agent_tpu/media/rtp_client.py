@@ -18,6 +18,8 @@ import json
 import numpy as np
 
 from ..resilience import faults as _faults
+from ..resilience.overload import DeadlineQueue
+from ..utils import env
 from .frames import VideoFrame
 from .plane import H264RingSource, H264Sink
 from .sockio import CoalescedFlush
@@ -30,7 +32,14 @@ class NativeRtpClient:
                  use_h264: bool | None = None):
         self.width, self.height, self.fps = width, height, fps
         self._use_h264 = use_h264
-        self._recv_q: asyncio.Queue = asyncio.Queue()
+        # bounded downlink packet queue (resilience/overload.py): a slow
+        # drain sheds the OLDEST packets instead of building unbounded
+        # latency; sheds are counted on the queue (freshest-frame-wins at
+        # packet granularity — no deadline here, since dropping individual
+        # late fragments would corrupt the AUs their siblings complete)
+        self._recv_q = DeadlineQueue(
+            bound=env.get_int("OVERLOAD_RX_QUEUE_BOUND", 512)
+        )
         self._recv_tr = None
         self._send_tr = None
         self.sink: H264Sink | None = None
@@ -48,7 +57,7 @@ class NativeRtpClient:
 
         class _Recv(asyncio.DatagramProtocol):
             def datagram_received(self, data, addr):
-                q.put_nowait(data)
+                q.push(data)
 
         self._recv_tr, _ = await loop.create_datagram_endpoint(
             _Recv, local_addr=("0.0.0.0", 0)
@@ -122,10 +131,10 @@ class NativeRtpClient:
         (latest-wins ring: batch-feeding would evict).  -> frames received."""
         got = 0
         while True:
-            try:
-                data = self._recv_q.get_nowait()
-            except asyncio.QueueEmpty:
+            entry = self._recv_q.pop()
+            if entry is None:
                 break
+            data, _stamp = entry
             if self._rx_faults is not None:
                 # downlink impairment: delays collapse to reorder here (the
                 # drain is synchronous — schedule-late == deliver-late)

@@ -47,6 +47,15 @@ class RetryPolicy:
     multiplier: float = 2.0
     jitter: float = 0.1
     deadline_s: float | None = None
+    # Full jitter (AWS-style): each delay is drawn uniformly from
+    # [0, base * multiplier**n] instead of base * multiplier**n * (1 ± j).
+    # Fractional jitter keeps a fleet of workers phase-locked within ±j of
+    # the same schedule — after a shared control-plane blip they all
+    # re-POST inside one narrow window and the recovering service eats a
+    # synchronized retry storm.  Full jitter decorrelates them across the
+    # whole backoff interval while preserving the exponential envelope.
+    # Deterministic when a seeded ``rng`` is passed (like faults.py plans).
+    full_jitter: bool = False
 
     def __post_init__(self):
         if self.attempts is not None and self.attempts < 1:
@@ -56,12 +65,21 @@ class RetryPolicy:
 
     def delays(self, rng: random.Random | None = None):
         """Generator of successive sleep durations (unjittered core:
-        base * multiplier**n, capped at max_delay_s)."""
+        base * multiplier**n, capped at max_delay_s).  ``full_jitter``
+        draws each delay from U[0, core] and takes precedence over the
+        fractional ``jitter`` band."""
         rng = rng or random
         d = self.base_delay_s
         while True:
-            j = 1.0 + self.jitter * (2.0 * rng.random() - 1.0) if self.jitter else 1.0
-            yield max(0.0, d * j)
+            if self.full_jitter:
+                yield d * rng.random()
+            else:
+                j = (
+                    1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+                    if self.jitter
+                    else 1.0
+                )
+                yield max(0.0, d * j)
             d = min(self.max_delay_s, d * self.multiplier)
 
     # -- shared attempt bookkeeping (one copy for run AND arun) -------------
@@ -189,6 +207,11 @@ def poll_policy(budget_s: float, interval_s: float = 1.0) -> RetryPolicy:
     )
 
 
-# a handful of jittered-backoff tries for one-shot control-plane calls
+# a handful of backed-off tries for one-shot control-plane calls.  Full
+# jitter by default: these call sites (worker publish, Twilio tokens,
+# Civitai downloads, example signaling) are exactly the fan-in points
+# where a fleet retrying one shared service must not synchronize.
 def transient_policy(attempts: int = 3, base_delay_s: float = 0.5) -> RetryPolicy:
-    return RetryPolicy(attempts=attempts, base_delay_s=base_delay_s)
+    return RetryPolicy(
+        attempts=attempts, base_delay_s=base_delay_s, full_jitter=True
+    )

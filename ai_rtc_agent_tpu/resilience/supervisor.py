@@ -39,6 +39,7 @@ import time
 
 from ..utils import env
 from .faults import DeviceLostError
+from .overload import ShedFrame
 from .retry import RetryError, RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -117,6 +118,12 @@ class SessionSupervisor:
         self._healthy_steps = 0
         self._last_frame_out: float | None = None
         self._recovery_pending = False
+        # overload hold (resilience/overload.py): while set, successful
+        # steps must NOT walk the session out of DEGRADED — the shedding
+        # ladder's probes succeed by design, and without the hold every
+        # probe would flap DEGRADED<->RECOVERING, spraying webhooks and
+        # counters once per probe for as long as the box stays saturated
+        self._overload_hold = False
         self._watchdog_task = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self.passthrough_frames = 0
@@ -149,11 +156,29 @@ class SessionSupervisor:
             if self._state == DEGRADED:
                 if self._recovery_pending:
                     return False
+                if self._overload_hold:
+                    # overload-DEGRADED (not a fault): the shedding ladder's
+                    # admit_frame() token already throttles probes to one
+                    # per OVERLOAD_PROBE_S, and it is consumed BEFORE this
+                    # gate runs — throttling again here burned every probe
+                    # that landed inside this gate's own (longer) interval,
+                    # halving the cadence and starving the step EWMA those
+                    # probes exist to feed.  While the hold is set the
+                    # ladder owns the probe cadence.
+                    return True
                 now = self._clock()
                 if now < self._next_probe:
                     return False
                 self._next_probe = now + self.probe_interval_s
             return True
+
+    def engine_available(self) -> bool:
+        """Non-consuming peek at :meth:`should_try_engine`'s hard refusals
+        (FAILED, recovery holding the wedged step) — lets the overload
+        gate skip a frame WITHOUT burning a ladder probe token when the
+        engine gate would refuse it anyway."""
+        with self._lock:
+            return self._state != FAILED and not self._recovery_pending
 
     def may_finish_inflight(self) -> bool:
         """A frame whose submit was granted keeps that grant through its
@@ -201,6 +226,11 @@ class SessionSupervisor:
         fire = None
         with self._lock:
             self._errors_in_row = 0
+            if self._overload_hold:
+                # shedding under pressure: a successful probe is expected
+                # and proves nothing about capacity — stay DEGRADED until
+                # the ladder de-escalates (note_overload_clear)
+                return
             if self._state == RECOVERING:
                 self._healthy_steps += 1
                 if self._healthy_steps >= self.healthy_after:
@@ -222,6 +252,28 @@ class SessionSupervisor:
                 "session %s: engine step error (%d/%d before degrade): %r",
                 self.session_id, self._errors_in_row, self.error_burst, exc,
             )
+
+    def note_overload(self, reason: str):
+        """Overload-ladder passthrough (resilience/overload.py): degrade
+        WITHOUT spending the restart budget — the engine is healthy, the
+        box is over capacity, and restarting would only add load.  Sets a
+        hold so successful probe steps cannot flap the session back out of
+        DEGRADED while shedding continues; :meth:`note_overload_clear`
+        (ladder de-escalation) releases it, after which healthy steps walk
+        the session through RECOVERING to HEALTHY via :meth:`on_step_ok`."""
+        fire = None
+        with self._lock:
+            self._overload_hold = True
+            if self._state in (HEALTHY, RECOVERING):
+                self._next_probe = self._clock() + self.probe_interval_s
+                fire = self._transition_locked(DEGRADED, reason)
+        self._notify(fire)
+
+    def note_overload_clear(self):
+        """The shedding ladder dropped below its passthrough rung: release
+        the hold so real steps can recover the session normally."""
+        with self._lock:
+            self._overload_hold = False
 
     def on_stall(self, reason: str):
         """A step blew its budget or errors burst: degrade NOW, recover in
@@ -500,6 +552,10 @@ class ResilientPipeline:
         )
         self._warm_steps = warm_steps
         self._steps = 0
+        # optional overload-ladder gate (resilience/overload.py): consulted
+        # before every engine call; a refused frame is delivered passthrough
+        # (the stream thins under load instead of queueing stale work)
+        self.throttle = None
         self._runner = _StepRunner()
         # teardown rides the supervisor's stop() so the agent's session
         # cleanup releases the worker without holding a wrapper reference
@@ -540,6 +596,14 @@ class ResilientPipeline:
             out = box.result(timeout=timeout)
         except _StepTimeout:
             self._abandon_runner()
+            if self.throttle is not None and self._steps >= self._warm_steps:
+                # a wedged steady-state step never reports a duration —
+                # feed the admission EWMA its budget (doubled) so overload
+                # pressure registers wedges as severe, not absent.  A blown
+                # WARM-UP step is a fault (on_stall restarts it below), not
+                # a capacity signal — first_step_timeout_s is compile-sized
+                # and would pin pressure over budget on every cold start
+                self.throttle.note_step_timeout(timeout)
             self.supervisor.on_stall(f"engine step exceeded {timeout:.1f}s")
             return False, None
         except Exception as e:
@@ -567,28 +631,62 @@ class ResilientPipeline:
         self.supervisor.note_frame_out(n, processed=False)
         return frame
 
+    def _admit_frame(self) -> bool:
+        """Overload-ladder gate (before the supervisor's own gate): a
+        refused frame sheds engine WORK, not the frame — it is delivered
+        passthrough immediately instead of queueing behind slow steps."""
+        t = self.throttle
+        if t is None:
+            return True
+        if not self.supervisor.engine_available():
+            # the engine gate would refuse this frame anyway (FAILED /
+            # recovery holds the wedged step) — refuse it HERE so the
+            # ladder's once-per-interval probe token isn't consumed and
+            # then discarded, starving the step EWMA during recovery
+            return False
+        return t.admit_frame()
+
+    def _note_step(self, dt_s: float):
+        # warm-up steps carry the JAX compile (tens of seconds by design —
+        # first_step_timeout_s exists for them): feeding them to the
+        # admission EWMA would drive pressure over budget on EVERY cold
+        # session start, 503ing concurrent offers and walking live ladders
+        # up — only steady-state steps measure capacity
+        if self._steps <= self._warm_steps:
+            return
+        t = self.throttle
+        if t is not None:
+            t.note_step(dt_s)
+
     # -- synchronous surface ---------------------------------------------------
 
     def __call__(self, frame):
-        if not self._engine_enabled():
+        if not self._admit_frame() or not self._engine_enabled():
             return self._passthrough(frame)
         t0 = time.monotonic()
         ok, out = self._run_bounded(self._inner, frame)
         if not ok:
+            return self._passthrough(frame)
+        if isinstance(out, ShedFrame):
+            # a bounded queue shed this frame under pressure: source
+            # pixels, not an engine step — deliver passthrough and feed
+            # NOTHING (same rationale as _fetch)
             return self._passthrough(frame)
         if _non_finite(out):
             self.supervisor.on_step_error(
                 FloatingPointError("non-finite frame from engine")
             )
             return self._passthrough(frame)
-        self.supervisor.on_step_ok(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._note_step(dt)
+        self.supervisor.on_step_ok(dt)
         self.supervisor.note_frame_out(processed=True)
         return out
 
     # -- pipelined surface -----------------------------------------------------
 
     def _submit(self, frame):
-        if not self._engine_enabled():
+        if not self._admit_frame() or not self._engine_enabled():
             return ("passthrough", frame)
         ok, handle = self._run_bounded(self._inner.submit, frame)
         if not ok:
@@ -610,17 +708,25 @@ class ResilientPipeline:
         ok, out = self._run_bounded(self._inner.fetch, inner_handle, src_frame)
         if not ok:
             return self._passthrough(src)
+        if isinstance(out, ShedFrame):
+            # a bounded queue shed this frame under pressure: source
+            # pixels, not an engine step — deliver passthrough and feed
+            # NOTHING (a ~0ms "step" would dilute the admission EWMA at
+            # exactly the moment the shed is evidence of overload)
+            return self._passthrough(src)
         if _non_finite(out):
             self.supervisor.on_step_error(
                 FloatingPointError("non-finite frame from engine")
             )
             return self._passthrough(src)
-        self.supervisor.on_step_ok(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._note_step(dt)
+        self.supervisor.on_step_ok(dt)
         self.supervisor.note_frame_out(processed=True)
         return out
 
     def _submit_batch(self, frames):
-        if not self._engine_enabled():
+        if not self._admit_frame() or not self._engine_enabled():
             return ("passthrough", list(frames))
         ok, handle = self._run_bounded(self._inner.submit_batch, frames)
         if not ok:
@@ -648,6 +754,8 @@ class ResilientPipeline:
                 )
             self.supervisor.note_frame_out(len(srcs), processed=False)
             return list(srcs)
-        self.supervisor.on_step_ok(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._note_step(dt)
+        self.supervisor.on_step_ok(dt)
         self.supervisor.note_frame_out(len(outs), processed=True)
         return outs

@@ -35,6 +35,7 @@ from typing import List, Tuple
 
 from aiohttp import web
 
+from ..resilience.overload import OverloadControlPlane, QueueProbe, ShedFrame
 from ..resilience.supervisor import (
     ResilientPipeline,
     SessionSupervisor,
@@ -93,15 +94,34 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
         session_key, resync=resync, on_transition=on_transition
     )
     wrapped = ResilientPipeline(pipeline, sup)
+    ov = app.get("overload")
+    if ov is not None:
+        # overload ladder (resilience/overload.py): the wrapper consults it
+        # per frame; sustained box-wide pressure walks this session down
+        # the shedding ladder and back up on recovery
+        wrapped.throttle = ov.register_session(session_key, sup)
     app.setdefault("supervisors", {})[session_key] = sup
     sup.start_watchdog()
     return wrapped
+
+
+def _register_ingest_queue(app, session_key: str, track):
+    """Expose the session's source queue depth at /metrics when the track
+    has one (loopback tier; the native tier's ring is latest-wins by
+    construction).  Unregistered with the session."""
+    ov = app.get("overload")
+    src_q = getattr(track, "_q", None)
+    if ov is not None and src_q is not None:
+        ov.register_queue(f"ingest:{session_key}", QueueProbe(src_q))
 
 
 def _end_supervision(app, session_key: str):
     sup = app.get("supervisors", {}).pop(session_key, None)
     if sup is not None:
         sup.stop()
+    ov = app.get("overload")
+    if ov is not None:
+        ov.unregister_session(session_key)
 
 
 # ---------------------------------------------------------------------------
@@ -189,6 +209,50 @@ def _wire_datachannel(pipeline, channel, guard=None):
             logger.error("bad config message: %s", e)
 
 
+def _overloaded_response(
+    app, text: str = "overloaded", retry_after: float | None = None
+) -> web.Response:
+    """503 with a Retry-After hint scaled to live pressure — clients back
+    off instead of hammering a saturated box (DAGOR-style early refusal).
+    ``retry_after`` lets the admission gate pass through the exact value
+    it computed when refusing (the cap refusal deliberately returns the
+    unscaled base) instead of re-deriving one here."""
+    if retry_after is None:
+        ov = app.get("overload")
+        retry_after = ov.admission.retry_after_s() if ov is not None else 2.0
+    return web.Response(
+        status=503,
+        text=text,
+        headers={"Retry-After": str(max(1, int(round(retry_after))))},
+    )
+
+
+def _admission_gate(app, session_key: str | None = None) -> web.Response | None:
+    """Cost-aware admission for the session-creating endpoints: refuse a
+    new stream BEFORE claiming anything when live signals (engine
+    step-latency EWMA, event-loop lag, session cap, ladder freeze) say the
+    box cannot hold it.  ``session_key`` turns the admit into a counted
+    reservation (consumed when on_track registers the ladder, released by
+    :func:`_release_admission` / :func:`_end_supervision` on failure) so a
+    burst of concurrent offers cannot race past OVERLOAD_MAX_SESSIONS
+    before any of their tracks arrive.  None = admitted."""
+    ov = app.get("overload")
+    if ov is None:
+        return None
+    ok, retry_after = ov.admission_gate(key=session_key)
+    if ok:
+        return None
+    return _overloaded_response(app, retry_after=retry_after)
+
+
+def _release_admission(app, session_key: str):
+    """Cancel an admission reservation for an offer that failed before its
+    video track (and therefore its supervisor/ladder) ever existed."""
+    ov = app.get("overload")
+    if ov is not None:
+        ov.release_admission(session_key)
+
+
 async def _claim_pipeline(app):
     """-> (pipeline, release_fn).  In --multipeer mode each connection
     claims a slot of the batched engine (503 via CapacityError when full);
@@ -229,14 +293,18 @@ async def offer(request):
         offer_params = params["offer"]
     except (ValueError, LookupError) as e:  # LookupError covers KeyError +
         return web.Response(status=400, text=f"invalid offer request: {e}")  # unknown charset=
+    stream_id = str(uuid.uuid4())
+    rejected = _admission_gate(app, stream_id)
+    if rejected is not None:
+        return rejected
     pipeline, release_pipeline = await _claim_pipeline(app)
     if pipeline is None:
-        return web.Response(status=503, text="all peer slots in use")
+        _release_admission(app, stream_id)
+        return _overloaded_response(app, "all peer slots in use")
     # everything between the claim and the connection handlers taking over
     # must release the slot on failure — a leaked slot is permanent 503s
     pc = None
     try:
-        stream_id = str(uuid.uuid4())
         offer_sdp = provider.session_description(
             sdp=offer_params["sdp"], type=offer_params["type"]
         )
@@ -265,7 +333,10 @@ async def offer(request):
                 supervised = _supervise_session(
                     app, pc, _TimedPipeline(pipeline, stats), stream_id, room_id
                 )
-                video_track = VideoStreamTrack(track, supervised)
+                _register_ingest_queue(app, stream_id, track)
+                video_track = VideoStreamTrack(
+                    track, supervised, overload=app.get("overload")
+                )
                 tracks["video"] = video_track
                 sender = pc.addTrack(video_track)
                 provider.force_codec(pc, sender, "video/H264")
@@ -297,10 +368,15 @@ async def offer(request):
     except (KeyError, ValueError) as e:
         release_pipeline()
         await _discard_pc(pc, pcs)
+        # on_track may already have registered supervision (it fires during
+        # setRemoteDescription) — a failed offer must not leave a watchdog
+        # task and overload ladder behind
+        _end_supervision(app, stream_id)
         return web.Response(status=400, text=f"invalid offer request: {e}")
     except Exception:
         release_pipeline()
         await _discard_pc(pc, pcs)
+        _end_supervision(app, stream_id)
         raise
 
     return web.Response(
@@ -464,18 +540,26 @@ async def whip(request):
     pcs = app["pcs"]
     provider = app["provider"]
     stats: FrameStats = app["stats"]
+    session_id = str(uuid.uuid4())
+    rejected = _admission_gate(app, session_id)
+    if rejected is not None:
+        return rejected
     pipeline, release_pipeline = await _claim_pipeline(app)
     if pipeline is None:
-        return web.Response(status=503, text="all peer slots in use")
+        _release_admission(app, session_id)
+        return _overloaded_response(app, "all peer slots in use")
 
     pc = None
-    session_id = None
 
     def _cleanup_failed():
         release_pipeline()
-        if session_id is not None:
-            app["state"].get("whip_pcs", {}).pop(session_id, None)
-            _refresh_source_track(app)
+        app["state"].get("whip_pcs", {}).pop(session_id, None)
+        app["state"].get("whip_tracks", {}).pop(session_id, None)
+        _refresh_source_track(app)
+        # on_track may already have registered supervision (and the
+        # admission reservation rides unregister_session) — a failed
+        # publish must not leave a watchdog task or ladder behind
+        _end_supervision(app, session_id)
 
     try:
         offer_sdp = provider.session_description(
@@ -486,7 +570,6 @@ async def whip(request):
         # permission dance can't complete; rely on STUN + pinned UDP ports
         # instead (full rationale preserved from reference agent.py:299-314).
         pc = provider.peer_connection()
-        session_id = str(uuid.uuid4())
         pcs.add(pc)
         app["state"].setdefault("whip_pcs", {})[session_id] = pc
 
@@ -511,7 +594,10 @@ async def whip(request):
                 supervised = _supervise_session(
                     app, pc, _TimedPipeline(pipeline, stats), session_id
                 )
-                vt = VideoStreamTrack(track, supervised)
+                _register_ingest_queue(app, session_id, track)
+                vt = VideoStreamTrack(
+                    track, supervised, overload=app.get("overload")
+                )
                 app["state"].setdefault("whip_tracks", {})[session_id] = vt
                 app["state"]["source_track"] = vt  # latest publisher wins
                 # one relay per publisher SESSION: N WHEP viewers share the
@@ -592,15 +678,49 @@ async def health_detail(request):
     """Supervisor rollup: overall status is the worst live session state
     (HEALTHY when idle); per-session snapshots carry the state machine's
     recent transitions — the operator's first stop when a stream degrades
-    (docs/resilience.md maps each state to an action)."""
-    sups = request.app.get("supervisors", {})
+    (docs/resilience.md maps each state to an action).  O(sessions): each
+    snapshot reads counters and a bounded transition ring, never a frame
+    queue — the endpoint itself survives overload."""
+    app = request.app
+    sups = app.get("supervisors", {})
     sessions = {k: s.snapshot() for k, s in sups.items()}
-    return web.json_response(
-        {
-            "status": worst_state(s["state"] for s in sessions.values()),
-            "sessions": sessions,
+    ov = app.get("overload")
+    if ov is not None:
+        for k, ladder in ov.ladders.items():
+            if k in sessions:
+                sessions[k]["overload_rung"] = ladder.rung
+    body = {
+        "status": worst_state(s["state"] for s in sessions.values()),
+        "sessions": sessions,
+    }
+    if ov is not None:
+        body["overload"] = {
+            "pressure": round(ov.admission.pressure(), 4),
+            "frozen": ov.admission.frozen,
         }
-    )
+    return web.json_response(body)
+
+
+async def capacity(request):
+    """Remaining session capacity for orchestrators (the worker sidecar
+    publishes this instead of a boolean "ready").  ``capacity``: sessions
+    this box will still admit (-1 = no structural bound); ``saturated``:
+    admission is currently refusing; ``retry_after_s``: backpressure hint."""
+    app = request.app
+    mp = app.get("multipeer_pipeline")
+    free = mp.free_slots if mp is not None else None
+    ov = app.get("overload")
+    if ov is None:
+        return web.json_response(
+            {
+                "capacity": free if free is not None else -1,
+                "saturated": free == 0,
+                "retry_after_s": 0.0,
+            }
+        )
+    # plane-level view: counts live ladders PLUS in-flight admission
+    # reservations, so a burst of half-set-up offers is not double-sold
+    return web.json_response(ov.capacity(free_slots=free))
 
 
 async def demo(_):
@@ -621,6 +741,15 @@ async def metrics(request):
     snapshot = getattr(provider, "host_plane_snapshot", None)
     if snapshot is not None:
         out["host_plane_sessions"] = snapshot()
+    # overload control plane (resilience/overload.py): pressure, lag,
+    # freshness percentiles, per-queue depth/shed — O(sessions) int reads,
+    # so this endpoint stays cheap exactly when the box is drowning
+    ov = request.app.get("overload")
+    if ov is not None:
+        mp = request.app.get("multipeer_pipeline")
+        if mp is not None:
+            out["overload_peer_frames_shed"] = mp.frames_shed
+        out.update(ov.snapshot())
     return web.json_response(out)
 
 
@@ -654,8 +783,11 @@ class _TimedPipeline:
         return int(getattr(self._pipeline, "frame_buffer_size", 1) or 1)
 
     def __call__(self, frame):
-        with self._stats.timed():
-            return self._pipeline(frame)
+        t0 = time.monotonic()
+        out = self._pipeline(frame)
+        if not isinstance(out, ShedFrame):
+            self._stats.record(time.monotonic() - t0)
+        return out
 
     def _submit(self, frame):
         return self._pipeline.submit(frame), time.monotonic()
@@ -663,7 +795,11 @@ class _TimedPipeline:
     def _fetch(self, handle, src_frame=None):
         inner, t_sub = handle
         out = self._pipeline.fetch(inner, src_frame)
-        self._stats.record(time.monotonic() - t_sub)
+        # a bounded-queue shed is submit-to-EVICTION time, not a latency
+        # sample — recording it would collapse latency_p50 and inflate
+        # fps exactly under overload, when the dashboard matters most
+        if not isinstance(out, ShedFrame):
+            self._stats.record(time.monotonic() - t_sub)
         return out
 
     def _submit_batch(self, frames):
@@ -787,9 +923,20 @@ async def on_startup(app):
     # decode/encode/glass-to-glass stages next to submit->fetch latency
     if hasattr(app["provider"], "attach_stats"):
         app["provider"].attach_stats(app["stats"])
+    # overload control plane: admission, lag watchdog, shedding ladders
+    # (OVERLOAD_CONTROL=0 restores the pre-overload-plane agent)
+    if env.get_bool("OVERLOAD_CONTROL", True):
+        ov = OverloadControlPlane(app["stats"])
+        app["overload"] = ov
+        await ov.start()
+    else:
+        app["overload"] = None
 
 
 async def on_shutdown(app):
+    ov = app.get("overload")
+    if ov is not None:
+        ov.stop()
     for sup in app.get("supervisors", {}).values():
         sup.stop()
     app.get("supervisors", {}).clear()
@@ -847,6 +994,7 @@ def build_app(
     app.router.add_post("/config", update_config)
     app.router.add_get("/", health)
     app.router.add_get("/health", health_detail)
+    app.router.add_get("/capacity", capacity)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/demo", demo)
     return app

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..models import registry
 from ..parallel.multipeer import CapacityError, MultiPeerEngine
+from ..resilience.overload import ShedFrame
 from ..stream.pipeline import DEFAULT_PROMPT, coerce_frame, maybe_load_safety_checker
 from ..utils import env
 
@@ -57,6 +58,11 @@ class PeerPipeline:
 
     def fetch(self, handle: Future, src_frame=None):
         out = handle.result(timeout=self._owner.fetch_timeout)
+        if isinstance(out, ShedFrame):
+            # shed by the bounded slot queue: source pixels, not engine
+            # output — skip the safety checker / processed-wrap and keep
+            # the marker so the caller can account it as passthrough
+            return out
         if self._owner.safety_checker is not None:
             out = self._owner.safety_checker(out)
         # same output-type contract as the single-peer pipeline fetch
@@ -70,6 +76,9 @@ class PeerPipeline:
         return out
 
     def __call__(self, frame):
+        # a shed resolves as a ShedFrame marker here too — the timing /
+        # resilience wrappers above skip their accounting on it, and the
+        # delivery layer unwraps to pixels
         return self.fetch(self.submit(frame), frame)
 
     # -- per-peer control plane --------------------------------------------
@@ -139,7 +148,17 @@ class MultiPeerPipeline:
 
         self._lock = threading.Lock()  # guards engine state + queues
         self._has_work = threading.Condition(self._lock)
-        self._queues = [deque() for _ in range(max_peers)]  # (frame, Future)
+        # bounded per-slot frame queues (resilience/overload.py policy): a
+        # peer outpacing the batched step sheds its OLDEST queued frame —
+        # resolved as passthrough (the frame itself) so its recv() never
+        # hangs — instead of building unbounded latency behind the batch
+        self.queue_bound = max(
+            1, env.get_int("OVERLOAD_PEER_QUEUE_BOUND", 2)
+        )
+        self.frames_shed = 0  # monotonic, read lock-free by /metrics
+        self._queues = [
+            deque(maxlen=self.queue_bound) for _ in range(max_peers)
+        ]  # (frame, Future)
         self._last_frame = [
             np.zeros((cfg.height, cfg.width, 3), np.uint8) for _ in range(max_peers)
         ]
@@ -209,7 +228,18 @@ class MultiPeerPipeline:
     def _enqueue(self, slot: int, frame: np.ndarray) -> Future:
         fut: Future = Future()
         with self._has_work:
-            self._queues[slot].append((frame, fut))
+            q = self._queues[slot]
+            if len(q) >= self.queue_bound:
+                # freshest-frame-wins: deliver the shed frame as
+                # passthrough NOW (its waiter unblocks with the source
+                # pixels) and keep the newcomer.  ShedFrame-marked so the
+                # resilience wrapper accounts it as passthrough instead of
+                # feeding a ~0ms "step" into the admission EWMA
+                old_frame, old_fut = q.popleft()
+                if not old_fut.cancelled():
+                    old_fut.set_result(ShedFrame(old_frame))
+                self.frames_shed += 1
+            q.append((frame, fut))
             self._has_work.notify()
         return fut
 
@@ -224,7 +254,9 @@ class MultiPeerPipeline:
     PIPELINE_DEPTH = 2
 
     def _run(self):
-        inflight: deque = deque()  # (pending_handle, futs)
+        # bound == PIPELINE_DEPTH: the pop below fires whenever the depth
+        # is reached, so the deque can never exceed it
+        inflight: deque = deque(maxlen=self.PIPELINE_DEPTH)  # (handle, futs)
         while True:
             with self._has_work:
                 while not self._stop and not any(self._queues) and not inflight:
@@ -249,6 +281,20 @@ class MultiPeerPipeline:
                             self._last_frame[s] = np.array(frame, copy=True)
                             futs[s] = fut
                     batch = np.stack(self._last_frame)
+                    if len(inflight) >= self.PIPELINE_DEPTH:
+                        # unreachable while the pop below fires at depth;
+                        # if that drain condition ever regresses, fail the
+                        # oldest step's waiters LOUDLY — silent maxlen
+                        # eviction would strand their recv() forever
+                        _stale, stale_futs = inflight.popleft()
+                        logger.error(
+                            "multipeer inflight overflow: drain invariant broken"
+                        )
+                        for fut in stale_futs:
+                            if fut is not None and not fut.cancelled():
+                                fut.set_exception(
+                                    RuntimeError("multipeer inflight overflow")
+                                )
                     try:
                         inflight.append((self.engine.submit(batch), futs))
                     except Exception as e:

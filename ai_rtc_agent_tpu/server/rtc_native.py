@@ -421,10 +421,20 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
             logger.exception("RTP depacketize error")
             return
         for got in aus:
+            if self._q.full():
+                # freshest-frame-wins (resilience/overload.py policy): shed
+                # the OLDEST queued AU — the decode backlog IS the latency,
+                # and the stalest frame is the least valuable one in it
+                try:
+                    self._q.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
+                if self._plane_stats is not None:
+                    self._plane_stats.count("overload_shed_rx_queue")
             try:
                 self._q.put_nowait(got)
             except asyncio.QueueFull:
-                pass  # real-time: drop rather than queue latency
+                pass  # raced a concurrent producer: drop rather than block
 
     async def _decode_loop(self):
         while True:

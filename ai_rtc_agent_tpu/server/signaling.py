@@ -72,6 +72,15 @@ class LoopbackTrack:
             raise ConnectionError("track ended")
         return await self._q.get()
 
+    def recv_nowait(self):
+        """Non-blocking pull, or None — lets the overload ingest hop
+        (server/tracks.py) skip ahead to a fresher frame when this queue
+        has backed up behind a slow pipeline."""
+        try:
+            return self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
     def stop(self):
         self._ended.set()
         from ..utils.dispatch import fire_handler

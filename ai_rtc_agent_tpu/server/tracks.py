@@ -17,6 +17,15 @@ reference lib/tracks.py:21-25) and the DROP_FRAMES OBS-stutter workaround
   the frame submitted `depth` calls ago — dispatch, device compute and
   readback overlap across consecutive frames, which is where the TPU's
   throughput headroom lives.  depth=1 restores synchronous behavior.
+
+Overload control (resilience/overload.py): the track is the INGEST hop of
+the frame path.  When an ``overload`` control plane is attached, every
+pulled frame is checked against its decode-stamp deadline
+(``OVERLOAD_FRAME_DEADLINE_MS``): a stale frame with a fresher one already
+queued behind it is shed (freshest-frame-wins, counted), and the
+delivered-frame freshness lands in the /metrics reservoir.  Sources that
+can skip ahead expose a non-blocking ``recv_nowait()`` (the loopback track
+and the native ring source do); sources without one simply never shed here.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ import asyncio
 import logging
 from collections import deque
 
+from ..resilience.overload import ShedFrame
 from ..utils import env
 
 logger = logging.getLogger(__name__)
@@ -33,9 +43,11 @@ logger = logging.getLogger(__name__)
 class VideoStreamTrack:
     kind = "video"
 
-    def __init__(self, track, pipeline, pipeline_depth: int | None = None):
+    def __init__(self, track, pipeline, pipeline_depth: int | None = None,
+                 overload=None):
         self.track = track
         self.pipeline = pipeline
+        self.overload = overload  # OverloadControlPlane | None
         self.warmup_frame_idx = 0
         self.warmup_frames = env.warmup_frames()
         self.drop_frames = env.drop_frames()
@@ -44,7 +56,9 @@ class VideoStreamTrack:
         )
         if not hasattr(pipeline, "submit"):
             self.pipeline_depth = 1
-        self._pending: deque = deque()
+        # in-flight bound: the submit loops below never hold more than
+        # `pipeline_depth` entries (single-frame path) / batches (fbs path)
+        self._pending: deque = deque(maxlen=self.pipeline_depth)
         self._handlers: dict = {}
 
     # minimal MediaStreamTrack event surface (works standalone and under
@@ -65,6 +79,43 @@ class VideoStreamTrack:
     def _fbs(self) -> int:
         return int(getattr(self.pipeline, "frame_buffer_size", 1) or 1)
 
+    # -- overload hooks -------------------------------------------------------
+
+    async def _pull_fresh(self):
+        """One source frame, freshest-wins: while the frame at hand has
+        aged past HALF the deadline AND the source has a backlog to skip
+        into, shed it and take the next.  Stopping at the first barely-
+        in-deadline frame would make delivered ages cluster just under the
+        deadline (each engine step pushes the next pick right back to the
+        edge) — the half-deadline target keeps freshness p99 comfortably
+        inside it.  A stale frame with nothing behind it is still
+        delivered — a late frame beats a frozen stream."""
+        frame = await self.track.recv()
+        ov = self.overload
+        if ov is None:
+            return frame
+        recv_nowait = getattr(self.track, "recv_nowait", None)
+        if ov.frame_deadline_s and recv_nowait is not None:
+            shed = 0
+            while ov.frame_age(frame) > ov.frame_deadline_s / 2.0:
+                nxt = recv_nowait()
+                if nxt is None:
+                    break
+                frame = nxt
+                shed += 1
+            if shed:
+                ov.note_shed_ingest(shed)
+        # freshness is measured HERE, at the pick: the queue-wait age of the
+        # frame admitted into the pipeline is exactly the component the
+        # overload plane controls (device time shows up in latency_p*_ms
+        # and the glass gauge instead).  Unstamped frames (plain aiortc
+        # remote tracks) carry no decode stamp — recording them would fill
+        # the reservoir with fake perfect 0.0 samples, so they are skipped
+        # and the freshness gauges reflect only frames that can be measured
+        if getattr(frame, "wall_ts", None) is not None:
+            ov.note_delivered(ov.frame_age(frame))
+        return frame
+
     async def recv(self):
         fbs = self._fbs
         if fbs > 1 and hasattr(self.pipeline, "submit_batch"):
@@ -82,26 +133,37 @@ class VideoStreamTrack:
             await self.track.recv()
 
         if self.pipeline_depth == 1:
-            frame = await self.track.recv()
-            return await asyncio.to_thread(self.pipeline, frame)
+            frame = await self._pull_fresh()
+            out = await asyncio.to_thread(self.pipeline, frame)
+            if isinstance(out, ShedFrame):
+                # unsupervised tier (SUPERVISOR=0): no resilience wrapper
+                # to unwrap the bounded-queue shed marker — deliver pixels
+                return out.frame
+            return out
 
         # pipelined path: keep `depth` frames in flight, return the oldest
         while len(self._pending) < self.pipeline_depth:
-            frame = await self.track.recv()
+            frame = await self._pull_fresh()
             handle = await asyncio.to_thread(self.pipeline.submit, frame)
             self._pending.append((frame, handle))
         src, handle = self._pending.popleft()
-        return await asyncio.to_thread(self.pipeline.fetch, handle, src)
+        out = await asyncio.to_thread(self.pipeline.fetch, handle, src)
+        if isinstance(out, ShedFrame):
+            # unsupervised tier (SUPERVISOR=0): no resilience wrapper to
+            # unwrap the bounded-queue shed marker — deliver the pixels
+            return out.frame
+        return out
 
     async def _recv_batched(self, fbs: int):
         """frame_buffer_size>1 serving: fbs consecutive frames ride ONE
         device step (the reference's fbs amortization, lib/wrapper.py:159-163,
         brought to the live track); outputs drain one per recv()."""
         if not hasattr(self, "_outbuf"):
+            # tpurtc: allow[bounded-queue] -- drained to empty before each refill; holds at most one fetch_batch's fbs outputs (fbs is not known at ctor time)
             self._outbuf = deque()
 
         async def pull_batch():
-            return [await self.track.recv() for _ in range(fbs)]
+            return [await self._pull_fresh() for _ in range(fbs)]
 
         while self.warmup_frame_idx < self.warmup_frames:
             logger.info("dropping warmup frame batch @%d", self.warmup_frame_idx)

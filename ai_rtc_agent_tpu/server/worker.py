@@ -20,6 +20,7 @@ AGENT_TIMEOUT (keep-alive seconds, default 600 like the reference).
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import logging
 import os
@@ -124,8 +125,28 @@ def default_publish(info: dict) -> bool:
     return ok
 
 
+def fetch_capacity(url: str) -> dict | None:
+    """GET the agent's /capacity snapshot (remaining sessions + saturation
+    — resilience/overload.py) so the orchestrator can weight placement by
+    real headroom instead of a boolean "ready".  Best-effort: an agent
+    without the endpoint (or a non-JSON answer) just means no capacity
+    fields in the publish."""
+    try:
+        with urllib.request.urlopen(url, timeout=2) as r:
+            body = json.loads(r.read().decode())
+        return body if isinstance(body, dict) else None
+    except (
+        urllib.error.URLError,
+        http.client.HTTPException,  # truncated/garbled response from a
+        OSError,                    # box that is drowning — exactly when
+        ValueError,                 # this endpoint gets queried
+    ):
+        return None
+
+
 def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
-    """One worker job: await agent, publish identity, hold the lease.
+    """One worker job: await agent, publish identity + capacity, hold the
+    lease.
 
     Returns 0 on success, 1 if the agent never became healthy, 2 if the
     connection info could not be published (a worker nobody can reach is
@@ -133,14 +154,18 @@ def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
     burning the whole lease invisible)."""
     if not check_server(f"http://127.0.0.1:{agent_port}/", HEALTH_BUDGET_S):
         return 1
-    ok = publish(
-        {
-            "worker_id": env.get_str("WORKER_ID", os.uname().nodename),
-            "public_ip": env.get_str("PUBLIC_IP", ""),
-            "public_port": env.get_str("PUBLIC_PORT", str(agent_port)),
-            "status": "ready",
-        }
-    )
+    info = {
+        "worker_id": env.get_str("WORKER_ID", os.uname().nodename),
+        "public_ip": env.get_str("PUBLIC_IP", ""),
+        "public_port": env.get_str("PUBLIC_PORT", str(agent_port)),
+        "status": "ready",
+    }
+    cap = fetch_capacity(f"http://127.0.0.1:{agent_port}/capacity")
+    if cap is not None and "capacity" in cap:
+        # remaining capacity, not a boolean: -1 = no structural bound
+        info["capacity"] = cap.get("capacity")
+        info["saturated"] = bool(cap.get("saturated", False))
+    ok = publish(info)
     if ok is False:  # None (no return value) counts as success
         return 2
     keep_alive = env.get_int("AGENT_TIMEOUT", 600)

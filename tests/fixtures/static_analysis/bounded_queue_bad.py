@@ -1,0 +1,37 @@
+"""Known-bad fixture: unbounded queues on the frame path.
+
+Every shape the bounded-queue checker must catch (bare ctor, explicit
+unbounded spellings, from-import aliases) plus the good spellings that
+must stay clean (finite literals, computed bounds, stdlib queue.Queue)."""
+
+import asyncio
+import collections
+import collections as colls
+import queue
+from asyncio import Queue
+from asyncio import Queue as RenamedQ
+from collections import deque
+from collections import deque as renamed_dq
+
+
+class BadBuffers:
+    def __init__(self, bound):
+        self.q1 = asyncio.Queue()  # BAD: no maxsize
+        self.q2 = asyncio.Queue(maxsize=0)  # BAD: 0 = unbounded spelling
+        self.q3 = Queue()  # BAD: from-import alias, no maxsize
+        self.q4 = RenamedQ()  # BAD: renamed from-import, no maxsize
+        self.d1 = collections.deque()  # BAD: no maxlen
+        self.d2 = deque(maxlen=None)  # BAD: None = unbounded spelling
+        self.d3 = deque([1, 2, 3])  # BAD: iterable but no maxlen
+        self.d4 = renamed_dq()  # BAD: renamed from-import, no maxlen
+        self.d5 = colls.deque()  # BAD: module alias, no maxlen
+
+        # good spellings — must stay clean
+        self.ok1 = asyncio.Queue(maxsize=16)
+        self.ok2 = asyncio.Queue(8)
+        self.ok3 = deque(maxlen=4)
+        self.ok4 = collections.deque([1], 4)
+        self.ok5 = deque(maxlen=bound)  # computed bound is still a bound
+        self.ok6 = queue.Queue()  # thread control queue: out of scope
+        self.ok7 = RenamedQ(maxsize=16)  # renamed but bounded
+        self.ok8 = colls.deque([1], 4)  # module alias but bounded

@@ -1,0 +1,282 @@
+"""Overload chaos (hermetic, tier-1): a sustained injected slow-step fault
+drives a live loopback session into overload; the control plane must
+
+* keep every frame queue at/below its bound (the source queue never grows
+  past its maxsize while the producer runs ahead of the engine),
+* shed stale frames at ingest with every shed counted — pushed frames ==
+  delivered + shed + still-queued, exactly,
+* keep admitted-frame freshness p99 under the configured deadline,
+* refuse new sessions (503 + Retry-After) while saturated,
+* walk the session down the shedding ladder (supervisor DEGRADED with an
+  overload reason, no restart budget spent) and, once the fault clears,
+  back up: ladder fully unwound, admission open, session HEALTHY.
+
+Fast and deterministic-by-construction: the fault plan is seeded, the
+engine slowdown is a worker-thread sleep well under the step timeout (slow
+≠ wedged: no restarts, no FAILED), and every wait is bounded.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_rtc_agent_tpu.media.frames import VideoFrame
+from ai_rtc_agent_tpu.resilience import faults
+from ai_rtc_agent_tpu.resilience.faults import FaultPlan, FaultSpec
+from ai_rtc_agent_tpu.resilience.overload import RUNG_PASSTHROUGH
+from ai_rtc_agent_tpu.server.agent import build_app
+from ai_rtc_agent_tpu.server.signaling import (
+    LoopbackProvider,
+    make_loopback_offer,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+class SlowableChaosPipeline:
+    """Invert-colors pipeline whose steps block on the injected slow_step
+    fault — SLOW, not wedged (the delay stays under the step timeout), so
+    overload pressure builds without consuming the restart budget."""
+
+    def __init__(self):
+        self._fault_scope = faults.scope("engine")
+        self.calls = 0
+        self.restarts = 0
+
+    def clear_faults(self):
+        self._fault_scope = None
+
+    def __call__(self, frame):
+        self.calls += 1
+        if self._fault_scope is not None:
+            self._fault_scope.step()
+        arr = frame if isinstance(frame, np.ndarray) else frame.to_ndarray()
+        return 255 - arr
+
+    def restart(self):
+        self.restarts += 1
+
+
+def _offer_body(room="overload"):
+    return {
+        "room_id": room,
+        "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+    }
+
+
+def test_overload_chaos_sheds_bounded_refuses_then_recovers(monkeypatch):
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    # slow (0.25s) steps stay far under the 5s step timeout: no stall
+    # verdicts, no restarts — pure capacity pressure
+    monkeypatch.setenv("RESILIENCE_STEP_TIMEOUT_S", "5")
+    monkeypatch.setenv("RESILIENCE_FIRST_STEP_TIMEOUT_S", "5")
+    monkeypatch.setenv("SUPERVISOR_STALL_AFTER_S", "30")
+    monkeypatch.setenv("OVERLOAD_STEP_BUDGET_MS", "60")
+    monkeypatch.setenv("OVERLOAD_FRAME_DEADLINE_MS", "300")
+    monkeypatch.setenv("OVERLOAD_TICK_S", "0.05")
+    monkeypatch.setenv("OVERLOAD_UP_TICKS", "2")
+    monkeypatch.setenv("OVERLOAD_DOWN_TICKS", "2")
+    monkeypatch.setenv("OVERLOAD_PROBE_S", "0.1")
+    monkeypatch.setenv("OVERLOAD_RETRY_AFTER_S", "1")
+
+    faults.activate(
+        FaultPlan(
+            specs=(
+                FaultSpec(target="engine", kind="slow_step", delay_s=0.25),
+            ),
+            seed=11,
+        )
+    )
+    pipe = SlowableChaosPipeline()
+
+    async def go():
+        app = build_app(pipeline=pipe, provider=LoopbackProvider())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/offer", json=_offer_body())
+            assert r.status == 200
+            pc = next(iter(app["pcs"]))
+            viewer = pc.out_tracks[0]
+            src_q = pc.in_track._q  # the bounded loopback source queue
+            (sup,) = app["supervisors"].values()
+            ov = app["overload"]
+            ladder = ov.ladders[sup.session_id]
+
+            pushed = 0
+            delivered = []
+            max_qsize = 0
+            producer_alive = True
+
+            async def producer():
+                # a camera that does not slow down for the server: one
+                # stamped frame every 10 ms, for as long as the test runs
+                nonlocal pushed, max_qsize
+                i = 0
+                while producer_alive:
+                    f = VideoFrame.from_ndarray(
+                        np.full((8, 8, 3), i % 200, np.uint8)
+                    )
+                    f.wall_ts = time.monotonic()  # decode stamp
+                    await pc.in_track.push(f)  # blocks at the queue bound
+                    pushed += 1
+                    max_qsize = max(max_qsize, src_q.qsize())
+                    i += 1
+                    await asyncio.sleep(0.01)
+
+            prod_task = asyncio.ensure_future(producer())
+
+            async def consume_until(pred, deadline_s):
+                deadline = time.monotonic() + deadline_s
+                while time.monotonic() < deadline and not pred():
+                    out = await asyncio.wait_for(viewer.recv(), timeout=5.0)
+                    delivered.append(out)
+                return pred()
+
+            # --- phase 1: saturation.  The ladder must reach passthrough,
+            # the supervisor must be DEGRADED with an overload reason, and
+            # admission must refuse new sessions with Retry-After.
+            assert await consume_until(
+                lambda: ladder.rung >= RUNG_PASSTHROUGH
+                and sup.state == "DEGRADED",
+                deadline_s=20.0,
+            ), f"never saturated (rung={ladder.rung}, state={sup.state})"
+            assert "overload" in sup.snapshot()["reason"]
+            assert sup.snapshot()["restarts"] == 0  # capacity, not a fault
+
+            r = await client.post("/offer", json=_offer_body("late"))
+            assert r.status == 503, "saturated box must refuse new sessions"
+            assert int(r.headers["Retry-After"]) >= 1
+            cap = await (await client.get("/capacity")).json()
+            assert cap["saturated"] is True and cap["capacity"] == 0
+
+            m = await (await client.get("/metrics")).json()
+            assert m["overload_pressure"] >= 1.0
+            assert m["overload_rung_max"] >= RUNG_PASSTHROUGH
+            assert m.get("overload_admission_rejected_total", 0) >= 1
+            # the ingest queue is visible at /metrics, inside its bound
+            qsnap = m["overload_queues"][f"ingest:{sup.session_id}"]
+            assert 0 <= qsnap["depth"] <= qsnap["bound"]
+
+            # --- phase 2: the fault clears; probe frames wash the EWMA
+            # down, the ladder unwinds rung by rung, and the supervisor
+            # walks DEGRADED -> RECOVERING -> HEALTHY on real steps.
+            pipe.clear_faults()
+            assert await consume_until(
+                lambda: ladder.rung == 0 and sup.state == "HEALTHY",
+                deadline_s=30.0,
+            ), f"no recovery (rung={ladder.rung}, state={sup.state})"
+
+            # admission is open again
+            r = await client.post("/offer", json=_offer_body("post"))
+            assert r.status == 200
+
+            # --- accounting: stop the producer, then balance the books.
+            producer_alive = False
+            await asyncio.sleep(0.05)
+            prod_task.cancel()
+
+            m = await (await client.get("/metrics")).json()
+            shed = m.get("overload_shed_ingest_total", 0)
+            assert shed > 0, "saturation never shed a stale frame"
+            still_queued = src_q.qsize()
+            assert pushed == len(delivered) + shed + still_queued, (
+                f"shed accounting leaks frames: pushed={pushed} "
+                f"delivered={len(delivered)} shed={shed} "
+                f"queued={still_queued}"
+            )
+
+            # every queue stayed at/below its bound throughout
+            assert max_qsize <= src_q.maxsize
+
+            # freshness: the queue-wait age of every admitted frame stayed
+            # under the deadline at p99 — staleness was shed, not served
+            assert m["overload_freshness_p99_ms"] < 300.0
+
+            # the ride is visible at /health: DEGRADED with an overload
+            # reason happened, and the final state is HEALTHY
+            h = await (await client.get("/health")).json()
+            assert h["status"] == "HEALTHY"
+            snap = h["sessions"][sup.session_id]
+            assert snap["overload_rung"] == 0
+            reasons = [t["reason"] for t in snap["transitions"]]
+            assert any("overload" in x for x in reasons)
+            seen = {t["to"] for t in snap["transitions"]}
+            assert {"DEGRADED", "RECOVERING", "HEALTHY"} <= seen
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_overload_chaos_passthrough_keeps_stream_alive(monkeypatch):
+    """During full passthrough shedding the viewer still receives frames
+    (source pixels, delivered promptly) — the stream thins, never freezes."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    monkeypatch.setenv("RESILIENCE_STEP_TIMEOUT_S", "5")
+    monkeypatch.setenv("RESILIENCE_FIRST_STEP_TIMEOUT_S", "5")
+    monkeypatch.setenv("SUPERVISOR_STALL_AFTER_S", "30")
+    monkeypatch.setenv("OVERLOAD_STEP_BUDGET_MS", "60")
+    monkeypatch.setenv("OVERLOAD_TICK_S", "0.05")
+    monkeypatch.setenv("OVERLOAD_UP_TICKS", "2")
+    monkeypatch.setenv("OVERLOAD_DOWN_TICKS", "50")  # stay escalated
+    monkeypatch.setenv("OVERLOAD_PROBE_S", "10")  # no probes: pure shed
+
+    faults.activate(
+        FaultPlan(
+            specs=(
+                FaultSpec(target="engine", kind="slow_step", delay_s=0.25),
+            ),
+            seed=3,
+        )
+    )
+    pipe = SlowableChaosPipeline()
+
+    async def go():
+        app = build_app(pipeline=pipe, provider=LoopbackProvider())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/offer", json=_offer_body())
+            assert r.status == 200
+            pc = next(iter(app["pcs"]))
+            viewer = pc.out_tracks[0]
+            (sup,) = app["supervisors"].values()
+            ov = app["overload"]
+            ladder = ov.ladders[sup.session_id]
+
+            deadline = time.monotonic() + 20.0
+            i = 0
+            while time.monotonic() < deadline and ladder.rung < RUNG_PASSTHROUGH:
+                await pc.in_track.push(np.full((8, 8, 3), i % 200, np.uint8))
+                await asyncio.wait_for(viewer.recv(), timeout=5.0)
+                i += 1
+            assert ladder.rung >= RUNG_PASSTHROUGH
+
+            # full shed: every frame comes back passthrough, and FAST
+            engine_calls = pipe.calls
+            t0 = time.monotonic()
+            for j in range(10):
+                src = np.full((8, 8, 3), 7 + j, np.uint8)
+                await pc.in_track.push(src)
+                out = await asyncio.wait_for(viewer.recv(), timeout=5.0)
+                arr = out if isinstance(out, np.ndarray) else out.to_ndarray()
+                assert np.array_equal(arr, src), "passthrough must be source"
+            assert time.monotonic() - t0 < 2.0, "shed frames must not queue"
+            assert pipe.calls == engine_calls  # no engine work at all
+            assert ladder.frames_skipped >= 10
+            m = await (await client.get("/metrics")).json()
+            assert m["overload_frames_skipped"] >= 10
+        finally:
+            await client.close()
+
+    asyncio.run(go())

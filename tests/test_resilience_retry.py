@@ -32,6 +32,58 @@ def test_jitter_is_bounded_and_seeded():
         assert 0.8 <= d <= 1.2
 
 
+def test_full_jitter_decorrelates_and_pins_when_seeded():
+    """ISSUE 4 satellite: full jitter draws each delay from U[0, core] so a
+    fleet retrying one control plane cannot synchronize into a retry storm.
+    Deterministic when seeded (like faults.py plans): the exact schedule
+    for seed 7 is pinned."""
+    p = RetryPolicy(
+        attempts=6, base_delay_s=1.0, multiplier=2.0, max_delay_s=8.0,
+        full_jitter=True,
+    )
+    g = p.delays(random.Random(7))
+    sched = [round(next(g), 6) for _ in range(5)]
+    assert sched == [0.323833, 0.301698, 2.603738, 0.57949, 4.287056]
+    # same seed -> same schedule; envelope respected for any seed
+    g2 = p.delays(random.Random(7))
+    assert [round(next(g2), 6) for _ in range(5)] == sched
+    caps = [1.0, 2.0, 4.0, 8.0, 8.0]
+    for seed in range(20):
+        g = p.delays(random.Random(seed))
+        for cap in caps:
+            d = next(g)
+            assert 0.0 <= d <= cap
+
+
+def test_full_jitter_takes_precedence_over_fractional():
+    p = RetryPolicy(attempts=3, base_delay_s=1.0, jitter=0.2, full_jitter=True)
+    # fractional jitter would bound delays to [0.8, 1.2]; full jitter uses
+    # the whole [0, 1] interval
+    seen = [next(p.delays(random.Random(s))) for s in range(50)]
+    assert min(seen) < 0.8
+
+
+def test_transient_policy_uses_full_jitter():
+    """The fleet-facing shape (worker publish, Twilio, Civitai, example
+    signaling) is full-jitter by default — the anti-storm satellite."""
+    p = transient_policy(attempts=3, base_delay_s=2.0)
+    assert p.full_jitter
+    slept = []
+    p.run(
+        lambda: (_ for _ in ()).throw(OSError("x")),
+        sleep=slept.append, rng=random.Random(7), default=None,
+    )
+    assert slept == [pytest.approx(2 * 0.32383276483316237, rel=1e-9),
+                     pytest.approx(4 * 0.15084917392450192, rel=1e-9)]
+
+
+def test_poll_policy_stays_unjittered():
+    """The health poll is a fixed-interval deadline-bound probe against
+    localhost — jitter would only blur its budget accounting."""
+    p = poll_policy(budget_s=5.0, interval_s=1.0)
+    assert not p.full_jitter and p.jitter == 0.0
+
+
 def test_run_retries_then_succeeds():
     calls = []
     slept = []

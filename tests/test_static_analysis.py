@@ -94,6 +94,42 @@ def test_metrics_registry_grammar_kind_and_collisions():
     assert "rr_jitter_ms" not in names  # well-formed name stays clean
 
 
+def test_bounded_queue_catches_unbounded_and_respects_bounds():
+    """ISSUE 4 satellite: unbounded asyncio.Queue / collections.deque in
+    package code is the overload failure mode — every spelling flagged,
+    every bounded spelling (including computed bounds) clean."""
+    fs = run_on(["bounded_queue_bad.py"], ("bounded-queue",))
+    lines = {f.line for f in fs}
+    src = (FIXTURES / "bounded_queue_bad.py").read_text().splitlines()
+    flagged = {src[n - 1].strip() for n in lines}
+    assert len(fs) == 9, "\n".join(f.render() for f in fs)
+    assert all("# BAD" in s for s in flagged), flagged
+    # renamed from-imports and module aliases cannot smuggle a queue past
+    # the scan
+    assert any("RenamedQ()" in s for s in flagged)
+    assert any("renamed_dq()" in s for s in flagged)
+    assert any("colls.deque()" in s for s in flagged)
+    # good spellings stay clean: finite literals, positional bounds,
+    # computed bounds, stdlib thread queues, bounded renamed spellings
+    assert not any("ok" in s for s in flagged)
+
+
+def test_bounded_queue_exempts_operator_tooling(tmp_path):
+    """scripts/, examples/ and bench.py are process-lifecycle tooling, not
+    the serving frame path — same carve-out as env-registry raw reads."""
+    root = tmp_path
+    (root / "scripts").mkdir()
+    (root / "ai_rtc_agent_tpu").mkdir()
+    body = "import asyncio\nq = asyncio.Queue()\n"
+    (root / "scripts" / "tool.py").write_text(body)
+    (root / "bench.py").write_text(body)
+    (root / "ai_rtc_agent_tpu" / "serving.py").write_text(body)
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("bounded-queue",))
+    assert [f.path for f in fs] == ["ai_rtc_agent_tpu/serving.py"]
+
+
 # -- shipped-bug reproductions (ROADMAP open items 2 and 3) ------------------
 
 def test_retry_4xx_reproduces_shipped_worker_bug():
