@@ -11,6 +11,8 @@ fix).  This package encodes the rules as checkers over stdlib ``ast``
 
   async-blocking     blocking calls lexically inside ``async def``
   pooled-view        pool-returned memoryviews escaping frame scope
+  span-pairing       trace.begin() without a matching end on some path
+                     (obs/trace.py frame timelines must stay well-formed)
   trace-purity       host state reads inside jitted/pallas functions
   env-registry       env knobs <-> docs/environment.md, both directions
   metrics-registry   /metrics name grammar + collision freedom

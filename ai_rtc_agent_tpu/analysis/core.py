@@ -218,6 +218,7 @@ def run_checkers(project: Project, checkers=None) -> list:
         metrics_registry,
         pooled_views,
         regressions,
+        span_pairing,
         trace_purity,
     )
 
@@ -225,6 +226,7 @@ def run_checkers(project: Project, checkers=None) -> list:
         "async-blocking": async_blocking.check,
         "bounded-queue": bounded_queues.check,
         "pooled-view": pooled_views.check,
+        "span-pairing": span_pairing.check,
         "trace-purity": trace_purity.check,
         "env-registry": env_registry.check,
         "metrics-registry": metrics_registry.check,
@@ -244,6 +246,7 @@ ALL_CHECKERS = (
     "async-blocking",
     "bounded-queue",
     "pooled-view",
+    "span-pairing",
     "trace-purity",
     "env-registry",
     "metrics-registry",

@@ -28,6 +28,10 @@ class VideoFrame:
     # wall-clock of decode completion; carried through the pipeline so the
     # encoder side can compute true glass-to-glass latency (/metrics `glass`)
     wall_ts: float | None = None
+    # per-frame lifecycle trace (obs/trace.py FrameTrace) — None unless
+    # tracing is enabled; rides the frame so every hop can stamp spans
+    # without a lookaside map
+    trace: object = field(default=None, repr=False, compare=False)
 
     @classmethod
     def from_ndarray(cls, arr: np.ndarray, format: str = "rgb24") -> "VideoFrame":
@@ -61,4 +65,7 @@ def wrap_processed(out_u8: np.ndarray, src_frame) -> "VideoFrame":
     vf.pts = src_frame.pts
     vf.time_base = src_frame.time_base
     vf.wall_ts = getattr(src_frame, "wall_ts", None)
+    # the lifecycle trace follows the pixels: the encode/send hops stamp
+    # the SOURCE frame's timeline through the processed output
+    vf.trace = getattr(src_frame, "trace", None)
     return vf

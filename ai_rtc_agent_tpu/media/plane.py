@@ -28,6 +28,7 @@ from fractions import Fraction
 
 import numpy as np
 
+from ..obs.trace import get_trace
 from ..utils import env as env_util
 from ..utils.profiling import FrameStats
 from . import native
@@ -82,6 +83,11 @@ class H264RingSource:
         self._depkt = RtpDepacketizer() if native.load() else None
         self._reorder = RtpReorderBuffer()
         self._meta: dict = {}  # pts -> wall_ts at decode completion
+        # obs/trace.py: the native tier mints each frame's trace at decode
+        # (the frame id IS the RTP pts); populated only while a session
+        # tracer is attached AND tracing is live — same bound as _meta
+        self.tracer = None  # SessionTracer | None (set by the agent wiring)
+        self._trace_decode: dict = {}  # pts -> (t0, t1) decode span stamps
         self._ended = False
         self._handlers: dict = {}
         # decode runs on an executor thread while close() runs on the event
@@ -166,6 +172,13 @@ class H264RingSource:
             if len(self._meta) > 64:  # bound the pts->wall map
                 for k in sorted(self._meta)[:-64]:
                     self._meta.pop(k, None)
+            tracer = self.tracer
+            if tracer is not None and tracer.controller.active():
+                # reuse the stage-gauge clock reads as the decode span
+                self._trace_decode[int(out_pts)] = (t0, now)
+                if len(self._trace_decode) > 64:  # same bound as _meta
+                    for k in sorted(self._trace_decode)[:-64]:
+                        self._trace_decode.pop(k, None)
             if frame.shape != self._ring.frame_shape:
                 # real-SDP offers carry no geometry — the H.264 SPS is the
                 # source of truth.  A browser camera at any resolution must
@@ -195,6 +208,15 @@ class H264RingSource:
         vf.pts = int(pts)
         vf.time_base = Fraction(1, CLOCK_RATE)
         vf.wall_ts = self._meta.get(int(pts))
+        tracer = self.tracer
+        if tracer is not None and tracer.controller.active():
+            # frame id minted at decode: the RTP pts names the frame on
+            # the wire AND in the timeline
+            trace = tracer.mint(frame_id=int(pts))
+            dec = self._trace_decode.pop(int(pts), None)
+            if dec is not None:
+                trace.add_span("decode", dec[0], dec[1])
+            vf.trace = trace
         return vf
 
     def recv_nowait(self) -> VideoFrame | None:
@@ -322,6 +344,7 @@ class H264Sink:
         else:
             arr, pts, wall = np.asarray(frame), self._pts, None
         self._pts = int(pts) + self._pts_step
+        trace = get_trace(frame)
         if (
             wall is not None
             and self._deadline_s
@@ -329,6 +352,11 @@ class H264Sink:
         ):
             self.shed_stale += 1
             self.stats.count("overload_shed_tx_stale")
+            if trace is not None:
+                # the TX-deadline eviction is a terminal event for this
+                # frame's timeline, not just a counter bump
+                trace.mark("tx_shed")
+                trace.finish("shed")
             return []
 
         t0 = time.monotonic()
@@ -353,6 +381,8 @@ class H264Sink:
                 au = NullCodec.encode(arr, pts=int(pts))
         now = time.monotonic()
         self.stats.record_stage("encode", now - t0)
+        if trace is not None:
+            trace.add_span("encode", t0, now)  # stage-gauge stamps reused
         if wall is not None:
             self.stats.record_stage("glass", now - wall)
         if not au:
@@ -361,11 +391,17 @@ class H264Sink:
             if self._pkt is None:
                 return [au] if not self._closed else []
             t1 = time.perf_counter()
+            # the µs-scale plane gauges run on perf_counter; the trace
+            # timeline runs on monotonic — separate reads keep the bases
+            # from mixing
+            tm0 = time.monotonic() if trace is not None else 0.0
             pkts = self._pkt.packetize(au, int(pts))
             if self.plane_stats is not None:
                 self.plane_stats.record_stage(
                     "packetize", time.perf_counter() - t1
                 )
+            if trace is not None:
+                trace.add_span("packetize", tm0, time.monotonic())
             return pkts
 
     def force_keyframe(self):

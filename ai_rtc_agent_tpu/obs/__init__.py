@@ -1,0 +1,23 @@
+"""Observability subsystem: per-frame tracing + black-box flight recorder.
+
+* obs/trace.py — :class:`FrameTrace` span timelines threaded through every
+  hop of the media path (decode → … → send), zero-cost when off.
+* obs/recorder.py — :class:`FlightRecorder`: bounded per-session rings of
+  completed timelines + an always-on structured event log, snapshotted
+  automatically on StreamDegraded/FAILED and on demand via
+  ``GET /debug/flight``.
+* obs/export.py — Chrome trace-event JSON (Perfetto) / JSONL renderings,
+  plus the opt-in ``jax.profiler`` bridge.
+
+Full tour: docs/observability.md.
+"""
+
+from .recorder import FlightRecorder, SessionRecorder  # noqa: F401
+from .trace import (  # noqa: F401
+    STAGES,
+    TERMINALS,
+    FrameTrace,
+    SessionTracer,
+    TraceController,
+    get_trace,
+)

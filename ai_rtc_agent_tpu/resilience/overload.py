@@ -549,6 +549,10 @@ class OverloadControlPlane:
         # operator knob.
         self._pending: dict = {}  # session key -> reservation deadline
         self._pending_ttl_s = 30.0
+        # flight-recorder hook (obs/recorder.py): callable(session_key,
+        # kind, **data) fed ladder rung moves — overload escalation is
+        # exactly what a post-mortem needs on its event timeline
+        self.on_event = None
         # delivered-frame freshness reservoir (bounded; appended per frame,
         # percentiles computed per snapshot over <=512 floats — cost is
         # constant, independent of session count or queue depth)
@@ -567,7 +571,7 @@ class OverloadControlPlane:
             down_after=self._down_after,
             probe_interval_s=self._probe_s,
             clock=self._clock,
-            on_rung=self._count_rung_move,
+            on_rung=lambda old, new, key=key: self._rung_moved(key, old, new),
         )
         self.ladders[key] = ladder
         return ladder
@@ -581,9 +585,18 @@ class OverloadControlPlane:
         for name in [n for n in self.queues if n.endswith(f":{key}")]:
             self.queues.pop(name, None)
 
-    def _count_rung_move(self, old: int, new: int):
+    def _rung_moved(self, key: str, old: int, new: int):
         if self.stats is not None:
             self.stats.count("overload_ladder_moves")
+        cb = self.on_event
+        if cb is not None:
+            try:
+                cb(
+                    key, "overload_rung",
+                    old=RUNG_LABELS[old], new=RUNG_LABELS[new],
+                )
+            except Exception:
+                logger.exception("overload on_event handler failed")
 
     def register_queue(self, name: str, q) -> object:
         """Register any object exposing ``depth``/``bound``/``shed_overflow``

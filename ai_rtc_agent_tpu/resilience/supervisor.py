@@ -37,6 +37,7 @@ import queue
 import threading
 import time
 
+from ..obs.trace import get_trace
 from ..utils import env
 from .faults import DeviceLostError
 from .overload import ShedFrame
@@ -107,6 +108,10 @@ class SessionSupervisor:
         self.restart = restart
         self.resync = resync
         self.on_transition = on_transition
+        # flight-recorder hook (obs/recorder.py): callable(kind, **data)
+        # fed restart attempts/outcomes — the event-log entries that
+        # explain a post-mortem; may fire from any thread
+        self.on_event = None
         self._clock = clock
         self._sleep = sleep
         self._lock = threading.RLock()
@@ -306,9 +311,20 @@ class SessionSupervisor:
 
     # -- recovery -----------------------------------------------------------
 
+    def _fire_event(self, kind: str, **data):
+        cb = self.on_event
+        if cb is None:
+            return
+        try:
+            cb(kind, **data)
+        except Exception:
+            logger.exception("supervisor on_event handler failed")
+
     def _restart_once(self):
         with self._lock:
             self._restarts += 1
+            n = self._restarts
+        self._fire_event("restart_attempt", attempt=n)
         self.restart()
 
     def _run_restart(self):
@@ -326,6 +342,7 @@ class SessionSupervisor:
                 label=f"engine restart ({self.session_id})",
             )
         except RetryError as e:
+            self._fire_event("restart_failed", error=repr(e.last))
             with self._lock:
                 self._recovery_pending = False
                 fire = self._transition_locked(
@@ -333,6 +350,7 @@ class SessionSupervisor:
                 )
             self._notify(fire)
             return
+        self._fire_event("restart_ok")
         with self._lock:
             self._recovery_pending = False
             self._healthy_steps = 0
@@ -629,6 +647,13 @@ class ResilientPipeline:
 
     def _passthrough(self, frame, n: int = 1):
         self.supervisor.note_frame_out(n, processed=False)
+        frame_trace = get_trace(frame)
+        if frame_trace is not None:
+            # terminal marker: the engine was bypassed and the SOURCE
+            # pixels were delivered — the timeline seals here so the
+            # flight recorder shows passthrough per frame, not just in
+            # the aggregate counters (the frame itself keeps flowing)
+            frame_trace.finish("passthrough")
         return frame
 
     def _admit_frame(self) -> bool:

@@ -35,6 +35,7 @@ from typing import List, Tuple
 
 from aiohttp import web
 
+from ..obs.recorder import FlightRecorder
 from ..resilience.overload import OverloadControlPlane, QueueProbe, ShedFrame
 from ..resilience.supervisor import (
     ResilientPipeline,
@@ -66,6 +67,8 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
     stats: FrameStats = app["stats"]
     handler: StreamEventHandler = app["stream_event_handler"]
     loop = asyncio.get_event_loop()
+    flight: FlightRecorder | None = app.get("flight")
+    rec = flight.register(session_key) if flight is not None else None
 
     def resync():
         # PLI-driven keyframe re-sync on recovery: force OUR encoder to
@@ -81,9 +84,27 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
     def on_transition(old, new, reason):
         # tpurtc: allow[metrics-registry] -- closed enum: new is one of the 4 supervisor states, keys supervisor_{healthy,degraded,recovering,failed}_total
         stats.count(f"supervisor_{new.lower()}")
+        snap_id = None
+        recent = None
+        if rec is not None:
+            rec.event("supervisor", old=old, new=new, reason=reason)
+            if new in (
+                "DEGRADED", "FAILED"
+            ) and flight is not None:
+                # black-box moment: freeze the event log + frame timelines
+                # NOW, before recovery churn overwrites the rings — the
+                # snapshot id rides the StreamDegraded webhook so external
+                # orchestrators can pull GET /debug/flight?id= later
+                snap_id = flight.take_snapshot(
+                    session_key, reason=f"{new}: {reason}"
+                )
+            recent = rec.recent_events()
 
         def fire():
-            handler.handle_session_state(session_key, room_id, new, reason)
+            handler.handle_session_state(
+                session_key, room_id, new, reason,
+                flight_snapshot_id=snap_id, recent_events=recent,
+            )
 
         try:  # may fire from a worker thread — webhooks belong on the loop
             loop.call_soon_threadsafe(fire)
@@ -93,6 +114,8 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
     sup = SessionSupervisor(
         session_key, resync=resync, on_transition=on_transition
     )
+    if rec is not None:
+        sup.on_event = rec.event  # restart attempts/outcomes -> event log
     wrapped = ResilientPipeline(pipeline, sup)
     ov = app.get("overload")
     if ov is not None:
@@ -115,6 +138,20 @@ def _register_ingest_queue(app, session_key: str, track):
         ov.register_queue(f"ingest:{session_key}", QueueProbe(src_q))
 
 
+def _session_tracer(app, session_key: str, src_track=None):
+    """The session's frame tracer (obs/trace.py), registered with the
+    flight recorder; None when the recorder is disabled.  Native-tier
+    sources (H264RingSource) get the tracer bound directly so frame ids
+    mint at DECODE; other tiers mint at the track's ingest hop."""
+    flight = app.get("flight")
+    if flight is None:
+        return None
+    tracer = flight.register(session_key).tracer
+    if src_track is not None and hasattr(src_track, "tracer"):
+        src_track.tracer = tracer
+    return tracer
+
+
 def _end_supervision(app, session_key: str):
     sup = app.get("supervisors", {}).pop(session_key, None)
     if sup is not None:
@@ -122,6 +159,11 @@ def _end_supervision(app, session_key: str):
     ov = app.get("overload")
     if ov is not None:
         ov.unregister_session(session_key)
+    flight = app.get("flight")
+    if flight is not None:
+        # live rings go with the session; stored snapshots survive (the
+        # black box outlives the crash it recorded)
+        flight.unregister(session_key)
 
 
 # ---------------------------------------------------------------------------
@@ -335,7 +377,8 @@ async def offer(request):
                 )
                 _register_ingest_queue(app, stream_id, track)
                 video_track = VideoStreamTrack(
-                    track, supervised, overload=app.get("overload")
+                    track, supervised, overload=app.get("overload"),
+                    tracer=_session_tracer(app, stream_id, track),
                 )
                 tracks["video"] = video_track
                 sender = pc.addTrack(video_track)
@@ -596,7 +639,8 @@ async def whip(request):
                 )
                 _register_ingest_queue(app, session_id, track)
                 vt = VideoStreamTrack(
-                    track, supervised, overload=app.get("overload")
+                    track, supervised, overload=app.get("overload"),
+                    tracer=_session_tracer(app, session_id, track),
                 )
                 app["state"].setdefault("whip_tracks", {})[session_id] = vt
                 app["state"]["source_track"] = vt  # latest publisher wins
@@ -723,6 +767,103 @@ async def capacity(request):
     return web.json_response(ov.capacity(free_slots=free))
 
 
+async def debug_flight(request):
+    """The flight recorder's pull surface (docs/observability.md):
+
+      GET /debug/flight                     index (sessions, snapshots)
+      GET /debug/flight?session=<key>       live capture of a session
+      GET /debug/flight?id=<snapshot-id>    stored post-mortem snapshot
+      &format=chrome | jsonl                Perfetto / grep renderings
+    """
+    flight = request.app.get("flight")
+    if flight is None:
+        return web.Response(status=404, text="flight recorder disabled")
+    q = request.query
+    fmt = q.get("format", "json")
+    if fmt not in ("json", "chrome", "jsonl"):
+        return web.Response(status=400, text=f"unknown format {fmt!r}")
+    if "id" in q:
+        snap = flight.get_snapshot(q["id"])
+        if snap is None:
+            return web.Response(status=404, text=f"unknown snapshot {q['id']!r}")
+    elif "session" in q:
+        rec = flight.session(q["session"])
+        if rec is None:
+            return web.Response(status=404, text=f"unknown session {q['session']!r}")
+        snap = rec.snapshot(reason="on-demand")
+    else:
+        if fmt != "json":
+            # the index is not a capture — a tooling URL whose id/session
+            # variable expanded empty should fail loudly, not feed the
+            # index dict to a Perfetto loader
+            return web.Response(
+                status=400, text="format= applies to a capture — pass id= or session="
+            )
+        return web.json_response(flight.index())
+    if fmt == "chrome":
+        from ..obs.export import to_chrome_trace
+
+        return web.json_response(to_chrome_trace(snap))
+    if fmt == "jsonl":
+        from ..obs.export import to_jsonl
+
+        return web.Response(
+            text=to_jsonl(snap), content_type="application/x-ndjson"
+        )
+    return web.json_response(snap)  # fmt == "json", validated above
+
+
+async def debug_trace(request):
+    """Start/stop the per-frame tracing window:
+
+      GET  /debug/trace                       status
+      POST /debug/trace {"action": "start", "duration_s": 30,
+                         "jax_profiler_dir": "/tmp/tpu-trace"}  (dir opt-in)
+      POST /debug/trace {"action": "stop"}
+
+    Captures are bounded by TRACE_MAX_CAPTURE_S — a forgotten start can
+    never leave per-frame allocation on forever.  The optional
+    jax.profiler bridge opens a TPU trace over the same window so the
+    device timeline and the host frame timeline line up."""
+    flight = request.app.get("flight")
+    if flight is None:
+        return web.Response(status=404, text="flight recorder disabled")
+    if request.method == "GET":
+        return web.json_response(flight.controller.status())
+    try:
+        body = await request.json()
+    except (ValueError, LookupError):
+        return web.Response(status=400, text="invalid JSON body")
+    action = body.get("action")
+    from ..obs import export as obs_export
+
+    if action == "start":
+        duration = body.get("duration_s")
+        if duration is not None:
+            try:
+                duration = float(duration)
+            except (TypeError, ValueError):
+                return web.Response(
+                    status=400, text="duration_s must be a number"
+                )
+        granted = flight.controller.start(duration)
+        out = {"tracing": True, "duration_s": round(granted, 3)}
+        jax_dir = body.get("jax_profiler_dir")
+        if jax_dir:
+            # profiler start touches the device runtime — off the loop
+            err = await asyncio.to_thread(obs_export.start_jax_bridge, jax_dir)
+            out["jax_profiler"] = err or f"tracing to {jax_dir}"
+        return web.json_response(out)
+    if action == "stop":
+        flight.controller.stop()
+        err = await asyncio.to_thread(obs_export.stop_jax_bridge)
+        out = {"tracing": False}
+        if err:
+            out["jax_profiler"] = err
+        return web.json_response(out)
+    return web.Response(status=400, text="action must be start|stop")
+
+
 async def demo(_):
     """Self-contained browser client for the /offer path — the reference
     depends on a hosted web app for this (ref docs/connect.md:3-5)."""
@@ -750,6 +891,14 @@ async def metrics(request):
         if mp is not None:
             out["overload_peer_frames_shed"] = mp.frames_shed
         out.update(ov.snapshot())
+    # tracing / flight recorder (obs/): cheap int reads, like the overload
+    # snapshot — observability endpoints must survive the incidents they
+    # exist to explain
+    flight = request.app.get("flight")
+    if flight is not None:
+        out["trace_enabled"] = int(flight.controller.active())
+        out["flight_sessions"] = len(flight.sessions)
+        out["flight_snapshots_stored"] = len(flight.snapshots)
     return web.json_response(out)
 
 
@@ -923,11 +1072,35 @@ async def on_startup(app):
     # decode/encode/glass-to-glass stages next to submit->fetch latency
     if hasattr(app["provider"], "attach_stats"):
         app["provider"].attach_stats(app["stats"])
+    # flight recorder + frame tracing (obs/): the black box every session
+    # writes into; FLIGHT_RECORDER=0 removes the whole subsystem (and the
+    # /debug endpoints 404)
+    if env.get_bool("FLIGHT_RECORDER", True):
+        flight = FlightRecorder(stats=app["stats"])
+        app["flight"] = flight
+
+        def _webhook_emitted(event_name, stream_id):
+            rec = flight.session(stream_id)
+            if rec is not None:
+                rec.event("webhook", event=event_name)
+
+        app["stream_event_handler"].on_emit = _webhook_emitted
+    else:
+        app["flight"] = None
     # overload control plane: admission, lag watchdog, shedding ladders
     # (OVERLOAD_CONTROL=0 restores the pre-overload-plane agent)
     if env.get_bool("OVERLOAD_CONTROL", True):
         ov = OverloadControlPlane(app["stats"])
         app["overload"] = ov
+        if app["flight"] is not None:
+            flight = app["flight"]
+
+            def _overload_event(session_key, kind, **data):
+                rec = flight.session(session_key)
+                if rec is not None:
+                    rec.event(kind, **data)
+
+            ov.on_event = _overload_event
         await ov.start()
     else:
         app["overload"] = None
@@ -996,6 +1169,9 @@ def build_app(
     app.router.add_get("/health", health_detail)
     app.router.add_get("/capacity", capacity)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_get("/debug/trace", debug_trace)
+    app.router.add_post("/debug/trace", debug_trace)
     app.router.add_get("/demo", demo)
     return app
 

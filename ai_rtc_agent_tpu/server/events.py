@@ -15,7 +15,7 @@ import asyncio
 import logging
 import time
 
-from pydantic import BaseModel
+from pydantic import BaseModel, Field
 
 from ..utils import env
 
@@ -39,11 +39,19 @@ class StreamEndedEvent(WebhookEvent):
 class StreamDegradedEvent(WebhookEvent):
     """Supervisor moved the session out of HEALTHY (resilience/supervisor):
     ``state`` is the new state (DEGRADED or FAILED), ``reason`` the trigger.
-    The stream is still flowing — in passthrough — when state=DEGRADED."""
+    The stream is still flowing — in passthrough — when state=DEGRADED.
+
+    ``flight_snapshot_id`` names the flight-recorder capture frozen at
+    this transition (obs/recorder.py) — orchestrators pull
+    ``GET /debug/flight?id=<id>`` for the post-mortem; ``recent_events``
+    carries the last few black-box entries inline so the webhook alone
+    already says what led up to the degrade (docs/resilience.md)."""
 
     event: str = "StreamDegraded"
     state: str = "DEGRADED"
     reason: str = ""
+    flight_snapshot_id: str | None = None
+    recent_events: list = Field(default_factory=list)
 
 
 class StreamRecoveredEvent(WebhookEvent):
@@ -60,6 +68,10 @@ class StreamEventHandler:
         self.token = env.get_str("AUTH_TOKEN")
         self._session_factory = session_factory
         self._tasks: set = set()
+        # flight-recorder hook (obs/recorder.py): callable(event_name,
+        # stream_id) fired when a webhook is actually dispatched, so the
+        # black box's event log shows what the outside world was told
+        self.on_emit = None
 
     def _event(
         self, event_name: str, stream_id: str, room_id: str, **extra
@@ -112,6 +124,11 @@ class StreamEventHandler:
         if self.webhook_url is None or self.token is None:
             return None
         ev = self._event(event_name, stream_id, room_id, **extra)
+        if self.on_emit is not None:
+            try:
+                self.on_emit(event_name, stream_id)
+            except Exception:
+                logger.exception("webhook on_emit hook failed")
         try:
             task = asyncio.get_running_loop().create_task(self._post(ev))
             self._tasks.add(task)
@@ -129,12 +146,25 @@ class StreamEventHandler:
         return self.send_request("StreamEnded", stream_id, room_id)
 
     def handle_session_state(
-        self, stream_id: str, room_id: str, state: str, reason: str
+        self,
+        stream_id: str,
+        room_id: str,
+        state: str,
+        reason: str,
+        flight_snapshot_id: str | None = None,
+        recent_events: list | None = None,
     ):
         """Supervisor transition -> webhook: non-HEALTHY states emit
         StreamDegraded (state carries DEGRADED/RECOVERING/FAILED), a return
-        to HEALTHY emits StreamRecovered."""
+        to HEALTHY emits StreamRecovered.  Degrades carry the flight-
+        recorder snapshot id + the last black-box entries so external
+        orchestrators can pull ``GET /debug/flight?id=`` for the
+        post-mortem (docs/resilience.md)."""
         name = "StreamRecovered" if state == "HEALTHY" else "StreamDegraded"
-        return self.send_request(
-            name, stream_id, room_id, state=state, reason=reason
-        )
+        extra = {"state": state, "reason": reason}
+        if name == "StreamDegraded":
+            if flight_snapshot_id is not None:
+                extra["flight_snapshot_id"] = flight_snapshot_id
+            if recent_events:
+                extra["recent_events"] = recent_events
+        return self.send_request(name, stream_id, room_id, **extra)

@@ -306,20 +306,25 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
         self.transport.sendto(wire, addr)
         return True
 
-    def send_media_batch(self, packets) -> bool:
+    def send_media_batch(self, packets, trace=None) -> bool:
         """Outbound RTP, one whole frame at a time: frame-granular SRTP
         (protect_frame — one keystream pass for every fragment) and a
         single coalesced socket flush.  Returns False while the handshake
-        has not yet produced keys / an ICE-latched peer."""
+        has not yet produced keys / an ICE-latched peer.  ``trace``: the
+        frame's lifecycle trace (obs/trace.py) — the protect/send hops
+        land on it as spans (monotonic base, separate from the
+        perf_counter µs gauges)."""
         if self.transport is None or self.session is None or not packets:
             return False
         stats = self._plane_stats
         t0 = time.perf_counter()
+        tm0 = time.monotonic() if trace is not None else 0.0
         wires = self.session.protect_rtp_frame(packets)
         addr = self.session.peer_addr
         if wires is None or addr is None:
             return False
         t1 = time.perf_counter()
+        tm1 = time.monotonic() if trace is not None else 0.0
         for plain, wire in zip(packets, wires):
             self._rtcp_state.sent(plain, wire)
         self._flush.flush(wires, addr)
@@ -328,6 +333,9 @@ class _RtpReceiverProtocol(asyncio.DatagramProtocol):
             stats.record_stage("protect", t1 - t0)
             stats.record_stage("send", t2 - t1)
             stats.count("tx_packets", len(wires))
+        if trace is not None:
+            trace.add_span("protect", tm0, tm1)
+            trace.add_span("send", tm1, time.monotonic())
         return True
 
     def datagram_received(self, data, addr):
@@ -847,30 +855,51 @@ class NativeRtpPeerConnection:
         encode runs on a worker thread; the whole frame's packet batch
         then flushes in ONE loop hop (frame-granular SRTP + sendmmsg)
         instead of one sendto per fragment (ISSUE 2)."""
+        from ..obs.trace import get_trace
+
         try:
             while self.connectionState != "closed":
                 frame = await track.recv()
                 pkts = await asyncio.to_thread(sink.consume, frame)
+                trace = get_trace(frame)
                 if not pkts:
+                    # TX-deadline sheds already terminal-marked their
+                    # trace inside the sink; an encoder still buffering
+                    # leaves the timeline open for the AU's eventual frame
                     continue
+                sent = False
                 if self._secure_session is not None:
                     # drops silently until DTLS keys + ICE latch exist
                     if self._batch_tx:
-                        self._recv_protocol.send_media_batch(pkts)
+                        sent = self._recv_protocol.send_media_batch(
+                            pkts, trace=trace
+                        )
                     else:
+                        # per-packet tier (HOST_PLANE_BATCH=0): protect and
+                        # send interleave per fragment, so the timeline gets
+                        # ONE combined span (marked per_packet_tx) rather
+                        # than a truncated one that reads as a wedged hop
+                        tm0 = time.monotonic() if trace is not None else 0.0
                         for pkt in pkts:
-                            self._recv_protocol.send_media(pkt)
+                            sent = self._recv_protocol.send_media(pkt) or sent
+                        if trace is not None:
+                            trace.mark("per_packet_tx")
+                            trace.add_span("send", tm0, time.monotonic())
                 else:
-                    self._send_plain(pkts)
+                    self._send_plain(pkts, trace=trace)
+                    sent = True
+                if trace is not None:
+                    trace.finish("sent" if sent else "dropped")
         except (ConnectionError, asyncio.CancelledError):
             pass
         except Exception:
             logger.exception("sender pump failed")
 
-    def _send_plain(self, pkts) -> None:
+    def _send_plain(self, pkts, trace=None) -> None:
         """Plain-tier frame flush: one coalesced batch on the connected
         send socket (per-packet sendto when batching is off)."""
         t0 = time.perf_counter()
+        tm0 = time.monotonic() if trace is not None else 0.0
         for pkt in pkts:
             self._rtcp_state.sent(pkt, pkt)
         if self._batch_tx:
@@ -880,6 +909,8 @@ class NativeRtpPeerConnection:
                 self._send_transport.sendto(pkt)
         self.plane_stats.record_stage("send", time.perf_counter() - t0)
         self.plane_stats.count("tx_packets", len(pkts))
+        if trace is not None:
+            trace.add_span("send", tm0, time.monotonic())
 
     # OBS full-gather parity — nothing to gather on plain UDP
     async def _RTCPeerConnection__gather(self):

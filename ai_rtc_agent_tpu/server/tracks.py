@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 
 from ..resilience.overload import ShedFrame
@@ -44,10 +45,16 @@ class VideoStreamTrack:
     kind = "video"
 
     def __init__(self, track, pipeline, pipeline_depth: int | None = None,
-                 overload=None):
+                 overload=None, tracer=None):
         self.track = track
         self.pipeline = pipeline
         self.overload = overload  # OverloadControlPlane | None
+        # obs/trace.py SessionTracer: the track is the INGEST hop, so it
+        # is where a frame that arrived without a trace (loopback/aiortc
+        # tiers — the native tier mints at decode) gets one, and where
+        # freshest-wins sheds are terminal-marked.  None = tracing never
+        # touches this track (zero overhead).
+        self.tracer = tracer
         self.warmup_frame_idx = 0
         self.warmup_frames = env.warmup_frames()
         self.drop_frames = env.drop_frames()
@@ -79,6 +86,17 @@ class VideoStreamTrack:
     def _fbs(self) -> int:
         return int(getattr(self.pipeline, "frame_buffer_size", 1) or 1)
 
+    # -- observability --------------------------------------------------------
+
+    @staticmethod
+    def _stamp_ingest(trace, frame):
+        """The ingest span: decode-complete (wall_ts stamp) -> admitted
+        into the pipeline — exactly the queue-wait component the overload
+        plane controls."""
+        now = time.monotonic()
+        wall = getattr(frame, "wall_ts", None)
+        trace.add_span("ingest", wall if wall is not None else now, now)
+
     # -- overload hooks -------------------------------------------------------
 
     async def _pull_fresh(self):
@@ -91,8 +109,12 @@ class VideoStreamTrack:
         inside it.  A stale frame with nothing behind it is still
         delivered — a late frame beats a frozen stream."""
         frame = await self.track.recv()
+        tracer = self.tracer
+        trace = tracer.attach(frame) if tracer is not None else None
         ov = self.overload
         if ov is None:
+            if trace is not None:
+                self._stamp_ingest(trace, frame)
             return frame
         recv_nowait = getattr(self.track, "recv_nowait", None)
         if ov.frame_deadline_s and recv_nowait is not None:
@@ -101,10 +123,19 @@ class VideoStreamTrack:
                 nxt = recv_nowait()
                 if nxt is None:
                     break
+                if trace is not None:
+                    # the shed frame's timeline ends HERE, visibly — PR 4's
+                    # freshest-frame-wins eviction per frame, not just a
+                    # counter bump
+                    trace.mark("ingest_shed")
+                    trace.finish("shed")
                 frame = nxt
+                trace = tracer.attach(frame) if tracer is not None else None
                 shed += 1
             if shed:
                 ov.note_shed_ingest(shed)
+        if trace is not None:
+            self._stamp_ingest(trace, frame)
         # freshness is measured HERE, at the pick: the queue-wait age of the
         # frame admitted into the pipeline is exactly the component the
         # overload plane controls (device time shows up in latency_p*_ms

@@ -628,6 +628,15 @@ class StreamEngine:
         self._skip_count = 0
         self._last_out = None
         self._last_submitted = None
+        # observability flag (obs/trace.py): True when the most recent
+        # submit() ON THIS THREAD resolved via the similarity filter
+        # instead of a device step — a plain attribute write (no clock,
+        # no env: trace-purity safe) that the pipeline façade turns into
+        # a trace mark.  Thread-local because the engine is shared by
+        # every non-multipeer session: set-then-read happens within one
+        # to_thread hop, and a concurrent session's submit on another
+        # thread must not cross-contaminate the mark
+        self._submit_skip_flag = threading.local()
         # compute-path fault injection (resilience/faults.py): None unless
         # a plan targeting the engine is active — disabled injection costs
         # one is-None test per submit
@@ -803,6 +812,17 @@ class StreamEngine:
         """
         return self.fetch(self.submit(frame_u8))
 
+    @property
+    def last_submit_was_skip(self) -> bool:
+        """Did the most recent submit() on the CALLING thread resolve via
+        the similarity filter?  Thread-local (see __init__): sessions
+        sharing this engine read only their own submit's outcome."""
+        return getattr(self._submit_skip_flag, "value", False)
+
+    @last_submit_was_skip.setter
+    def last_submit_was_skip(self, value: bool):
+        self._submit_skip_flag.value = value
+
     def submit(self, frame_u8: np.ndarray):
         """Dispatch one stream step WITHOUT waiting for the result.
 
@@ -816,6 +836,7 @@ class StreamEngine:
         """
         if self.state is None:
             raise RuntimeError("call prepare() first")
+        self.last_submit_was_skip = False
         if self._fault_scope is not None:
             # injected slow step (blocks this worker thread, simulating a
             # wedged device dispatch), DeviceLostError, or NaN output —
@@ -838,6 +859,7 @@ class StreamEngine:
                 # stays correct even when fetches run concurrently on pool
                 # threads (resolving against host-side _last_out would race
                 # the in-flight frames and step the stream backwards)
+                self.last_submit_was_skip = True
                 if self._last_submitted is not None:
                     return ("dup",) + self._last_submitted
                 return None, frame_u8.ndim == 3

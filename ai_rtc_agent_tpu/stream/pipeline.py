@@ -21,12 +21,14 @@ Differences, all deliberate and TPU-motivated:
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import replace
 from typing import Sequence
 
 import numpy as np
 
 from ..models import registry
+from ..obs.trace import get_trace
 from ..utils import env
 from .engine import StreamConfig, StreamEngine
 
@@ -273,10 +275,22 @@ class StreamDiffusionPipeline:
         return out_u8
 
     def __call__(self, frame):
-        pre = self.preprocess(frame)
-        out = self.predict(pre)
+        trace = get_trace(frame)  # None (one getattr) unless tracing is on
+        if trace is None:
+            pre = self.preprocess(frame)
+            out = self.predict(pre)
+            if hasattr(frame, "pts") and not env.hw_encode():
+                return self.postprocess(out, frame)
+            return out
+        with trace.span("submit"):
+            pre = self.preprocess(frame)
+        with trace.span("engine_step"):  # sync path: the whole device step
+            out = self.predict(pre)
+        if self.engine.last_submit_was_skip:
+            trace.mark("similar_skip")
         if hasattr(frame, "pts") and not env.hw_encode():
-            return self.postprocess(out, frame)
+            with trace.span("postprocess"):
+                return self.postprocess(out, frame)
         return out
 
     # -- pipelined (async-dispatch) frame path ------------------------------
@@ -285,8 +299,16 @@ class StreamDiffusionPipeline:
         """Dispatch one frame without waiting (see engine.submit); returns a
         handle for :meth:`fetch`.  Lets the caller keep several frames in
         flight so device compute, dispatch and readback overlap."""
-        pre = self.preprocess(frame)
-        return self.engine.submit(pre)
+        trace = get_trace(frame)
+        if trace is None:
+            pre = self.preprocess(frame)
+            return self.engine.submit(pre)
+        with trace.span("submit"):  # host preprocess + async device dispatch
+            pre = self.preprocess(frame)
+            handle = self.engine.submit(pre)
+        if self.engine.last_submit_was_skip:
+            trace.mark("similar_skip")
+        return handle
 
     # -- frame_buffer_size > 1: batched amortization in SERVING -------------
     # (the reference pins fbs at engine-build time, lib/wrapper.py:159-163;
@@ -318,11 +340,26 @@ class StreamDiffusionPipeline:
 
     def fetch(self, handle, src_frame=None):
         """Resolve a submit() handle; attaches pts metadata like __call__."""
+        trace = get_trace(src_frame) if src_frame is not None else None
+        if trace is not None:
+            t0 = time.monotonic()
         out = self.engine.fetch(handle)
         if self.safety_checker is not None:
             out = self.safety_checker(out)
+        if trace is not None:
+            t1 = time.monotonic()
+            # fetch = the blocking host-side resolve; engine_step = the
+            # frame's device residency, submit-end -> resolve-end (the
+            # host-observable bound on the async step — stamped OUTSIDE
+            # jit, the trace-purity checker holds that line)
+            trace.add_span("fetch", t0, t1)
+            sub_end = trace.span_end("submit")
+            trace.add_span("engine_step", sub_end if sub_end is not None else t0, t1)
         if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
-            return self.postprocess(out, src_frame)
+            if trace is None:
+                return self.postprocess(out, src_frame)
+            with trace.span("postprocess"):
+                return self.postprocess(out, src_frame)
         return out
 
 

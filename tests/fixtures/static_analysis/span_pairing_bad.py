@@ -1,0 +1,107 @@
+"""Known-bad fixture for the span-pairing checker (analysis/span_pairing.py).
+
+Every function marked BAD leaves a trace span open on some path (or closes
+one that was never opened); every function marked ok is a correct spelling
+that must stay clean — the precision half of the contract.
+"""
+
+
+def bad_early_return(trace, frame):  # BAD: return before end
+    trace.begin("submit")
+    if frame is None:
+        return None  # "submit" still open here
+    trace.end("submit")
+    return frame
+
+
+def bad_branch_only_begin(trace, flag):  # BAD: begin in one branch only
+    if flag:
+        trace.begin("encode")
+    do_work()
+    return 1  # open iff flag — flagged at the return
+
+
+def bad_raise_path(trace, data):  # BAD: raise skips the end
+    trace.begin("packetize")
+    if not data:
+        raise ValueError("no data")  # "packetize" open
+    trace.end()
+    return data
+
+
+def bad_never_closed(trace):  # BAD: fall-through with an open span
+    trace.begin("send")
+    do_work()
+
+
+def bad_unbalanced_end(trace):  # BAD: end with nothing open
+    trace.end("decode")
+
+
+def bad_wrong_name(trace):  # BAD: end closes a name never begun
+    trace.begin("encode")
+    trace.end("decode")  # "decode" not open
+    trace.end("encode")
+
+
+def bad_handler_swallow(trace):  # BAD: raise mid-try leaks via the handler
+    try:
+        trace.begin("submit")
+        do_work()  # may raise with "submit" open
+        trace.end("submit")
+    except Exception:
+        return None  # entered between begin and end: "submit" still open
+
+
+def bad_with_begin(trace):  # BAD: begin() returns None — crashes as a ctx mgr
+    with trace.begin("encode"):
+        do_work()
+
+
+def ok_linear(trace):
+    trace.begin("submit")
+    do_work()
+    trace.end("submit")
+
+
+def ok_try_finally(trace, frame):
+    trace.begin("engine_step")
+    try:
+        if frame is None:
+            return None  # finally still closes the span
+        return do_work()
+    finally:
+        trace.end("engine_step")
+
+
+def ok_context_manager(trace):
+    with trace.span("encode"):
+        do_work()
+    return 1
+
+
+def ok_both_branches(trace, flag):
+    trace.begin("fetch")
+    if flag:
+        trace.end("fetch")
+    else:
+        trace.end()
+    return flag
+
+
+def ok_bare_end_stack(trace):
+    trace.begin("outer")
+    trace.begin("inner")
+    trace.end()  # inner
+    trace.end()  # outer
+
+
+def ok_not_a_trace(queue):
+    # receivers without "trace" in the name are out of scope — a DB
+    # transaction's begin() must not be mistaken for a span
+    queue.begin("txn")
+    return queue
+
+
+def do_work():
+    return 0

@@ -263,6 +263,45 @@ def test_host_plane_bench_contract_and_speedup(tmp_path):
     assert banked and banked[-1]["metric"] == "host_plane_batched_speedup"
 
 
+def test_trace_overhead_bench_contract(tmp_path):
+    """Tracing-overhead microbench smoke (ISSUE 5): runs in seconds on
+    CPU, emits exactly one contract line, BANKS it into PERF_LOG_PATH,
+    and the zero-cost-when-off promise holds as a guarded ratio.  The
+    fence is deliberately loose for contended CI boxes — what it catches
+    is a regression that puts allocation/locking/clock reads back on the
+    trace-off hot path (that is a multi-x blowup, not a few percent)."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "PERF_LOG_PATH": str(log),
+            "TRACE_BENCH_FRAMES": "400",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/trace_overhead_bench.py"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "trace_off_overhead_ratio"
+    assert 0 < d["value"] <= 1.5, d  # off-mode must stay within noise
+    # tracing ON costs more than OFF (the bench actually traced), and the
+    # absolute off-mode residue stays in single-digit µs per frame
+    assert d["trace_on_us_per_frame"] >= d["trace_off_us_per_frame"], d
+    assert d["off_overhead_us_per_frame"] < 25.0, d
+    # banked: the same entry landed in the log
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "trace_off_overhead_ratio"
+
+
 def test_unet_cache_prefix_validated():
     """advisor r3: 'foo:3' must not parse as a valid UNET_CACHE spelling."""
     import pytest

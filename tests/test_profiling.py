@@ -1,0 +1,186 @@
+"""Direct unit coverage for utils/profiling.py ``FrameStats`` (ISSUE 5).
+
+The class is load-bearing for ``GET /metrics`` (server/agent.py), the
+PR 2 host-plane stage gauges (``stage_snapshot_us``) and the overload
+counters, but until now was only exercised incidentally through the
+server tests.  These tests pin the observable contract directly:
+empty-window snapshots, deque wraparound at ``window``, ``record_stage``
+percentile math, counter/gauge semantics, and a thread-safety smoke.
+"""
+
+import threading
+
+from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+
+# -- empty-window behavior ----------------------------------------------------
+
+def test_empty_snapshot_has_null_latencies_and_zero_fps():
+    s = FrameStats().snapshot()
+    assert s["frames_total"] == 0
+    assert s["fps"] == 0.0
+    assert s["latency_p50_ms"] is None
+    assert s["latency_p90_ms"] is None
+    assert s["latency_max_ms"] is None
+
+
+def test_single_sample_no_fps_but_latency_present():
+    st = FrameStats()
+    st.record(0.050, t=100.0)
+    s = st.snapshot()
+    # fps needs >=2 timestamps spanning nonzero time
+    assert s["fps"] == 0.0
+    assert s["latency_p50_ms"] == 50.0
+    assert s["latency_max_ms"] == 50.0
+    assert s["frames_total"] == 1
+
+
+def test_identical_timestamps_do_not_divide_by_zero():
+    st = FrameStats()
+    st.record(0.010, t=5.0)
+    st.record(0.010, t=5.0)
+    assert st.snapshot()["fps"] == 0.0
+
+
+def test_empty_stage_snapshot_us_is_empty():
+    assert FrameStats().stage_snapshot_us() == {}
+
+
+# -- fps + wraparound ---------------------------------------------------------
+
+def test_fps_over_explicit_timestamps():
+    st = FrameStats()
+    for i in range(31):  # 31 samples, 1 s apart -> 30 intervals / 30 s
+        st.record(0.001, t=float(i))
+    assert st.snapshot()["fps"] == 30 / 30.0
+
+
+def test_window_wraparound_drops_oldest_but_total_is_monotonic():
+    st = FrameStats(window=4)
+    for i in range(10):
+        # latencies 0..9 ms; timestamps 1 s apart
+        st.record(i / 1e3, t=float(i))
+    s = st.snapshot()
+    # frames_total counts every record, the window only bounds percentiles
+    assert s["frames_total"] == 10
+    # only the last 4 samples (6..9 ms) remain: max is 9, p50 sits mid-window
+    assert s["latency_max_ms"] == 9.0
+    assert s["latency_p50_ms"] == 8.0  # sorted [6,7,8,9][4//2]
+    # fps window follows the same 4 samples: 3 intervals over 3 s
+    assert s["fps"] == 1.0
+
+
+def test_record_stage_wraps_at_window_too():
+    st = FrameStats(window=3)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        st.record_stage("encode", v)
+    s = st.snapshot()
+    # deque holds [2,3,4]: p50 = sorted[1] = 3
+    assert s["encode_p50_ms"] == 3000.0
+    assert s["encode_p90_ms"] == 4000.0
+
+
+# -- record_stage percentile math --------------------------------------------
+
+def test_stage_percentiles_ms_and_us_agree():
+    st = FrameStats()
+    for i in range(1, 101):  # 1..100 µs
+        st.record_stage("packetize", i / 1e6)
+    s = st.snapshot()
+    u = st.stage_snapshot_us()
+    # p50 = sorted[100//2] = 51st value = 51 µs
+    assert u["packetize_p50_us"] == 51.0
+    assert u["packetize_p90_us"] == 91.0
+    assert u["packetize_p99_us"] == 100.0
+    assert u["packetize_count"] == 100
+    assert abs(s["packetize_p50_ms"] - 0.051) < 1e-9
+    assert abs(s["packetize_p90_ms"] - 0.091) < 1e-9
+
+
+def test_stage_snapshot_us_filters_and_carries_counters():
+    st = FrameStats()
+    st.record_stage("send", 10 / 1e6)
+    st.record_stage("infer", 5 / 1e3)
+    st.count("tx_packets", 7)
+    u = st.stage_snapshot_us(stages=("send",))
+    assert "send_p50_us" in u
+    assert "infer_p50_us" not in u  # filtered out
+    assert u["tx_packets_total"] == 7  # counters always ride along
+
+
+def test_stages_are_independent_deques():
+    st = FrameStats(window=2)
+    st.record_stage("decode", 0.001)
+    st.record_stage("encode", 0.002)
+    st.record_stage("encode", 0.003)
+    st.record_stage("encode", 0.004)  # encode wraps; decode must not
+    s = st.snapshot()
+    assert s["decode_p50_ms"] == 1.0
+    assert s["encode_p50_ms"] == 4.0  # sorted [3,4][2//2]
+
+
+# -- counters + gauges --------------------------------------------------------
+
+def test_counts_accumulate_and_land_as_total():
+    st = FrameStats()
+    st.count("srtp_drops")
+    st.count("srtp_drops", 2)
+    assert st.snapshot()["srtp_drops_total"] == 3
+
+
+def test_gauge_is_last_value_wins():
+    st = FrameStats()
+    st.gauge("rr_jitter_ms", 4.0)
+    st.gauge("rr_jitter_ms", 2.5)
+    assert st.snapshot()["rr_jitter_ms"] == 2.5
+
+
+def test_timed_context_manager_records_one_sample():
+    st = FrameStats()
+    with st.timed():
+        pass
+    s = st.snapshot()
+    assert s["frames_total"] == 1
+    assert s["latency_max_ms"] is not None and s["latency_max_ms"] >= 0.0
+
+
+# -- thread-safety smoke ------------------------------------------------------
+
+def test_concurrent_mixed_recording_is_consistent():
+    """4 writer threads hammer every mutating entry point while a reader
+    snapshots concurrently; no exception, and the monotonic totals come
+    out exact (the deques themselves are bounded, so only the counters
+    can prove nothing was lost)."""
+    st = FrameStats(window=64)
+    n_threads, per_thread = 4, 500
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(per_thread):
+                st.record(0.001 * (i % 7), t=float(i))
+                st.record_stage("encode", 0.001)
+                st.count("events")
+                st.gauge("g", i)
+        except Exception as e:  # pragma: no cover - failure diagnostics
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                st.snapshot()
+                st.stage_snapshot_us()
+        except Exception as e:  # pragma: no cover - failure diagnostics
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(n_threads)
+    ] + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    s = st.snapshot()
+    assert s["frames_total"] == n_threads * per_thread
+    assert s["events_total"] == n_threads * per_thread

@@ -114,6 +114,56 @@ def test_bounded_queue_catches_unbounded_and_respects_bounds():
     assert not any("ok" in s for s in flagged)
 
 
+def test_span_pairing_catches_unbalanced_and_respects_closures():
+    """ISSUE 5 satellite: every ``trace.begin`` must reach a matching
+    ``end`` on all paths (obs/trace.py timelines stay well-formed) —
+    early returns, raises, one-branch begins, fall-throughs and
+    wrong-name ends all flagged; try/finally, ``with trace.span``,
+    branch-complete closes and bare-end stacks stay clean."""
+    fs = run_on(["span_pairing_bad.py"], ("span-pairing",))
+    scopes = {f.scope for f in fs}
+    assert "bad_early_return" in scopes
+    assert "bad_branch_only_begin" in scopes
+    assert "bad_raise_path" in scopes
+    assert "bad_never_closed" in scopes
+    assert "bad_unbalanced_end" in scopes
+    assert "bad_wrong_name" in scopes
+    # an exception between begin and end reaches the handler with the
+    # span OPEN — the handler-return path must be flagged
+    assert "bad_handler_swallow" in scopes
+    # `with trace.begin(...)` crashes at runtime (begin() returns None):
+    # flagged, never blessed as a pairing
+    assert "bad_with_begin" in scopes
+    # precision: every flagged scope is a bad_* function — the ok_*
+    # spellings (try/finally, context manager, both-branches close,
+    # nested bare ends, non-trace receivers) must stay clean
+    assert all(s.startswith("bad_") for s in scopes), scopes
+    msgs = " | ".join(f.message for f in fs)
+    assert "still open" in msgs  # the open-at-exit family
+    assert "no span open" in msgs  # the unbalanced-end family
+    assert "not open on this path" in msgs  # the wrong-name family
+
+
+def test_span_pairing_flags_state_overflow_instead_of_dropping_paths(tmp_path):
+    """>64 reachable open-span states: silently truncating path states
+    would let a leaking path past the cap scan clean — the checker must
+    flag the function as unprovable instead."""
+    root = tmp_path
+    (root / "ai_rtc_agent_tpu").mkdir()
+    # 7 independent conditional begins -> 2^7 = 128 path states
+    body = ["def f(trace, flags):"]
+    for i in range(7):
+        body += [f"    if flags[{i}]:", f"        trace.begin('s{i}')"]
+    body += ["    return None"]
+    (root / "ai_rtc_agent_tpu" / "deep.py").write_text("\n".join(body) + "\n")
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("span-pairing",))
+    assert any(f.name == "<state-overflow>" for f in fs), [
+        f.render() for f in fs
+    ]
+
+
 def test_bounded_queue_exempts_operator_tooling(tmp_path):
     """scripts/, examples/ and bench.py are process-lifecycle tooling, not
     the serving frame path — same carve-out as env-registry raw reads."""
