@@ -10,6 +10,8 @@ fix).  This package encodes the rules as checkers over stdlib ``ast``
 (no new dependencies):
 
   async-blocking     blocking calls lexically inside ``async def``
+  encoder-reconfig   encoder bitrate/GOP mutations outside the single
+                     reconfigure() path (media/codec.py owns tr_h264_*)
   pooled-view        pool-returned memoryviews escaping frame scope
   span-pairing       trace.begin() without a matching end on some path
                      (obs/trace.py frame timelines must stay well-formed)

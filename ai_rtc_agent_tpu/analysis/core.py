@@ -214,6 +214,7 @@ def run_checkers(project: Project, checkers=None) -> list:
     from . import (
         async_blocking,
         bounded_queues,
+        encoder_reconfig,
         env_registry,
         metrics_registry,
         pooled_views,
@@ -225,6 +226,7 @@ def run_checkers(project: Project, checkers=None) -> list:
     registry = {
         "async-blocking": async_blocking.check,
         "bounded-queue": bounded_queues.check,
+        "encoder-reconfig": encoder_reconfig.check,
         "pooled-view": pooled_views.check,
         "span-pairing": span_pairing.check,
         "trace-purity": trace_purity.check,
@@ -245,6 +247,7 @@ def run_checkers(project: Project, checkers=None) -> list:
 ALL_CHECKERS = (
     "async-blocking",
     "bounded-queue",
+    "encoder-reconfig",
     "pooled-view",
     "span-pairing",
     "trace-purity",

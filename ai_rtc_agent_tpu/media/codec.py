@@ -76,31 +76,89 @@ class H264Encoder:
                 "floor-only bound as advisory (minrate applies under "
                 "CBR/nal-hrd); set ENC_MAX_BITRATE to enforce a band"
             )
-        if (min_rate or max_rate) and hasattr(lib, "tr_h264_encoder_create_rc"):
-            self._enc = lib.tr_h264_encoder_create_rc(
-                width, height, fps, 1, bitrate, min_rate, max_rate, gop,
-                preset.encode(), tune.encode()
-            )
-        else:
-            if min_rate or max_rate:
-                # a stale committed .so predating the rc export: an operator
-                # who set a bandwidth cap must not silently run uncapped
-                logger.warning(
-                    "ENC_MIN/MAX_BITRATE set but the loaded native library "
-                    "lacks tr_h264_encoder_create_rc — bounds NOT enforced "
-                    "(rebuild native/)"
-                )
-            self._enc = lib.tr_h264_encoder_create(
-                width, height, fps, 1, bitrate, gop, preset.encode(),
-                tune.encode()
-            )
+        # rate/cadence params are kept so reconfigure() can rebuild the
+        # encoder with only the changed values
+        self._fps = fps
+        self._bitrate = bitrate
+        self._gop = gop
+        self._preset = preset
+        self._tune = tune
+        self._min_rate = min_rate
+        self._max_rate = max_rate
+        self._pending = False  # reconfigure awaiting its rebuild-on-IDR
+        self.width, self.height = width, height
+        self._enc = self._create()
         if not self._enc:
             raise RuntimeError("failed to open H.264 encoder")
-        self.width, self.height = width, height
         self._buf = np.empty(width * height * 3 + (1 << 16), np.uint8)
+
+    def _create(self):
+        lib = self._lib
+        if (self._min_rate or self._max_rate) and hasattr(
+            lib, "tr_h264_encoder_create_rc"
+        ):
+            return lib.tr_h264_encoder_create_rc(
+                self.width, self.height, self._fps, 1, self._bitrate,
+                self._min_rate, self._max_rate, self._gop,
+                self._preset.encode(), self._tune.encode()
+            )
+        if self._min_rate or self._max_rate:
+            # a stale committed .so predating the rc export: an operator
+            # who set a bandwidth cap must not silently run uncapped
+            logger.warning(
+                "ENC_MIN/MAX_BITRATE set but the loaded native library "
+                "lacks tr_h264_encoder_create_rc — bounds NOT enforced "
+                "(rebuild native/)"
+            )
+        return lib.tr_h264_encoder_create(
+            self.width, self.height, self._fps, 1, self._bitrate, self._gop,
+            self._preset.encode(), self._tune.encode()
+        )
+
+    def reconfigure(
+        self,
+        *,
+        bitrate: int | None = None,
+        gop: int | None = None,
+        fps: int | None = None,
+    ) -> bool:
+        """Update rate-control / cadence targets — the ONE blessed mutation
+        path for encoder bitrate and GOP (the ``encoder-reconfig`` static
+        checker makes any direct native rate call outside this module a
+        finding).  Applied in place when the native lib exports
+        ``tr_h264_encoder_reconfigure``; otherwise the change is recorded
+        and the encoder rebuilds at the next encode boundary — the rebuilt
+        stream opens with a fresh IDR + in-band SPS, so receivers re-sync
+        onto the new parameters within one frame (rebuild-on-next-IDR).
+        Returns True when applied immediately, False while pending."""
+        changed = False
+        for name, val in (("_bitrate", bitrate), ("_gop", gop), ("_fps", fps)):
+            if val is not None and int(val) != getattr(self, name):
+                setattr(self, name, max(1, int(val)))
+                changed = True
+        if not changed:
+            return True
+        if self._enc and hasattr(self._lib, "tr_h264_encoder_reconfigure"):
+            self._lib.tr_h264_encoder_reconfigure(
+                self._enc, self._bitrate, self._gop, self._fps
+            )
+            return True
+        self._pending = True
+        return False
+
+    def _apply_pending(self):
+        if not self._pending or not self._enc:
+            return
+        self._pending = False
+        self._lib.tr_h264_encoder_destroy(self._enc)
+        self._enc = self._create()
+        if not self._enc:
+            raise RuntimeError("failed to reopen H.264 encoder after reconfigure")
 
     def encode(self, rgb: np.ndarray, pts: int = -1) -> bytes:
         """[H,W,3] uint8 -> annex-B bytes ('' while the encoder buffers)."""
+        if self._pending:
+            self._apply_pending()
         rgb = np.ascontiguousarray(rgb, dtype=np.uint8)
         key = ctypes.c_int(0)
         n = self._lib.tr_h264_encode(

@@ -116,6 +116,12 @@ def _declare(lib: ctypes.CDLL):
     lib.tr_h264_encoder_destroy.argtypes = [c.c_void_p]
     if hasattr(lib, "tr_h264_force_keyframe"):  # absent in pre-r3 builds
         lib.tr_h264_force_keyframe.argtypes = [c.c_void_p]
+    if hasattr(lib, "tr_h264_encoder_reconfigure"):
+        # in-place rate control (absent in committed pre-r6 builds: codec.py
+        # falls back to rebuild-on-next-IDR when this export is missing)
+        lib.tr_h264_encoder_reconfigure.argtypes = [
+            c.c_void_p, c.c_int64, c.c_int, c.c_int,
+        ]
     lib.tr_h264_decoder_create.restype = c.c_void_p
     lib.tr_h264_decode.restype = c.c_int64
     lib.tr_h264_decode.argtypes = [

@@ -333,6 +333,44 @@ class H264Sink:
             else 0.0
         )
         self.shed_stale = 0  # frames dropped at this hop (monotonic)
+        # network-adaptation actuation state (resilience/netadapt.py):
+        # encode-side decimation divisor, and the last-applied encoder
+        # profile — recorded even on the NullCodec tier so quality rungs
+        # are observable/testable without libavcodec
+        self._scale = 1
+        self.profile: dict = {
+            "bitrate": None, "gop": None, "fps": fps, "scale": 1,
+        }
+
+    def reconfigure(
+        self,
+        *,
+        bitrate: int | None = None,
+        gop: int | None = None,
+        fps: int | None = None,
+        scale: int | None = None,
+    ) -> None:
+        """Runtime encoder profile change — the session-level entry of the
+        ONE blessed encoder mutation path (H264Encoder.reconfigure).  Used
+        by the network-adaptation ladder and the runtime /config surface.
+        ``scale``: encode-side decimation divisor (>=1); the encoder
+        restarts at the reduced geometry through the existing
+        geometry-change path in consume().  Safe from any thread — the
+        lock serializes against consume()'s encoder use."""
+        with self._enc_lock:
+            for key, val in (
+                ("bitrate", bitrate), ("gop", gop), ("fps", fps),
+            ):
+                if val is not None:
+                    self.profile[key] = int(val)
+            if scale is not None:
+                self._scale = max(1, int(scale))
+                self.profile["scale"] = self._scale
+            if fps is not None:
+                self._fps = max(1, int(fps))
+                self._pts_step = CLOCK_RATE // self._fps
+            if self._enc is not None:
+                self._enc.reconfigure(bitrate=bitrate, gop=gop, fps=fps)
 
     def consume(self, frame) -> list[bytes]:
         """frame: VideoFrame or [H,W,3] uint8 -> list of RTP packets
@@ -363,6 +401,16 @@ class H264Sink:
         with self._enc_lock:
             if self.use_h264 and self._enc is None:
                 return []  # sink closed while a frame sat on the worker
+            if self._scale > 1:
+                # reduce-resolution rung: cheap decimation before encode —
+                # the geometry-change branch below restarts the encoder at
+                # the smaller size (new SPS; decoders re-sync on it).
+                # Crop to EVEN dims: yuv420 encoders reject odd geometry,
+                # and the degradation rung must never kill the send path
+                arr = arr[:: self._scale, :: self._scale]
+                h2 = arr.shape[0] & ~1 or arr.shape[0]
+                w2 = arr.shape[1] & ~1 or arr.shape[1]
+                arr = np.ascontiguousarray(arr[:h2, :w2])
             if self.use_h264 and arr.shape[:2] != self._wh:
                 # the pipeline's output geometry is the model's, which a
                 # real-SDP answer cannot know up front — restart the encoder
@@ -374,7 +422,17 @@ class H264Sink:
                 )
                 self._enc.close()
                 self._wh = (arr.shape[0], arr.shape[1])
-                self._enc = H264Encoder(arr.shape[1], arr.shape[0], self._fps)
+                # build ONCE with the session's LIVE profile: a geometry
+                # restart must not revert a runtime reconfigure to
+                # compile-time defaults (the restart-defaults bug class),
+                # and reconfigure-after-build would throw the fresh
+                # encoder away on libs without in-place rate control
+                # tpurtc: allow[encoder-reconfig] -- geometry restart re-applies this sink's live reconfigure() profile; rate targets still have one owner
+                self._enc = H264Encoder(
+                    arr.shape[1], arr.shape[0], self._fps,
+                    bitrate=self.profile["bitrate"],
+                    gop=self.profile["gop"] or 60,
+                )
             if self.use_h264:
                 au = self._enc.encode(arr, pts=int(pts))
             else:

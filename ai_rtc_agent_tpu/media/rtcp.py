@@ -26,6 +26,8 @@ import struct
 import time
 from collections import OrderedDict
 
+from ..utils import env
+
 PT_SR = 200
 PT_RR = 201
 PT_SDES = 202
@@ -33,6 +35,17 @@ PT_RTPFB = 205  # transport-layer feedback (NACK is FMT 1)
 PT_PSFB = 206  # payload-specific feedback (PLI is FMT 1)
 
 NTP_EPOCH_OFFSET = 2208988800  # 1900 -> 1970
+
+
+def report_interval_s() -> float:
+    """SR/RR emission cadence for the native tier's report loop
+    (rtc_native._sr_loop).  RFC 3550 suggests ~5 s for low-rate sessions;
+    interactive video wants faster loss feedback — and the network
+    adaptation ladder (resilience/netadapt.py) can react no faster than
+    reports arrive, so the cadence is an operator knob
+    (``RTCP_REPORT_INTERVAL_S``).  Floored at 200 ms so a typo cannot turn
+    the report loop into a packet storm."""
+    return max(0.2, env.get_float("RTCP_REPORT_INTERVAL_S", 2.0))
 
 
 def is_rtcp(data: bytes) -> bool:

@@ -25,6 +25,8 @@ Plan JSON shape::
         {"target": "rx", "kind": "reorder", "p": 0.1},
         {"target": "rx", "kind": "delay", "p": 0.2, "delay_s": 0.05},
         {"target": "rx", "kind": "truncate", "p": 0.01, "keep": 8},
+        {"target": "rx", "kind": "loss_burst", "period": 20, "burst": 10,
+         "start": 100, "stop": 500},
         {"target": "engine", "kind": "slow_step", "start": 50, "stop": 55,
          "delay_s": 3.0},
         {"target": "engine", "kind": "nan", "start": 60, "stop": 62},
@@ -47,7 +49,7 @@ from dataclasses import dataclass
 
 logger = logging.getLogger(__name__)
 
-NET_KINDS = ("drop", "dup", "reorder", "delay", "truncate")
+NET_KINDS = ("drop", "dup", "reorder", "delay", "truncate", "loss_burst")
 ENGINE_KINDS = ("slow_step", "nan", "device_lost")
 TARGETS = ("rx", "tx", "engine")
 
@@ -65,6 +67,8 @@ class FaultSpec:
     stop: int | None = None  # exclusive; None = unbounded
     delay_s: float = 0.05  # for delay / slow_step
     keep: int = 8  # for truncate: bytes kept
+    period: int = 10  # for loss_burst: packets per on/off duty cycle
+    burst: int = 5  # for loss_burst: packets DROPPED at each cycle start
 
     def __post_init__(self):
         if self.target not in TARGETS:
@@ -77,9 +81,25 @@ class FaultSpec:
             )
         if not 0.0 <= self.p <= 1.0:
             raise ValueError(f"fault p={self.p} outside [0, 1]")
+        if self.kind == "loss_burst":
+            if self.period < 1:
+                raise ValueError(f"loss_burst period={self.period} must be >= 1")
+            if not 0 <= self.burst <= self.period:
+                raise ValueError(
+                    f"loss_burst burst={self.burst} outside [0, period="
+                    f"{self.period}]"
+                )
 
     def in_window(self, index: int) -> bool:
         return index >= self.start and (self.stop is None or index < self.stop)
+
+    def in_burst_phase(self, index: int) -> bool:
+        """loss_burst duty cycle: the first ``burst`` of every ``period``
+        packets (counted from the window start) drop.  Pure index
+        arithmetic — a sustained-loss episode replays packet-for-packet
+        with no per-packet probability to tune, which is what lets tier-1
+        script the network ladder's hysteresis deterministically."""
+        return (index - self.start) % self.period < self.burst
 
 
 @dataclass(frozen=True)
@@ -171,6 +191,14 @@ class NetFaultScope:
         for s in self.specs:
             if not s.in_window(i) or self.rng.random() >= s.p:
                 continue
+            if s.kind == "loss_burst":
+                # deterministic on/off duty cycle (the p gate above still
+                # applies; default p=1.0 keeps it purely index-driven)
+                if not s.in_burst_phase(i):
+                    continue
+                self.stats[s.kind] += 1
+                out = []
+                break
             self.stats[s.kind] += 1
             if s.kind == "drop":
                 out = []

@@ -123,6 +123,14 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
         # per frame; sustained box-wide pressure walks this session down
         # the shedding ladder and back up on recovery
         wrapped.throttle = ov.register_session(session_key, sup)
+        # network ladder (resilience/netadapt.py): RTCP loss telemetry
+        # walks a quality rung joined to the compute ladder above —
+        # registered after it so the skip-floor join binds; providers
+        # without an RTCP plane (loopback/aiortc) just never feed it
+        na = ov.register_netadapt(session_key)
+        attach = getattr(pc, "attach_netadapt", None)
+        if na is not None and attach is not None:
+            attach(na)
     app.setdefault("supervisors", {})[session_key] = sup
     sup.start_watchdog()
     return wrapped
@@ -210,7 +218,18 @@ def patch_loop_datagram(local_ports: List[int]):
 # and POST /config — reference agent.py:154-168, 324-337, 398-412)
 # ---------------------------------------------------------------------------
 
-def apply_runtime_config(pipeline, config: dict):
+def _encoder_surface(provider):
+    """The provider's runtime encoder-config surface (validate + apply),
+    or None when it has none (loopback/aiortc tiers)."""
+    if provider is not None and hasattr(provider, "apply_encoder_config"):
+        return provider
+    return None
+
+
+def apply_runtime_config(pipeline, config: dict, encoders=None):
+    """``encoders``: an object with ``validate_encoder_config`` /
+    ``apply_encoder_config`` (NativeRtpProvider), or None when this
+    surface has no encoder plane."""
     if not isinstance(config, dict):
         raise ValueError("config must be a JSON object")
     guidance_scale = config.get("guidance_scale")
@@ -226,6 +245,17 @@ def apply_runtime_config(pipeline, config: dict):
             )
         guidance_scale = None if guidance_scale is None else float(guidance_scale)
         delta = None if delta is None else float(delta)
+    # encoder bitrate/GOP reconfigure (ISSUE 6): rides the same runtime
+    # config surface, applied through the provider's single blessed path
+    # (NativeRtpProvider.apply_encoder_config -> H264Sink.reconfigure) —
+    # same contract: validated here, applied only after every other check
+    encoder = config.get("encoder")
+    if encoder is not None:
+        if encoders is None:
+            raise ValueError(
+                "encoder reconfigure not supported by this provider"
+            )
+        encoder = encoders.validate_encoder_config(encoder)  # BEFORE mutation
     t_index_list = config.get("t_index_list")
     if t_index_list is not None:
         pipeline.update_t_index_list(t_index_list)
@@ -234,9 +264,11 @@ def apply_runtime_config(pipeline, config: dict):
         pipeline.update_prompt(prompt)
     if guidance_scale is not None or delta is not None:
         update_guidance(guidance_scale=guidance_scale, delta=delta)
+    if encoder is not None:
+        encoders.apply_encoder_config(encoder)
 
 
-def _wire_datachannel(pipeline, channel, guard=None):
+def _wire_datachannel(pipeline, channel, guard=None, encoders=None):
     @channel.on("message")
     async def on_message(message):
         if guard is not None and not guard():
@@ -244,7 +276,9 @@ def _wire_datachannel(pipeline, channel, guard=None):
         logger.info("received config: %s", message)
         try:
             # prompt updates run a text-encoder forward — never on the loop
-            await asyncio.to_thread(apply_runtime_config, pipeline, json.loads(message))
+            await asyncio.to_thread(
+                apply_runtime_config, pipeline, json.loads(message), encoders
+            )
         except (ValueError, KeyError, TypeError) as e:
             # TypeError: structurally-wrong JSON from a hostile/buggy client
             # (e.g. t_index_list [18, null]) must not escape the handler
@@ -365,7 +399,8 @@ async def offer(request):
         @pc.on("datachannel")
         def on_datachannel(channel):
             _wire_datachannel(
-                pipeline, channel, guard=lambda: tracks["video"] is not None
+                pipeline, channel, guard=lambda: tracks["video"] is not None,
+                encoders=_encoder_surface(provider),
             )
 
         @pc.on("track")
@@ -621,7 +656,9 @@ async def whip(request):
 
         @pc.on("datachannel")
         def on_datachannel(channel):
-            _wire_datachannel(pipeline, channel)
+            _wire_datachannel(
+                pipeline, channel, encoders=_encoder_surface(provider)
+            )
 
         @pc.on("iceconnectionstatechange")
         async def on_iceconnectionstatechange():
@@ -705,8 +742,9 @@ async def update_config(request):
         return web.Response(status=400, text="invalid JSON body")
     logger.info("received config: %s", config)
     target = request.app.get("multipeer_pipeline") or request.app["pipeline"]
+    encoders = _encoder_surface(request.app.get("provider"))
     try:
-        await asyncio.to_thread(apply_runtime_config, target, config)
+        await asyncio.to_thread(apply_runtime_config, target, config, encoders)
     except (ValueError, TypeError, KeyError) as e:
         # TypeError/KeyError: structurally-wrong JSON (t_index_list with
         # nulls, config that is not an object) is a client error, not a 500
@@ -733,6 +771,10 @@ async def health_detail(request):
         for k, ladder in ov.ladders.items():
             if k in sessions:
                 sessions[k]["overload_rung"] = ladder.rung
+                sessions[k]["effective_rung"] = ladder.effective_rung
+        for k, na in ov.netadapt.items():
+            if k in sessions:
+                sessions[k]["netadapt"] = na.snapshot()
     body = {
         "status": worst_state(s["state"] for s in sessions.values()),
         "sessions": sessions,

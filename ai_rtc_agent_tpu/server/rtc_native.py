@@ -85,6 +85,9 @@ class _RtcpState:
         self.ssrc = ssrc
         self.cache = rtcp_mod.RetransmissionCache()
         self.recv = rtcp_mod.ReceiverStats()
+        # network-adaptation ladder (resilience/netadapt.py): fed the
+        # peer's report blocks about OUR stream + local NACK/PLI feedback
+        self.netadapt = None
         self.packet_count = 0
         self.octet_count = 0
         self.last_rtp_ts = 0
@@ -169,11 +172,15 @@ class _RtcpState:
                 m = item.get("media_ssrc")
                 if m == self.ssrc or (allow_wildcard_pli and not m):
                     force_idr = True
+                    if self.netadapt is not None:
+                        self.netadapt.on_tx_feedback(plis=1)
             elif item["type"] == "nack":
                 if item.get("media_ssrc") != self.ssrc:
                     continue
                 if self.stats is not None:
                     self.stats.count("rtcp_nacks")
+                if self.netadapt is not None:
+                    self.netadapt.on_tx_feedback(nacks=len(item["seqs"]))
                 for seq in item["seqs"]:
                     wire = self.cache.get(seq)
                     if wire is not None and self._rtx_allowed():
@@ -184,14 +191,28 @@ class _RtcpState:
                         # aged out of the cache: a keyframe is the only
                         # recovery that still helps
                         force_idr = True
-            elif item["type"] == "rr":
-                blks = [
-                    b for b in item["blocks"] if b["ssrc"] == self.ssrc
-                ]
-                if blks and self.stats is not None:
+            elif item["type"] in ("rr", "sr"):
+                # reception report blocks ride RRs AND (from bidirectional
+                # peers, RFC 3550 s6.4.1) SRs; select the block about OUR
+                # stream — a multi-block compound from a multi-stream peer
+                # must not gauge a stranger's loss, and an absent block
+                # must not gauge at all (regression: tests/test_rtcp.py)
+                blk = next(
+                    (
+                        b
+                        for b in item.get("blocks", ())
+                        if b["ssrc"] == self.ssrc
+                    ),
+                    None,
+                )
+                if blk is None:
+                    continue
+                if self.stats is not None:
                     self.stats.count("rtcp_rrs")
-                    self.stats.gauge("rr_fraction_lost", blks[0]["fraction_lost"])
-                    self.stats.gauge("rr_jitter", blks[0]["jitter"])
+                    self.stats.gauge("rr_fraction_lost", blk["fraction_lost"])
+                    self.stats.gauge("rr_jitter", blk["jitter"])
+                if self.netadapt is not None:
+                    self.netadapt.on_receiver_report(blk)
         if force_idr:
             now = time.monotonic()
             if now - self._last_idr < self.IDR_MIN_INTERVAL_S:
@@ -522,7 +543,11 @@ class NativeRtpPeerConnection:
         self.plane_stats = FrameStats()
         self._batch_tx = env_util.get_bool("HOST_PLANE_BATCH", True)
         self._plain_flush = sockio.CoalescedFlush()
-        provider.register_plane_session(self.pc_id, self.plane_stats)
+        # network adaptation (resilience/netadapt.py): attached by the
+        # agent's session wiring; None = no quality ladder on this session
+        self.netadapt = None
+        self.kf_governor = None
+        provider.register_plane_session(self.pc_id, self.plane_stats, pc=self)
 
     # -- events --------------------------------------------------------------
 
@@ -771,8 +796,58 @@ class NativeRtpPeerConnection:
         except asyncio.CancelledError:
             pass
 
+    def attach_netadapt(self, ladder):
+        """Join this session to its network-adaptation ladder
+        (resilience/netadapt.py): RR blocks about our stream and NACK/PLI
+        feedback flow in; rung moves actuate out through the sink's
+        reconfigure() and the keyframe governor."""
+        if ladder is None:
+            return
+        from ..resilience.netadapt import KeyframeGovernor
+
+        self.netadapt = ladder
+        self._rtcp_state.netadapt = ladder
+        self.kf_governor = KeyframeGovernor(coalesce_s=ladder.pli_coalesce_s)
+        ladder.apply = self._apply_net_profile
+        self._apply_net_profile(ladder.profile())
+
+    def _apply_net_profile(self, profile: dict):
+        """One network-rung actuation: encoder bitrate/scale through the
+        blessed reconfigure() path, keyframe cadence into the governor.
+        Governor knobs are plain attribute writes (lock-free); the sink
+        call takes ``_enc_lock``, which a worker thread can hold across a
+        full encode (or an encoder rebuild) — so when this fires on the
+        event loop (the control plane's tick task), the sink actuation is
+        pushed to a worker instead of stalling every session's loop."""
+        gov = self.kf_governor
+        if gov is not None:
+            gov.coalesce_s = profile["pli_coalesce_s"]
+            gov.interval_s = profile["keyframe_interval_s"]
+        sink = self._sink
+        if sink is None:
+            return
+
+        def actuate():
+            try:
+                sink.reconfigure(
+                    bitrate=profile["bitrate"], scale=profile["scale"]
+                )
+            except Exception:
+                logger.exception("netadapt sink actuation failed")
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            actuate()  # already off the loop (POST /config to_thread path)
+            return
+        loop.run_in_executor(None, actuate)
+
     def _force_sink_keyframe(self):
-        """RTCP-PLI handler: the viewer dropped a frame — next encode is IDR."""
+        """RTCP-PLI handler: the viewer dropped a frame — next encode is
+        IDR.  Under network adaptation the keyframe governor coalesces
+        storms: N PLIs inside one window cost ONE IDR."""
+        if self.kf_governor is not None and not self.kf_governor.request():
+            return
         if self._sink is not None:
             self._sink.force_keyframe()
 
@@ -808,6 +883,10 @@ class NativeRtpPeerConnection:
             payload_type=self._h264_pt or 96, ssrc=OUT_SSRC,
             plane_stats=self.plane_stats,
         )
+        if self.netadapt is not None:
+            # the ladder may have moved before the sink existed (attach
+            # races setLocalDescription) — actuate the current rung now
+            self._apply_net_profile(self.netadapt.profile())
         for track in self.out_tracks:
             self._sender_tasks.append(
                 asyncio.ensure_future(self._pump(track, self._sink))
@@ -821,7 +900,7 @@ class NativeRtpPeerConnection:
     async def _sr_loop(self):
         while self.connectionState != "closed":
             try:
-                await asyncio.sleep(2.0)
+                await asyncio.sleep(rtcp_mod.report_interval_s())
                 report = self._rtcp_state.make_report()
                 if report is None:
                     continue
@@ -860,6 +939,11 @@ class NativeRtpPeerConnection:
         try:
             while self.connectionState != "closed":
                 frame = await track.recv()
+                gov = self.kf_governor
+                if gov is not None and gov.periodic_due():
+                    # loss-driven re-sync cadence (netadapt): scheduled
+                    # IDRs replace per-PLI reaction under sustained loss
+                    sink.force_keyframe()
                 pkts = await asyncio.to_thread(sink.consume, frame)
                 trace = get_trace(frame)
                 if not pkts:
@@ -972,12 +1056,73 @@ class NativeRtpProvider:
         # packetize/protect/send/recv µs histograms behind /metrics'
         # host_plane_sessions block
         self._plane_sessions: dict = {}
+        # pc_id -> live peer connection: the runtime encoder-config surface
+        # (/config {"encoder": ...}) fans out over these
+        self._live_pcs: dict = {}
 
-    def register_plane_session(self, pc_id: str, stats: FrameStats) -> None:
+    def register_plane_session(
+        self, pc_id: str, stats: FrameStats, pc=None
+    ) -> None:
         self._plane_sessions[pc_id] = stats
+        if pc is not None:
+            self._live_pcs[pc_id] = pc
 
     def unregister_plane_session(self, pc_id: str) -> None:
         self._plane_sessions.pop(pc_id, None)
+        self._live_pcs.pop(pc_id, None)
+
+    ENCODER_CONFIG_KEYS = ("bitrate", "gop", "fps", "scale")
+
+    def validate_encoder_config(self, cfg) -> dict:
+        """Reject a malformed encoder config BEFORE any sink mutates —
+        /config's contract is that a 400 means nothing was applied."""
+        if not isinstance(cfg, dict) or not cfg:
+            raise ValueError("encoder config must be a non-empty JSON object")
+        out = {}
+        for key, val in cfg.items():
+            if key not in self.ENCODER_CONFIG_KEYS:
+                raise ValueError(
+                    f"unknown encoder config key {key!r} "
+                    f"(expected one of {self.ENCODER_CONFIG_KEYS})"
+                )
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                raise ValueError(f"encoder {key} must be a number")
+            val = int(val)
+            if val <= 0:
+                raise ValueError(f"encoder {key} must be positive")
+            out[key] = val
+        return out
+
+    def apply_encoder_config(self, cfg: dict) -> int:
+        """Runtime encoder reconfigure (POST /config ``{"encoder": {...}}``):
+        validate, then fan out to every live session's sink through the ONE
+        blessed mutation path (H264Sink.reconfigure → H264Encoder.
+        reconfigure).  -> number of sinks updated (0 = no live senders)."""
+        cfg = self.validate_encoder_config(cfg)
+        n = 0
+        for pc in list(self._live_pcs.values()):
+            na = getattr(pc, "netadapt", None)
+            sink = getattr(pc, "_sink", None)
+            if na is not None:
+                # ladder-joined session: the operator's bitrate becomes the
+                # ladder's BASE and actuation flows through the CURRENT
+                # rung's profile — a session holding at reduce_resolution
+                # must not have full rate/scale pushed onto its congested
+                # link by an operator update (the rung scales the new base
+                # instead; recovery returns to it).  gop/fps are not
+                # rung-owned and apply directly.
+                if "bitrate" in cfg:
+                    na.base_bitrate = cfg["bitrate"]
+                direct = {k: v for k, v in cfg.items() if k in ("gop", "fps")}
+                if sink is not None and direct:
+                    sink.reconfigure(**direct)
+                pc._apply_net_profile(na.profile())
+                if sink is not None:
+                    n += 1
+            elif sink is not None:
+                sink.reconfigure(**cfg)
+                n += 1
+        return n
 
     def host_plane_snapshot(self) -> dict:
         """{pc_id: stage µs percentiles} for every live session."""

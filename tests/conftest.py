@@ -8,6 +8,14 @@ multi-chip without real hardware.  Must run before jax initializes a backend.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# NOTE (PR 6): the persistent XLA compilation cache
+# (JAX_COMPILATION_CACHE_DIR) was tried here as a wall-time shave and
+# REVERTED: on this jax 0.4.37 CPU backend with the virtual 8-device
+# mesh it served stale/colliding executables across engine instances —
+# tp-parity and quant-parity tests got all-zero frames from one engine,
+# on fresh AND warm caches.  Do not re-enable without a jax upgrade and
+# a green parity run.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

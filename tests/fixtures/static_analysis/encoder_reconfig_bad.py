@@ -1,0 +1,41 @@
+"""Known-bad fixture for the encoder-reconfig checker: direct native rate
+calls and rate-carrying encoder construction outside media/codec.py.
+Every line marked # BAD must be flagged; the ok_* spellings stay clean."""
+
+from ai_rtc_agent_tpu.media.codec import H264Encoder
+from ai_rtc_agent_tpu.media.codec import H264Encoder as RenamedEncoder
+
+
+class BadSink:
+    def __init__(self, lib, enc):
+        self._lib = lib
+        self._enc = enc
+
+    def set_bitrate_native(self, bps):
+        self._lib.tr_h264_encoder_destroy(self._enc)  # BAD tr-call
+        self._enc = self._lib.tr_h264_encoder_create(  # BAD tr-call
+            64, 64, 30, 1, bps, 60, b"ultrafast", b"zerolatency"
+        )
+
+    def force_native(self):
+        self._lib.tr_h264_force_keyframe(self._enc)  # BAD tr-call
+
+    def throttle_kw(self):
+        return H264Encoder(64, 64, bitrate=500_000)  # BAD rate-ctor kw
+
+    def throttle_gop(self):
+        return H264Encoder(64, 64, 30, None, 30)  # BAD rate-ctor positional
+
+    def throttle_renamed(self):
+        return RenamedEncoder(64, 64, gop=12)  # BAD rate-ctor renamed
+
+    def ok_rateless_ctor(self):
+        # geometry is the caller's to choose; rate targets are not
+        return H264Encoder(64, 64, 30)
+
+    def ok_blessed_path(self, enc):
+        enc.reconfigure(bitrate=250_000, gop=30)
+        enc.force_keyframe()
+
+    def ok_unrelated_call(self, other):
+        other.tr_something_else(1)

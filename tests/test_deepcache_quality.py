@@ -47,7 +47,12 @@ def _stream_outputs(interval: int):
 def curves():
     full = _stream_outputs(0)
     rows = {}
-    for interval in (2, 3, 5):
+    # intervals 3 (the shipped default, floor-pinned below) and 5 (the
+    # far point of the curve) carry every assertion; interval 2 carried
+    # none and cost a full engine build — dropped for the tier-1 wall-time
+    # budget (ROADMAP standing constraints).  The full curve incl. 2 stays
+    # measurable via scripts/deepcache_quality.py.
+    for interval in (3, 5):
         cached = _stream_outputs(interval)
         ps = [psnr(a, b) for a, b in zip(full, cached)]
         ss = [ssim(a, b) for a, b in zip(full, cached)]

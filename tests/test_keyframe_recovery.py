@@ -67,6 +67,36 @@ def test_force_keyframe_emits_idr():
         enc.close()
 
 
+def test_reconfigure_applies_at_the_next_idr_boundary():
+    """ISSUE 6: H264Encoder.reconfigure — in place when the native lib
+    exports rate control, otherwise rebuild-on-next-IDR: the next encoded
+    frame opens a fresh stream (IDR + in-band SPS) carrying the new
+    bitrate/GOP, so receivers re-sync within one frame."""
+    enc = H264Encoder(64, 64, gop=600)
+    rng = np.random.default_rng(3)
+    try:
+        for i in range(3):  # past the opening IDR, into P-frames
+            enc.encode(rng.integers(0, 255, (64, 64, 3), np.uint8), pts=i)
+        applied = enc.reconfigure(bitrate=400_000, gop=30)
+        assert enc._bitrate == 400_000 and enc._gop == 30
+        au = enc.encode(
+            rng.integers(0, 255, (64, 64, 3), np.uint8), pts=9
+        )
+        if not applied:
+            # rebuild path: the reconfigured stream must open with IDR+SPS
+            assert au and 5 in _nal_types(au), "rebuild did not IDR"
+            assert 7 in _nal_types(au), "rebuilt stream lacks in-band SPS"
+        # a no-op reconfigure is applied trivially and must not rebuild
+        assert enc.reconfigure(bitrate=400_000) is True
+        later = enc.encode(
+            rng.integers(0, 255, (64, 64, 3), np.uint8), pts=12
+        )
+        if later:
+            assert 5 not in _nal_types(later), "no-op reconfigure forced an IDR"
+    finally:
+        enc.close()
+
+
 def test_decode_error_pli_loop_recovers():
     """Mid-stream join (IDR lost): decode errors fire decode_error; the
     handler forces a keyframe at the sender; recovery within 2 frames

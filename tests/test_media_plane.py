@@ -493,3 +493,79 @@ def test_rtcp_on_media_port_does_not_desync_depacketizer(native_lib):
         sink.close()
         src.close()
     assert decoded >= 6, f"only {decoded} frames survived muxed RTCP"
+
+
+def test_sink_reconfigure_profile_and_scale(native_lib):
+    """ISSUE 6: the session-level encoder mutation surface.  On the
+    NullCodec tier the profile is still recorded (quality rungs stay
+    observable without libavcodec) and the reduce-resolution decimation
+    actually shrinks the frames on the wire."""
+    sink = H264Sink(32, 32, use_h264=False)
+    src = H264RingSource(32, 32, use_h264=False)
+    try:
+        frame = np.arange(32 * 32 * 3, dtype=np.uint8).reshape(32, 32, 3)
+        for pkt in sink.consume(frame):
+            src.feed_packet(bytes(pkt))
+        got = src.poll()
+        assert got is not None and got[0].shape == (32, 32, 3)
+
+        sink.reconfigure(bitrate=500_000, gop=30, scale=2)
+        assert sink.profile["bitrate"] == 500_000
+        assert sink.profile["gop"] == 30
+        assert sink.profile["scale"] == 2
+        for pkt in sink.consume(frame):
+            src.feed_packet(bytes(pkt))
+        got = src.poll()
+        assert got is not None and got[0].shape == (16, 16, 3), (
+            "reduce-resolution rung must shrink the encoded geometry"
+        )
+
+        sink.reconfigure(scale=1)  # recovery restores full resolution
+        assert sink.profile["bitrate"] == 500_000  # rate profile survives
+        for pkt in sink.consume(frame):
+            src.feed_packet(bytes(pkt))
+        got = src.poll()
+        assert got is not None and got[0].shape == (32, 32, 3)
+
+        # odd decimated geometry is cropped to EVEN dims (yuv420 encoders
+        # reject odd sizes — the degradation rung must never kill the
+        # send path; review fix)
+        sink.reconfigure(scale=2)
+        odd = np.zeros((54, 42, 3), np.uint8)  # 54/2=27, 42/2=21: both odd
+        for pkt in sink.consume(odd):
+            src.feed_packet(bytes(pkt))
+        got = src.poll()
+        assert got is not None and got[0].shape == (26, 20, 3)
+    finally:
+        sink.close()
+        src.close()
+
+
+def test_pc_keyframe_governor_coalesces_pli_storm(native_lib):
+    """rtc_native wiring: with a netadapt ladder attached, a PLI storm at
+    _force_sink_keyframe costs ONE IDR per coalescing window."""
+    from ai_rtc_agent_tpu.resilience.netadapt import NetworkAdaptLadder
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+
+    provider = NativeRtpProvider()
+    pc = provider.peer_connection()
+    forced = []
+
+    class FakeSink:
+        def force_keyframe(self):
+            forced.append(1)
+
+        def reconfigure(self, **kw):
+            pass
+
+    try:
+        pc._sink = FakeSink()
+        na = NetworkAdaptLadder("s", pli_coalesce_s=60.0)
+        pc.attach_netadapt(na)
+        assert pc._rtcp_state.netadapt is na  # RR blocks feed the ladder
+        for _ in range(25):
+            pc._force_sink_keyframe()
+        assert sum(forced) == 1, "PLI storm must cost one IDR per window"
+        assert pc.kf_governor.coalesced == 24
+    finally:
+        provider.unregister_plane_session(pc.pc_id)

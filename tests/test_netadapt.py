@@ -1,0 +1,348 @@
+"""Network-adaptation ladder (resilience/netadapt.py): rung hysteresis,
+actuation profiles, the max-rung join onto the compute overload ladder,
+keyframe governance, and the runtime encoder-config surface — all
+clockless and injectable, no wall-clock sleeps."""
+
+import pytest
+
+from ai_rtc_agent_tpu.resilience.netadapt import (
+    NET_RUNG_KEYFRAME_THROTTLE,
+    NET_RUNG_LABELS,
+    NET_RUNG_RAISE_FRAME_SKIP,
+    NET_RUNG_REDUCE_BITRATE,
+    NET_RUNG_REDUCE_RESOLUTION,
+    NET_SKIP_FLOOR,
+    KeyframeGovernor,
+    NetworkAdaptLadder,
+)
+from ai_rtc_agent_tpu.resilience.overload import (
+    RUNG_PASSTHROUGH,
+    AdmissionController,
+    OverloadLadder,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _ladder(clock, **kw):
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 3)
+    kw.setdefault("base_bitrate", 3_000_000)
+    kw.setdefault("min_bitrate", 250_000)
+    kw.setdefault("rr_timeout_s", 1e9)  # reports fed explicitly below
+    return NetworkAdaptLadder("s", clock=clock, **kw)
+
+
+def _lossy(na, fraction=128):
+    na.on_receiver_report({"ssrc": 1, "fraction_lost": fraction, "jitter": 90})
+
+
+def _clean(na):
+    # repeated clean reports wash the EWMA down fast (alpha=0.3)
+    for _ in range(8):
+        na.on_receiver_report({"ssrc": 1, "fraction_lost": 0, "jitter": 10})
+
+
+class TestRungHysteresis:
+    def test_sustained_loss_climbs_and_clean_unwinds(self):
+        clock = FakeClock()
+        moves = []
+        na = _ladder(clock)
+        na.on_rung = lambda old, new: moves.append((old, new))
+        # sustained 50% loss: one rung per up_after ticks, to the top
+        for _ in range(2 * len(NET_RUNG_LABELS)):
+            _lossy(na)
+            na.tick()
+        assert na.rung == NET_RUNG_KEYFRAME_THROTTLE
+        # clean reports: one rung back per down_after ticks, to normal
+        _clean(na)
+        for _ in range(3 * len(NET_RUNG_LABELS)):
+            _clean(na)
+            na.tick()
+        assert na.rung == 0
+        # every move was single-step, up then down
+        ups = [(o, n) for o, n in moves if n > o]
+        downs = [(o, n) for o, n in moves if n < o]
+        assert len(ups) == len(downs) == NET_RUNG_KEYFRAME_THROTTLE
+        assert all(n == o + 1 for o, n in ups)
+        assert all(n == o - 1 for o, n in downs)
+
+    def test_one_lossy_report_does_not_escalate(self):
+        na = _ladder(FakeClock())
+        _lossy(na)
+        na.tick()  # only one pressured tick < up_after
+        assert na.rung == 0
+
+    def test_hysteresis_band_holds_the_rung(self):
+        na = _ladder(FakeClock(), loss_up=0.08, loss_down=0.02)
+        na.rung = 2
+        # ~4% loss sits between the thresholds: neither climbs nor unwinds
+        for _ in range(20):
+            na.on_receiver_report({"ssrc": 1, "fraction_lost": 10, "jitter": 0})
+            na.loss_ewma.value = 0.04  # settled mid-band
+            na.tick()
+        assert na.rung == 2
+
+    def test_rr_silence_decays_loss_and_unwinds(self):
+        clock = FakeClock()
+        na = _ladder(clock, rr_timeout_s=5.0)
+        for _ in range(4):
+            _lossy(na)
+            na.tick()
+        assert na.rung >= 1
+        # the peer stops reporting entirely: the EWMA decays tick by tick
+        # (evidence-free pressure must not pin quality down forever)
+        clock.advance(10.0)
+        for _ in range(60):
+            na.tick()
+        assert na.rung == 0
+        assert na.loss_ewma.value < 0.02
+
+    def test_tx_feedback_counts_as_pressure_without_rrs(self):
+        na = _ladder(FakeClock(), feedback_burst=4)
+        for _ in range(4):
+            na.on_tx_feedback(nacks=3, plis=2)  # 5 >= burst per tick
+            na.tick()
+        assert na.rung >= 1
+
+    def test_close_releases_the_skip_floor(self):
+        adm = AdmissionController()
+        comp = OverloadLadder("s", adm)
+        na = _ladder(FakeClock(), compute_ladder=comp)
+        for _ in range(2 * len(NET_RUNG_LABELS)):
+            _lossy(na)
+            na.tick()
+        assert comp.net_floor > 0
+        na.close()
+        assert comp.net_floor == 0 and comp.effective_rung == 0
+
+
+class TestActuationProfile:
+    def test_bitrate_steps_down_monotonically_with_floor(self):
+        na = _ladder(FakeClock(), bitrate_factor=0.5, min_bitrate=500_000)
+        seen = []
+        for rung in range(len(NET_RUNG_LABELS)):
+            na.rung = rung
+            seen.append(na.profile()["bitrate"])
+        assert seen[0] == 3_000_000
+        assert all(b2 <= b1 for b1, b2 in zip(seen, seen[1:]))
+        assert seen[-1] >= 500_000  # floored, never zero
+
+    def test_resolution_and_skip_floor_by_rung(self):
+        na = _ladder(FakeClock())
+        na.rung = NET_RUNG_REDUCE_BITRATE
+        p = na.profile()
+        assert p["scale"] == 1 and p["skip_floor"] == 0
+        na.rung = NET_RUNG_REDUCE_RESOLUTION
+        assert na.profile()["scale"] == 2
+        na.rung = NET_RUNG_RAISE_FRAME_SKIP
+        assert na.profile()["skip_floor"] == 1
+        na.rung = NET_RUNG_KEYFRAME_THROTTLE
+        p = na.profile()
+        assert p["skip_floor"] == 2
+        # the feedback window widens at the top rung: a persistent storm
+        # buys even fewer IDRs
+        assert p["pli_coalesce_s"] == pytest.approx(4 * na.pli_coalesce_s)
+
+    def test_keyframe_cadence_from_loss_not_per_pli(self):
+        na = _ladder(FakeClock())
+        assert na.profile()["keyframe_interval_s"] == 0.0  # normal: off
+        na.rung = NET_RUNG_REDUCE_BITRATE
+        assert na.profile()["keyframe_interval_s"] > 0.0
+
+    def test_apply_hook_fires_on_every_move(self):
+        profiles = []
+        na = _ladder(FakeClock(), apply=profiles.append)
+        for _ in range(6):
+            _lossy(na)
+            na.tick()
+        assert len(profiles) >= 2
+        rates = [p["bitrate"] for p in profiles]
+        assert rates == sorted(rates, reverse=True)  # strictly stepping down
+
+
+class TestOverloadJoin:
+    def _joined(self, clock=None):
+        clock = clock or FakeClock()
+        adm = AdmissionController(clock=clock)
+        comp = OverloadLadder("s", adm, clock=clock)
+        na = _ladder(clock, compute_ladder=comp)
+        return comp, na
+
+    def test_effective_rung_is_max_of_compute_and_network(self):
+        comp, na = self._joined()
+        na.rung = NET_RUNG_KEYFRAME_THROTTLE
+        na._move(NET_RUNG_KEYFRAME_THROTTLE)  # push the floor
+        assert comp.net_floor == 2
+        assert comp.effective_rung == 2  # network wins while compute idle
+        comp.rung = 3  # compute passthrough outranks the floor
+        assert comp.effective_rung == 3
+
+    def test_net_floor_never_reaches_passthrough(self):
+        comp, na = self._joined()
+        comp.set_net_floor(99)  # hostile/buggy input
+        assert comp.net_floor < RUNG_PASSTHROUGH
+        assert max(NET_SKIP_FLOOR) < RUNG_PASSTHROUGH
+
+    def test_floor_thins_frames_without_stopping_engine(self):
+        comp, na = self._joined()
+        na._move(NET_RUNG_RAISE_FRAME_SKIP)  # floor = skip2
+        admitted = sum(1 for _ in range(100) if comp.admit_frame())
+        assert 40 <= admitted <= 60  # 1-in-2, never zero
+
+    def test_floor_release_restores_every_frame(self):
+        comp, na = self._joined()
+        na._move(NET_RUNG_RAISE_FRAME_SKIP)
+        na._move(0)
+        assert comp.net_floor == 0
+        assert all(comp.admit_frame() for _ in range(10))
+
+
+class TestKeyframeGovernor:
+    def test_pli_storm_costs_one_idr_per_window(self):
+        clock = FakeClock()
+        gov = KeyframeGovernor(coalesce_s=0.7, clock=clock)
+        grants = [gov.request() for _ in range(20)]
+        assert sum(grants) == 1 and grants[0]
+        assert gov.coalesced == 19
+        clock.advance(0.71)
+        assert gov.request()  # next window, next grant
+
+    def test_periodic_cadence_shares_the_window_stamp(self):
+        clock = FakeClock()
+        gov = KeyframeGovernor(coalesce_s=0.5, clock=clock)
+        gov.interval_s = 2.0
+        assert gov.periodic_due()  # first cadence IDR
+        assert not gov.periodic_due()  # not due again yet
+        clock.advance(1.0)
+        # feedback inside the cadence interval but outside the coalesce
+        # window: granted, AND it resets the shared stamp
+        assert gov.request()
+        clock.advance(1.5)  # 1.5 < 2.0 since the feedback IDR
+        assert not gov.periodic_due()
+        clock.advance(0.6)
+        assert gov.periodic_due()
+
+    def test_cadence_off_by_default(self):
+        gov = KeyframeGovernor(clock=FakeClock())
+        assert not gov.periodic_due()
+
+
+class TestRuntimeEncoderConfig:
+    """The /config {"encoder": ...} surface (apply_runtime_config) and the
+    native provider's validate/apply fan-out."""
+
+    def _provider(self):
+        from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+
+        return NativeRtpProvider()
+
+    def test_validate_rejects_before_any_mutation(self):
+        prov = self._provider()
+        for bad in (
+            None, [], {}, {"bitrate": "fast"}, {"bitrate": 0},
+            {"volume": 11}, {"gop": True},
+        ):
+            with pytest.raises(ValueError):
+                prov.validate_encoder_config(bad)
+        assert prov.validate_encoder_config(
+            {"bitrate": 1_000_000.0, "gop": 30}
+        ) == {"bitrate": 1_000_000, "gop": 30}
+
+    def test_apply_fans_out_to_live_sinks(self):
+        prov = self._provider()
+        applied = []
+
+        class Sink:
+            def reconfigure(self, **kw):
+                applied.append(kw)
+
+        class Pc:
+            _sink = Sink()
+            netadapt = None
+
+        prov.register_plane_session("a", object(), pc=Pc())
+        prov.register_plane_session("b", object(), pc=Pc())
+        n = prov.apply_encoder_config({"bitrate": 800_000, "scale": 2})
+        assert n == 2
+        assert applied == [{"bitrate": 800_000, "scale": 2}] * 2
+        prov.unregister_plane_session("a")
+        assert prov.apply_encoder_config({"gop": 30}) == 1
+
+    def test_operator_bitrate_becomes_the_ladder_base(self):
+        """A runtime /config bitrate on a ladder-joined session is an
+        operator CAP, not a raw push: it becomes the ladder's base, the
+        sink is actuated through the CURRENT rung (a congested link must
+        not get full rate/scale because the operator updated the cap),
+        gop/fps apply directly, and recovery returns to the cap."""
+        prov = self._provider()
+        applied = []
+
+        class Sink:
+            def reconfigure(self, **kw):
+                applied.append(kw)
+
+        na = _ladder(FakeClock())  # base 3 Mbit, factor 0.6
+
+        class Pc:
+            _sink = Sink()
+            netadapt = na
+
+            def _apply_net_profile(self, profile):
+                self._sink.reconfigure(
+                    bitrate=profile["bitrate"], scale=profile["scale"]
+                )
+
+        prov.register_plane_session("a", object(), pc=Pc())
+        na.rung = NET_RUNG_REDUCE_RESOLUTION  # mid-episode, rung holding
+        prov.apply_encoder_config({"bitrate": 1_000_000, "gop": 30})
+        assert na.base_bitrate == 1_000_000
+        assert {"gop": 30} in applied  # non-rung-owned key applied directly
+        rung_cfg = applied[-1]  # rung-owned keys flow through the profile
+        assert rung_cfg == {
+            "bitrate": na.profile()["bitrate"], "scale": 2,
+        }
+        assert rung_cfg["bitrate"] < 1_000_000  # scaled from the cap
+        na.rung = 0
+        assert na.profile()["bitrate"] == 1_000_000  # recovery = the cap
+        # a cap below the configured floor wins over the floor too
+        prov.apply_encoder_config({"bitrate": 100_000})
+        na.rung = NET_RUNG_KEYFRAME_THROTTLE
+        assert na.profile()["bitrate"] <= 100_000
+
+    def test_apply_runtime_config_encoder_path(self):
+        from ai_rtc_agent_tpu.server.agent import apply_runtime_config
+
+        class Pipe:
+            def __init__(self):
+                self.prompts = []
+
+            def update_prompt(self, p):
+                self.prompts.append(p)
+
+        prov = self._provider()
+        pipe = Pipe()
+        # no encoder surface (loopback/aiortc tier): a clean 400-shaped
+        # refusal
+        with pytest.raises(ValueError, match="not supported"):
+            apply_runtime_config(pipe, {"encoder": {"bitrate": 1}})
+        # invalid encoder config fails BEFORE the prompt mutates
+        with pytest.raises(ValueError):
+            apply_runtime_config(
+                pipe, {"prompt": "x", "encoder": {"bogus": 1}}, prov
+            )
+        assert pipe.prompts == []
+        # valid config applies both
+        apply_runtime_config(
+            pipe, {"prompt": "x", "encoder": {"bitrate": 700_000}}, prov
+        )
+        assert pipe.prompts == ["x"]

@@ -1,0 +1,337 @@
+"""Network-adaptation chaos (hermetic, tier-1): a scripted ``loss_burst``
+fault plan drives a live loopback session's network rung up — encoder
+bitrate steps down, resolution reduces, a frame-skip floor lands on the
+compute ladder, keyframe cadence throttles — while freshness stays inside
+the overload deadline (quality degrades, never freshness), and the whole
+ride unwinds to normal once the loss clears.  A dual-pressure test pins
+the join: the *effective* session rung is the max of compute and network
+pressure.
+
+The loss path is the real machinery end to end: RTP-shaped packets
+through the seeded fault scope (resilience/faults.py ``loss_burst``) into
+RFC 3550 reception accounting (media/rtcp.py ``ReceiverStats``), report
+blocks over the actual RR wire format (``make_rr``/``parse_compound``),
+into the session's :class:`NetworkAdaptLadder`.  Only the UDP socket is
+elided — every byte format and counter in between is production code.
+"""
+
+import asyncio
+import struct
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_rtc_agent_tpu.media import rtcp
+from ai_rtc_agent_tpu.media.frames import VideoFrame
+from ai_rtc_agent_tpu.resilience import faults
+from ai_rtc_agent_tpu.resilience.faults import FaultPlan, FaultSpec
+from ai_rtc_agent_tpu.resilience.netadapt import (
+    NET_RUNG_KEYFRAME_THROTTLE,
+    NET_RUNG_RAISE_FRAME_SKIP,
+    KeyframeGovernor,
+)
+from ai_rtc_agent_tpu.resilience.overload import RUNG_PASSTHROUGH
+from ai_rtc_agent_tpu.server.agent import build_app
+from ai_rtc_agent_tpu.server.signaling import (
+    LoopbackProvider,
+    make_loopback_offer,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+class InvertPipeline:
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, frame):
+        self.calls += 1
+        arr = frame if isinstance(frame, np.ndarray) else frame.to_ndarray()
+        return 255 - arr
+
+    def restart(self):
+        pass
+
+
+class LossyViewer:
+    """Simulated viewer downlink: our RTP through the scripted fault link
+    into RFC 3550 accounting; RRs come back over the real wire format."""
+
+    MEDIA_SSRC = 0x0ABC
+
+    def __init__(self):
+        self.scope = faults.scope("rx")
+        self.stats = rtcp.ReceiverStats()
+        self.seq = 0
+
+    def clear_link(self):
+        self.scope = None
+
+    def carry(self, n: int):
+        for _ in range(n):
+            pkt = (
+                struct.pack(
+                    "!BBHII", 0x80, 96, self.seq & 0xFFFF,
+                    (self.seq * 3000) & 0xFFFFFFFF, self.MEDIA_SSRC,
+                )
+                + b"x" * 16
+            )
+            self.seq += 1
+            outs = (
+                self.scope.apply(pkt) if self.scope is not None else [(pkt, 0.0)]
+            )
+            for d, _delay in outs:
+                self.stats.received(d)
+
+    def report_block(self) -> dict:
+        blk = self.stats.report_block()
+        rr = rtcp.make_rr(
+            0x9999,
+            media_ssrc=blk["ssrc"],
+            fraction_lost=blk["fraction_lost"],
+            cumulative_lost=blk["cumulative_lost"],
+            highest_seq=blk["highest_seq"],
+            jitter=blk["jitter"],
+        )
+        (item,) = [i for i in rtcp.parse_compound(rr) if i["type"] == "rr"]
+        return item["blocks"][0]
+
+
+def _netadapt_env(monkeypatch):
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    monkeypatch.setenv("SUPERVISOR_STALL_AFTER_S", "30")
+    monkeypatch.setenv("OVERLOAD_TICK_S", "0.05")
+    monkeypatch.setenv("OVERLOAD_FRAME_DEADLINE_MS", "300")
+    monkeypatch.setenv("NETADAPT_UP_TICKS", "2")
+    monkeypatch.setenv("NETADAPT_DOWN_TICKS", "2")
+    monkeypatch.setenv("NETADAPT_RR_TIMEOUT_S", "30")
+    monkeypatch.setenv("ENC_DEFAULT_BITRATE", "3000000")
+
+
+def _offer_body(room="netadapt"):
+    return {
+        "room_id": room,
+        "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+    }
+
+
+def test_loss_burst_rides_the_quality_ladder_and_unwinds(monkeypatch):
+    _netadapt_env(monkeypatch)
+    # 50% sustained loss, deterministic duty cycle, unbounded window —
+    # the episode "clears" when the viewer's link drops the fault scope
+    faults.activate(
+        FaultPlan(
+            specs=(
+                FaultSpec(target="rx", kind="loss_burst", period=10, burst=5),
+            ),
+            seed=6,
+        )
+    )
+    pipe = InvertPipeline()
+
+    async def go():
+        app = build_app(pipeline=pipe, provider=LoopbackProvider())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/offer", json=_offer_body())
+            assert r.status == 200
+            pc = next(iter(app["pcs"]))
+            viewer_track = pc.out_tracks[0]
+            (key,) = app["supervisors"].keys()
+            ov = app["overload"]
+            ladder = ov.ladders[key]
+            na = ov.netadapt[key]
+            profiles = []
+            na.apply = profiles.append
+
+            alive = True
+            delivered = 0
+
+            async def producer():
+                i = 0
+                while alive:
+                    f = VideoFrame.from_ndarray(
+                        np.full((8, 8, 3), i % 200, np.uint8)
+                    )
+                    f.wall_ts = time.monotonic()
+                    await pc.in_track.push(f)
+                    i += 1
+                    await asyncio.sleep(0.01)
+
+            async def consumer():
+                nonlocal delivered
+                while alive:
+                    await asyncio.wait_for(viewer_track.recv(), timeout=5.0)
+                    delivered += 1
+
+            tasks = [
+                asyncio.ensure_future(producer()),
+                asyncio.ensure_future(consumer()),
+            ]
+            link = LossyViewer()
+
+            # --- phase 1: the burst.  RRs report ~50% loss; the network
+            # rung must climb to the top within the hysteresis window.
+            deadline = time.monotonic() + 20.0
+            while (
+                time.monotonic() < deadline
+                and na.rung < NET_RUNG_KEYFRAME_THROTTLE
+            ):
+                link.carry(40)
+                na.on_receiver_report(link.report_block())
+                await asyncio.sleep(0.05)
+            assert na.rung == NET_RUNG_KEYFRAME_THROTTLE, (
+                f"never saturated (rung={na.rung}, "
+                f"loss={na.loss_ewma.value:.3f})"
+            )
+
+            # bitrate stepped DOWN monotonically through the ride
+            rates = [p["bitrate"] for p in profiles]
+            assert len(rates) >= 4 and rates == sorted(rates, reverse=True)
+            assert rates[-1] < 3_000_000
+            top = profiles[-1]
+            assert top["scale"] == 2  # reduce-resolution engaged
+            assert top["keyframe_interval_s"] > 0  # cadence from telemetry
+            # keyframe window throttled 4x: a 30-PLI storm costs ONE IDR
+            assert top["pli_coalesce_s"] == pytest.approx(4 * na.pli_coalesce_s)
+            gov = KeyframeGovernor(coalesce_s=top["pli_coalesce_s"])
+            grants = sum(gov.request() for _ in range(30))
+            assert grants == 1 and gov.coalesced == 29
+
+            # the join: network pressure imposes a skip FLOOR (skip4) but
+            # never passthrough — quality degrades, freshness does not
+            assert ladder.net_floor == 2
+            assert ladder.rung == 0  # compute side is idle
+            assert ladder.effective_rung == 2
+            assert RUNG_PASSTHROUGH > ladder.effective_rung
+
+            # frames kept flowing the whole time, comfortably fresh
+            m = await (await client.get("/metrics")).json()
+            assert delivered > 0
+            assert m["overload_freshness_p99_ms"] < 300.0
+            assert m["netadapt_rung_max"] == NET_RUNG_KEYFRAME_THROTTLE
+            assert m["overload_rung_effective_max"] == 2
+            assert m["netadapt_ladder_moves_total"] >= 4
+            assert m["netadapt_loss_ewma_max"] > 0.08
+
+            # the ride is on the session's health + black box
+            h = await (await client.get("/health")).json()
+            snap = h["sessions"][key]
+            assert snap["netadapt"]["rung"] == NET_RUNG_KEYFRAME_THROTTLE
+            assert snap["effective_rung"] == 2
+            rec = app["flight"].session(key)
+            kinds = [e["kind"] for e in rec.events]
+            assert kinds.count("netadapt_rung") >= 4
+
+            # --- phase 2: the burst clears.  Clean RRs wash the EWMA
+            # down; every rung unwinds; full quality comes back.
+            link.clear_link()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and (
+                na.rung > 0 or ladder.effective_rung > 0
+            ):
+                link.carry(40)
+                na.on_receiver_report(link.report_block())
+                await asyncio.sleep(0.05)
+            assert na.rung == 0 and ladder.net_floor == 0
+            assert ladder.effective_rung == 0
+            assert profiles[-1]["bitrate"] == 3_000_000
+            assert profiles[-1]["scale"] == 1
+            assert profiles[-1]["keyframe_interval_s"] == 0.0
+            m = await (await client.get("/metrics")).json()
+            assert m["netadapt_rung_max"] == 0
+            alive = False
+            for t in tasks:
+                t.cancel()
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_dual_pressure_effective_rung_is_max_of_both(monkeypatch):
+    """Compute and network pressure at once: the session runs the WORSE of
+    the two rungs; either side clearing alone leaves the other's rung in
+    force."""
+    _netadapt_env(monkeypatch)
+    monkeypatch.setenv("OVERLOAD_STEP_BUDGET_MS", "100")
+    monkeypatch.setenv("OVERLOAD_UP_TICKS", "2")
+    monkeypatch.setenv("OVERLOAD_DOWN_TICKS", "2")
+    pipe = InvertPipeline()
+
+    async def go():
+        app = build_app(pipeline=pipe, provider=LoopbackProvider())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/offer", json=_offer_body("dual"))
+            assert r.status == 200
+            (key,) = app["supervisors"].keys()
+            ov = app["overload"]
+            ladder = ov.ladders[key]
+            na = ov.netadapt[key]
+
+            # network side: sustained heavy loss straight into the ladder
+            async def pressure_until(pred, feed, deadline_s=15.0):
+                deadline = time.monotonic() + deadline_s
+                while time.monotonic() < deadline and not pred():
+                    feed()
+                    await asyncio.sleep(0.05)
+                assert pred()
+
+            await pressure_until(
+                lambda: na.rung >= NET_RUNG_RAISE_FRAME_SKIP,
+                lambda: na.on_receiver_report(
+                    {"ssrc": 1, "fraction_lost": 128, "jitter": 50}
+                ),
+            )
+            assert ladder.net_floor >= 1
+            floor = ladder.net_floor
+
+            # compute side: step latency over budget walks the compute
+            # ladder past the network floor — the max wins
+            await pressure_until(
+                lambda: ladder.rung >= RUNG_PASSTHROUGH,
+                lambda: ov.admission.note_step_latency(1.0),
+            )
+            assert ladder.effective_rung == ladder.rung >= RUNG_PASSTHROUGH
+            assert ladder.effective_rung > floor
+
+            # compute recovers (fast steps), loss persists: the effective
+            # rung falls only to the NETWORK floor, not to zero
+            await pressure_until(
+                lambda: ladder.rung == 0,
+                lambda: (
+                    ov.admission.note_step_latency(0.001),
+                    na.on_receiver_report(
+                        {"ssrc": 1, "fraction_lost": 128, "jitter": 50}
+                    ),
+                ),
+                deadline_s=20.0,
+            )
+            assert na.rung >= NET_RUNG_RAISE_FRAME_SKIP
+            assert ladder.effective_rung == ladder.net_floor >= 1
+
+            # loss clears too: everything unwinds
+            await pressure_until(
+                lambda: na.rung == 0 and ladder.effective_rung == 0,
+                lambda: (
+                    ov.admission.note_step_latency(0.001),
+                    na.on_receiver_report(
+                        {"ssrc": 1, "fraction_lost": 0, "jitter": 1}
+                    ),
+                ),
+                deadline_s=20.0,
+            )
+        finally:
+            await client.close()
+
+    asyncio.run(go())

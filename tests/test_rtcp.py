@@ -128,6 +128,97 @@ class TestRetransmissionCache:
         assert item["octet_count"] == 2 * len(b"payload")
 
 
+class TestReportBlockSelection:
+    """ISSUE 6 satellite: the RR gauge must select the report block about
+    OUR media SSRC — a multi-block compound from a multi-stream peer must
+    not gauge a stranger's loss, and blocks riding an SR (bidirectional
+    peers, RFC 3550 s6.4.1) must feed the same gauges."""
+
+    def _state(self):
+        from ai_rtc_agent_tpu.server.rtc_native import _RtcpState
+        from ai_rtc_agent_tpu.utils.profiling import FrameStats
+
+        stats = FrameStats()
+        return _RtcpState(stats=stats), stats
+
+    def _multiblock_rr(self, blocks):
+        payload = struct.pack("!I", 0x1111)
+        for b in blocks:
+            payload += struct.pack(
+                "!IIIIII",
+                b["ssrc"],
+                ((b["fraction_lost"] & 0xFF) << 24) | (b.get("lost", 0) & 0xFFFFFF),
+                b.get("highest_seq", 0),
+                b.get("jitter", 0),
+                0, 0,
+            )
+        return (
+            struct.pack("!BBH", 0x80 | len(blocks), 201, len(payload) // 4)
+            + payload
+        )
+
+    def test_multiblock_rr_selects_our_ssrc_not_the_first_block(self):
+        st, stats = self._state()
+        # a stranger's catastrophic block comes FIRST; ours second
+        rr = self._multiblock_rr([
+            {"ssrc": 0xDEAD, "fraction_lost": 255, "jitter": 9999},
+            {"ssrc": st.ssrc, "fraction_lost": 32, "jitter": 7},
+        ])
+        st.on_rtcp(rr, lambda w: None)
+        snap = stats.snapshot()
+        assert snap["rr_fraction_lost"] == 32 and snap["rr_jitter"] == 7
+
+    def test_rr_without_our_block_gauges_nothing(self):
+        st, stats = self._state()
+        rr = self._multiblock_rr(
+            [{"ssrc": 0xDEAD, "fraction_lost": 255, "jitter": 1}]
+        )
+        st.on_rtcp(rr, lambda w: None)
+        snap = stats.snapshot()
+        assert "rr_fraction_lost" not in snap
+        assert snap.get("rtcp_rrs_total", 0) == 0
+
+    def test_sr_embedded_report_block_feeds_gauges(self):
+        st, stats = self._state()
+        sr = rtcp.make_sr(
+            0x2222, rtp_ts=0, packet_count=1, octet_count=1,
+            compound_sdes=False,
+            report_blocks=[
+                {"ssrc": 0xBEEF, "fraction_lost": 200, "jitter": 5},
+                {"ssrc": st.ssrc, "fraction_lost": 48, "jitter": 11},
+            ],
+        )
+        st.on_rtcp(sr, lambda w: None)
+        snap = stats.snapshot()
+        assert snap["rr_fraction_lost"] == 48 and snap["rr_jitter"] == 11
+
+    def test_blocks_feed_the_netadapt_ladder(self):
+        st, _ = self._state()
+        seen = []
+
+        class Ladder:
+            def on_receiver_report(self, blk):
+                seen.append(blk)
+
+            def on_tx_feedback(self, nacks=0, plis=0):
+                seen.append(("fb", nacks, plis))
+
+        st.netadapt = Ladder()
+        st.on_rtcp(
+            self._multiblock_rr([
+                {"ssrc": 0xDEAD, "fraction_lost": 255, "jitter": 1},
+                {"ssrc": st.ssrc, "fraction_lost": 64, "jitter": 3},
+            ]),
+            lambda w: None,
+        )
+        assert len(seen) == 1 and seen[0]["fraction_lost"] == 64
+        # NACK + PLI feedback also lands, with the stranger's filtered out
+        st.on_rtcp(make_nack(1, st.ssrc, [5, 6]), lambda w: None)
+        st.on_rtcp(make_nack(1, 0xDEAD, [7]), lambda w: None)
+        fb = [x for x in seen if isinstance(x, tuple)]
+        assert fb == [("fb", 2, 0)]
+
+
 @pytest.fixture(scope="module")
 def native_lib():
     from ai_rtc_agent_tpu.media import native

@@ -114,6 +114,43 @@ def test_bounded_queue_catches_unbounded_and_respects_bounds():
     assert not any("ok" in s for s in flagged)
 
 
+def test_encoder_reconfig_catches_native_calls_and_rate_ctors():
+    """ISSUE 6 satellite: encoder bitrate/GOP mutations outside the single
+    reconfigure() path — direct tr_h264_* calls and rate-carrying
+    H264Encoder construction (any import spelling) are findings; rateless
+    construction and the blessed reconfigure()/force_keyframe() surface
+    stay clean."""
+    fs = run_on(["encoder_reconfig_bad.py"], ("encoder-reconfig",))
+    names = {f.name for f in fs}
+    scopes = {f.scope for f in fs}
+    assert "tr_h264_encoder_create" in names
+    assert "tr_h264_encoder_destroy" in names
+    assert "tr_h264_force_keyframe" in names
+    assert "BadSink.throttle_kw" in scopes  # bitrate kwarg
+    assert "BadSink.throttle_gop" in scopes  # positional gop
+    assert "BadSink.throttle_renamed" in scopes  # renamed import
+    assert len(fs) == 6, "\n".join(f.render() for f in fs)
+    assert not any(s.startswith("BadSink.ok_") for s in scopes), scopes
+
+
+def test_encoder_reconfig_exempts_codec_tier_and_tooling(tmp_path):
+    """media/codec.py owns the native calls, media/native.py declares the
+    ctypes signatures, and operator tooling is carved out — only serving
+    code outside the codec tier is flagged."""
+    root = tmp_path
+    (root / "ai_rtc_agent_tpu" / "media").mkdir(parents=True)
+    (root / "scripts").mkdir()
+    body = "def f(lib, enc):\n    lib.tr_h264_force_keyframe(enc)\n"
+    (root / "ai_rtc_agent_tpu" / "media" / "codec.py").write_text(body)
+    (root / "ai_rtc_agent_tpu" / "media" / "native.py").write_text(body)
+    (root / "scripts" / "tool.py").write_text(body)
+    (root / "ai_rtc_agent_tpu" / "plane.py").write_text(body)
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("encoder-reconfig",))
+    assert [f.path for f in fs] == ["ai_rtc_agent_tpu/plane.py"]
+
+
 def test_span_pairing_catches_unbalanced_and_respects_closures():
     """ISSUE 5 satellite: every ``trace.begin`` must reach a matching
     ``end`` on all paths (obs/trace.py timelines stay well-formed) —
