@@ -130,6 +130,11 @@ class EngineCache:
         return exp.call
 
     def entries(self):
+        """Metadata of every cached engine.  One corrupt/truncated meta
+        JSON (a crashed build, a partial copy) must not crash the whole
+        listing — such entries are skipped with a warning; the blobs they
+        describe are still served by load_or_build (which reads the blob,
+        not the meta)."""
         if not os.path.isdir(self.cache_dir):
             return []
         out = []
@@ -138,8 +143,15 @@ class EngineCache:
             if os.path.isdir(kd):
                 for f in sorted(os.listdir(kd)):
                     if f.endswith(".json"):
-                        with open(os.path.join(kd, f)) as fh:
-                            out.append(json.load(fh))
+                        path = os.path.join(kd, f)
+                        try:
+                            with open(path) as fh:
+                                out.append(json.load(fh))
+                        except (OSError, ValueError) as e:
+                            logger.warning(
+                                "skipping unreadable engine meta %s (%s)",
+                                path, e,
+                            )
         return out
 
 

@@ -123,6 +123,61 @@ def build_multipeer(
     logger.info("multipeer engine(s) built for %s peers=%d", model_id, peers)
 
 
+def build_scheduler_buckets(
+    model_id: str,
+    sessions: int,
+    lora_dict: dict | None = None,
+    cache_dir: str | None = None,
+    controlnet: str | None = None,
+    bundle=None,
+):
+    """Prebuild the continuous batch scheduler's bucket geometries
+    (stream/scheduler.py): one serialized executable per power-of-two
+    occupancy bucket, keyed ``sbucket-k, sessions-S``.  Already-cached
+    geometries are detected via ``EngineCache.has()`` and skipped, so a
+    partial earlier build (or a crash mid-way) resumes instead of
+    recompiling everything.  Uses the scheduler's own adoption path as the
+    builder — the keys can never drift from what serving looks for."""
+    from ..models import registry
+    from ..stream.scheduler import BatchScheduler
+
+    cfg = registry.default_stream_config(
+        model_id, **({"use_controlnet": True} if controlnet else {})
+    )
+    if bundle is None:
+        bundle = registry.load_model_bundle(
+            model_id, lora_dict=lora_dict, controlnet=controlnet
+        )
+        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
+    sched = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        model_id=model_id, max_sessions=sessions,
+        prewarm=False, aot_build_on_miss=False, cache_dir=cache_dir,
+    )
+    try:
+        status = sched.aot_status(model_id, cache_dir=cache_dir)
+        missing = [k for k, built in status.items() if not built]
+        for k, built in sorted(status.items()):
+            logger.info(
+                "scheduler bucket %d/%d: %s",
+                k, sessions, "cached" if built else "building",
+            )
+        if missing and not sched.use_aot_cache(
+            model_id, cache_dir=cache_dir, build_on_miss=True
+        ):
+            raise RuntimeError(
+                f"scheduler bucket build failed for {model_id} "
+                f"sessions={sessions}"
+            )
+        logger.info(
+            "scheduler bucket engine(s) ready for %s sessions=%d "
+            "(%d built, %d already cached)",
+            model_id, sessions, len(missing), len(status) - len(missing),
+        )
+    finally:
+        sched.close()
+
+
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser()
@@ -144,6 +199,12 @@ def main(argv=None):
         help="also build the --multipeer N serving engine (peers-N keys; "
              "with UNET_CACHE set, the capture+cached pair)",
     )
+    ap.add_argument(
+        "--sched-buckets", type=int, default=0, metavar="S",
+        help="also prebuild the continuous batch scheduler's bucket "
+             "geometries for S session slots (one engine per power-of-two "
+             "occupancy; already-cached buckets are skipped)",
+    )
     args = ap.parse_args(argv)
     lora_dict = {}
     for spec in args.lora:
@@ -156,6 +217,11 @@ def main(argv=None):
         build_multipeer(
             args.model_id, args.peers, lora_dict or None, args.cache_dir,
             controlnet=args.controlnet, bundle=bundle,
+        )
+    if args.sched_buckets:
+        build_scheduler_buckets(
+            args.model_id, args.sched_buckets, lora_dict or None,
+            args.cache_dir, controlnet=args.controlnet, bundle=bundle,
         )
 
 

@@ -59,6 +59,8 @@ STAGES = (
     "decode",       # H.264 AU -> pixels (media/plane.py, native tier)
     "ingest",       # decode-complete -> admitted into the pipeline (queue wait)
     "submit",       # host preprocess + device dispatch
+    "batch_join",   # batch-scheduler coalescing window: enqueue -> the
+                    # cross-session batch step this frame rode dispatched
     "engine_step",  # dispatch-complete -> result resolved (device residency)
     "fetch",        # the blocking host-side resolve (readback tail)
     "postprocess",  # output wrap + timing metadata

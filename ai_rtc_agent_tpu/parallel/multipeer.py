@@ -42,6 +42,43 @@ class CapacityError(RuntimeError):
     """All peer slots are claimed (maps to HTTP 503 in the agent)."""
 
 
+def make_bucket_step(vstep, capacity: int, scatter_output: bool = True):
+    """Pure gather -> vmapped-step -> scatter over a stacked slot pytree.
+
+    ``vstep(params, states_k, frames_k) -> (new_states_k, out_k)`` is the
+    vmapped single-stream step; ``idx`` [k] selects which of ``capacity``
+    slot rows participate.  Duplicate indices (bucket padding) are sound:
+    the duplicated rows compute identical values, so the duplicate scatter
+    writes land identical data.  The whole thing runs in ONE jitted call so
+    the gather/scatter fuses with the step — shared by MultiPeerEngine's
+    active-count buckets and the continuous batch scheduler
+    (stream/scheduler.py), which is exactly the "slot/bucket design" reuse
+    ROADMAP open item 1 calls for.
+
+    ``scatter_output``: True returns a full-capacity output (callers index
+    by slot id — the multipeer contract); False returns the k-shaped
+    output aligned with ``idx`` (the scheduler resolves waiters by batch
+    position, saving the zeros+scatter pass that measurably taxes small
+    buckets)."""
+
+    def bucket(params, states, frames_k, idx):
+        sub = jax.tree.map(lambda a: jnp.take(a, idx, axis=0), states)
+        new_sub, out = vstep(params, sub, frames_k)
+        new_states = jax.tree.map(
+            lambda full, ns: full.at[idx].set(ns), states, new_sub
+        )
+        if not scatter_output:
+            return new_states, out
+        # scatter into a full-capacity output so callers keep indexing by
+        # slot id (rows not in idx are zeros, discarded)
+        full_out = jnp.zeros(
+            (capacity,) + out.shape[1:], out.dtype
+        ).at[idx].set(out)
+        return new_states, full_out
+
+    return bucket
+
+
 class MultiPeerEngine:
     """Fixed-capacity peer-slot engine.
 
@@ -350,21 +387,9 @@ class MultiPeerEngine:
         step = self._bucket_steps.get((k, variant))
         if step is None:
             vstep = self._vstep if variant == "full" else self._vstep_cached
-
-            def bucket(params, states, frames_k, idx):
-                sub = jax.tree.map(lambda a: jnp.take(a, idx, axis=0), states)
-                new_sub, out = vstep(params, sub, frames_k)
-                new_states = jax.tree.map(
-                    lambda full, ns: full.at[idx].set(ns), states, new_sub
-                )
-                # scatter into a full-capacity output so callers keep
-                # indexing by slot id (inactive rows are zeros, discarded)
-                full_out = jnp.zeros(
-                    (self.max_peers,) + out.shape[1:], out.dtype
-                ).at[idx].set(out)
-                return new_states, full_out
-
-            step = jax.jit(bucket, donate_argnums=(1,))
+            step = jax.jit(
+                make_bucket_step(vstep, self.max_peers), donate_argnums=(1,)
+            )
             self._bucket_steps[(k, variant)] = step
             logger.info(
                 "multipeer bucket step for %d/%d active slots (%s) "

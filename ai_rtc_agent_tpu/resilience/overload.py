@@ -90,11 +90,17 @@ class DeadlineQueue:
         deadline_s: float = 0.0,
         clock=time.monotonic,
         on_shed=None,
+        on_evict=None,
     ):
         self.bound = max(1, int(bound))
         self.deadline_s = deadline_s
         self._clock = clock
         self._on_shed = on_shed  # callable(reason, n) — metrics hook
+        # callable(item, reason) — hands every shed ITEM back to the owner
+        # (outside the lock).  The batch scheduler needs this: a shed frame
+        # carries a waiter future that must resolve as passthrough, not
+        # vanish inside the queue (stream/scheduler.py coalescing window)
+        self._on_evict = on_evict
         self._lock = threading.Lock()
         self._q: collections.deque = collections.deque(maxlen=self.bound)
         self.shed_overflow = 0
@@ -106,37 +112,49 @@ class DeadlineQueue:
 
     def push(self, item, stamp: float | None = None) -> bool:
         """Append ``item``; -> True when the bound forced a shed."""
-        shed = False
+        shed = None
         with self._lock:
             if len(self._q) >= self.bound:
                 # freshest-frame-wins: the OLDEST queued entry is the one
                 # whose delivery value has decayed furthest — drop it, keep
                 # the newcomer (never drop-new, never block)
-                self._q.popleft()
+                shed = self._q.popleft()
                 self.shed_overflow += 1
-                shed = True
             self._q.append((item, self._clock() if stamp is None else stamp))
-        if shed and self._on_shed is not None:
-            self._on_shed("overflow", 1)
-        return shed
+        if shed is not None:
+            if self._on_evict is not None:
+                self._on_evict(shed[0], "overflow")
+            if self._on_shed is not None:
+                self._on_shed("overflow", 1)
+        return shed is not None
 
     def pop(self):
         """-> (item, stamp) of the oldest in-deadline entry, or None."""
-        stale = 0
+        stale = []
         out = None
         with self._lock:
             now = self._clock()
             while self._q:
                 item, stamp = self._q.popleft()
                 if self.deadline_s and now - stamp > self.deadline_s:
-                    stale += 1
+                    stale.append(item)
                     continue
                 out = (item, stamp)
                 break
-            self.shed_stale += stale
-        if stale and self._on_shed is not None:
-            self._on_shed("stale", stale)
+            self.shed_stale += len(stale)
+        if stale:
+            if self._on_evict is not None:
+                for item in stale:
+                    self._on_evict(item, "stale")
+            if self._on_shed is not None:
+                self._on_shed("stale", len(stale))
         return out
+
+    def oldest_stamp(self) -> float | None:
+        """Enqueue stamp of the oldest queued entry (None when empty) —
+        the batch scheduler's coalescing window is measured from this."""
+        with self._lock:
+            return self._q[0][1] if self._q else None
 
     def clear(self):
         with self._lock:

@@ -574,6 +574,15 @@ class ResilientPipeline:
         # before every engine call; a refused frame is delivered passthrough
         # (the stream thins under load instead of queueing stale work)
         self.throttle = None
+        # batch-scheduler sessions (stream/scheduler.py) feed the admission
+        # step-EWMA themselves with PER-BATCH-AMORTIZED latency (dt / batch
+        # occupancy) — feeding the raw submit->fetch duration here too would
+        # overstate per-session cost by the batch width, erasing exactly the
+        # capacity gain batching buys.  Timeouts still feed (a wedge is a
+        # wedge regardless of who owns the healthy-step signal).
+        self._owns_step_signal = bool(
+            getattr(pipeline, "owns_step_signal", False)
+        )
         self._runner = _StepRunner()
         # teardown rides the supervisor's stop() so the agent's session
         # cleanup releases the worker without holding a wrapper reference
@@ -678,6 +687,8 @@ class ResilientPipeline:
         # session start, 503ing concurrent offers and walking live ladders
         # up — only steady-state steps measure capacity
         if self._steps <= self._warm_steps:
+            return
+        if self._owns_step_signal:
             return
         t = self.throttle
         if t is not None:

@@ -329,27 +329,59 @@ def _release_admission(app, session_key: str):
         ov.release_admission(session_key)
 
 
-async def _claim_pipeline(app):
+def _slots_full_text(app) -> str:
+    """Name the serving plane whose slot pool refused — an operator
+    debugging 503s on a non-multipeer box must not be pointed at peer
+    slots that don't exist (the default path's pool is the batch
+    scheduler's session slots)."""
+    if app.get("multipeer_pipeline") is not None:
+        return "all peer slots in use"
+    return "all batch-scheduler session slots in use"
+
+
+async def _claim_pipeline(app, session_key: str | None = None):
     """-> (pipeline, release_fn).  In --multipeer mode each connection
     claims a slot of the batched engine (503 via CapacityError when full);
-    otherwise every connection shares the single pipeline (reference
-    semantics, agent.py:423).  Claim runs a prepare() (text-encode + UNet
-    stock pass), so it is pushed off the event loop; the returned release_fn
-    is loop-safe (schedules its work on a thread)."""
+    with the continuous batch scheduler active (the default single-device
+    path) each connection claims a scheduler session — per-session stream
+    state batched into one cross-session device step; otherwise every
+    connection shares the single pipeline (reference semantics,
+    agent.py:423).  Claim runs a prepare() (text-encode + UNet stock
+    pass), so it is pushed off the event loop; the returned release_fn is
+    loop-safe (schedules its work on a thread)."""
     mp = app.get("multipeer_pipeline")
-    if mp is None:
+    sched = app.get("batch_scheduler")
+    if mp is None and sched is None:
         return app["pipeline"], lambda: None
     from .multipeer_serving import CapacityError
 
+    if mp is not None:
+        try:
+            peer = await asyncio.to_thread(mp.claim)
+        except CapacityError:
+            return None, None
+
+        def release():
+            asyncio.ensure_future(asyncio.to_thread(peer.release))
+
+        return peer, release
+
     try:
-        peer = await asyncio.to_thread(mp.claim)
+        session = await asyncio.to_thread(sched.claim, session_key)
     except CapacityError:
         return None, None
+    ov = app.get("overload")
+    if ov is not None and session_key is not None:
+        # the session's coalescing-window queue joins the /metrics queue
+        # registry; unregistered with the session (":<key>" suffix rule)
+        ov.register_queue(
+            f"batchwin:{session_key}", session.window_queue
+        )
 
-    def release():
-        asyncio.ensure_future(asyncio.to_thread(peer.release))
+    def release_session():
+        asyncio.ensure_future(asyncio.to_thread(session.release))
 
-    return peer, release
+    return session, release_session
 
 
 # ---------------------------------------------------------------------------
@@ -373,10 +405,10 @@ async def offer(request):
     rejected = _admission_gate(app, stream_id)
     if rejected is not None:
         return rejected
-    pipeline, release_pipeline = await _claim_pipeline(app)
+    pipeline, release_pipeline = await _claim_pipeline(app, stream_id)
     if pipeline is None:
         _release_admission(app, stream_id)
-        return _overloaded_response(app, "all peer slots in use")
+        return _overloaded_response(app, _slots_full_text(app))
     # everything between the claim and the connection handlers taking over
     # must release the slot on failure — a leaked slot is permanent 503s
     pc = None
@@ -622,10 +654,10 @@ async def whip(request):
     rejected = _admission_gate(app, session_id)
     if rejected is not None:
         return rejected
-    pipeline, release_pipeline = await _claim_pipeline(app)
+    pipeline, release_pipeline = await _claim_pipeline(app, session_id)
     if pipeline is None:
         _release_admission(app, session_id)
-        return _overloaded_response(app, "all peer slots in use")
+        return _overloaded_response(app, _slots_full_text(app))
 
     pc = None
 
@@ -741,7 +773,16 @@ async def update_config(request):
     except (ValueError, LookupError):
         return web.Response(status=400, text="invalid JSON body")
     logger.info("received config: %s", config)
-    target = request.app.get("multipeer_pipeline") or request.app["pipeline"]
+    # the operator surface targets the serving plane actually in use:
+    # multipeer slots, else the batch scheduler (applies to every live
+    # session AND becomes the default for future claims — the shared-
+    # pipeline semantics operators already rely on), else the shared
+    # pipeline itself
+    target = (
+        request.app.get("multipeer_pipeline")
+        or request.app.get("batch_scheduler")
+        or request.app["pipeline"]
+    )
     encoders = _encoder_surface(request.app.get("provider"))
     try:
         await asyncio.to_thread(apply_runtime_config, target, config, encoders)
@@ -775,6 +816,11 @@ async def health_detail(request):
         for k, na in ov.netadapt.items():
             if k in sessions:
                 sessions[k]["netadapt"] = na.snapshot()
+    sched = app.get("batch_scheduler")
+    if sched is not None:
+        for k, snap in sched.session_snapshots().items():
+            if k in sessions:
+                sessions[k]["batchsched"] = snap
     body = {
         "status": worst_state(s["state"] for s in sessions.values()),
         "sessions": sessions,
@@ -794,7 +840,13 @@ async def capacity(request):
     admission is currently refusing; ``retry_after_s``: backpressure hint."""
     app = request.app
     mp = app.get("multipeer_pipeline")
-    free = mp.free_slots if mp is not None else None
+    sched = app.get("batch_scheduler")
+    if mp is not None:
+        free = mp.free_slots
+    elif sched is not None:
+        free = sched.free_slots
+    else:
+        free = None
     ov = app.get("overload")
     if ov is None:
         return web.json_response(
@@ -933,6 +985,12 @@ async def metrics(request):
         if mp is not None:
             out["overload_peer_frames_shed"] = mp.frames_shed
         out.update(ov.snapshot())
+    # continuous batch scheduler (stream/scheduler.py): occupancy
+    # histogram + window-wait percentiles — the cost-per-user story's
+    # primary gauges, O(1) reads like everything else here
+    sched = request.app.get("batch_scheduler")
+    if sched is not None:
+        out.update(sched.snapshot())
     # tracing / flight recorder (obs/): cheap int reads, like the overload
     # snapshot — observability endpoints must survive the incidents they
     # exist to explain
@@ -1098,6 +1156,30 @@ async def on_startup(app):
             controlnet=app.get("controlnet"),
             mesh=mesh,
         )
+        # Continuous batch scheduler (stream/scheduler.py): the DEFAULT
+        # single-device serving path — concurrent sessions coalesce into
+        # one vmapped device step instead of serializing through the
+        # shared engine.  BATCHSCHED=0 kill-switch restores the shared
+        # pipeline; tp/sp meshes, --fbs and UNET_CACHE keep it (those
+        # batch/cadence axes don't compose with the session axis).
+        if (
+            app.get("batch_scheduler") is None
+            and env.batchsched_enabled()
+            and mesh is None
+            and app["pipeline"].config.frame_buffer_size == 1
+            and app["pipeline"].config.unet_cache_interval < 2
+        ):
+            from ..stream.scheduler import BatchScheduler
+
+            try:
+                app["batch_scheduler"] = BatchScheduler.from_pipeline(
+                    app["pipeline"]
+                )
+            except Exception:
+                logger.exception(
+                    "batch scheduler unavailable — serving the shared "
+                    "single-engine path"
+                )
     app["pcs"] = set()
     app["supervisors"] = {}
     app["stream_event_handler"] = StreamEventHandler()
@@ -1146,6 +1228,15 @@ async def on_startup(app):
         await ov.start()
     else:
         app["overload"] = None
+    sched = app.get("batch_scheduler")
+    if sched is not None and app["overload"] is not None:
+        # overload joins at batch composition: the admission step-EWMA is
+        # fed PER-BATCH-AMORTIZED latency (dt / occupancy), so advertised
+        # capacity reflects the batching gain — N coalesced sessions cost
+        # one step, not N (the resilient wrapper skips its own raw feed
+        # for scheduler sessions: owns_step_signal)
+        admission = app["overload"].admission
+        sched.on_step = lambda dt_s, occ: admission.note_step_latency(dt_s)
 
 
 async def on_shutdown(app):
@@ -1164,6 +1255,9 @@ async def on_shutdown(app):
     mp = app.get("multipeer_pipeline")
     if mp is not None:
         mp.close()
+    sched = app.get("batch_scheduler")
+    if sched is not None:
+        sched.close()
 
 
 def build_app(
@@ -1175,6 +1269,7 @@ def build_app(
     annotator: str | None = None,
     multipeer: int = 0,
     multipeer_pipeline=None,
+    batch_scheduler=None,
     tp: int = 0,
     sp: int = 0,
     fbs: int = 0,
@@ -1189,6 +1284,7 @@ def build_app(
     app["pipeline"] = pipeline  # injectable for tests; built on startup if None
     app["multipeer"] = multipeer
     app["multipeer_pipeline"] = multipeer_pipeline  # injectable for tests
+    app["batch_scheduler"] = batch_scheduler  # injectable for tests
     app["tp"] = tp
     app["sp"] = sp
     app["fbs"] = fbs

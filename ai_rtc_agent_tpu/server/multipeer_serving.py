@@ -63,17 +63,15 @@ class PeerPipeline:
             # output — skip the safety checker / processed-wrap and keep
             # the marker so the caller can account it as passthrough
             return out
-        if self._owner.safety_checker is not None:
-            out = self._owner.safety_checker(out)
         # same output-type contract as the single-peer pipeline fetch
-        # (stream/pipeline.py): HW_ENCODE serving hands the track layer bare
-        # ndarrays in BOTH modes (ADVICE r2 — identical config must not
-        # yield different frame types across serving modes)
-        if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
-            from ..media.frames import wrap_processed
+        # (stream/pipeline.py finish_output): HW_ENCODE serving hands the
+        # track layer bare ndarrays in BOTH modes (ADVICE r2 — identical
+        # config must not yield different frame types across serving modes)
+        from ..stream.pipeline import finish_output
 
-            return wrap_processed(out, src_frame)
-        return out
+        return finish_output(
+            out, src_frame, safety_checker=self._owner.safety_checker
+        )
 
     def __call__(self, frame):
         # a shed resolves as a ShedFrame marker here too — the timing /

@@ -512,6 +512,62 @@ def stream_engine_key(model_id: str, cfg: StreamConfig, **extra) -> str:
     )
 
 
+class SimilarityFilter:
+    """Host-side STOCHASTIC similar-image filter — the fork's
+    SimilarImageFilter semantics (reference lib/wrapper.py:192-195):
+    cosine similarity between consecutive (subsampled) frames; the skip
+    probability ramps linearly from 0 at the threshold to 1 at sim=1,
+    sampled per frame, with a max-skip guard so a static scene still
+    refreshes.  An identical frame (sim=1) always skips; anything at or
+    below the threshold never does — the stochastic band between keeps
+    slow pans alive instead of hard-freezing them at a cliff.
+
+    One instance per STREAM: the engine owns one for the shared-pipeline
+    path, and every batch-scheduler session (stream/scheduler.py) owns its
+    own so one session's static scene never skips another session's
+    frames."""
+
+    def __init__(self, threshold: float, max_skip: int, seed: int = 0):
+        self.threshold = threshold
+        self.max_skip = max_skip
+        self._rng = np.random.default_rng(seed)
+        self._prev_small = None
+        self._skip_count = 0
+
+    def should_skip(self, frame_u8, have_output: bool) -> bool:
+        """True when this frame should duplicate the previous output
+        instead of stepping the engine.  ``have_output``: a previous
+        output exists to duplicate (never skip before the first frame)."""
+        # subsample BEFORE the float cast: touch ~1/256 of the pixels, not
+        # a full-frame float32 copy per submitted frame (hot path)
+        small = np.asarray(frame_u8)[..., ::16, ::16, :].astype(np.float32)
+        if self._prev_small is not None and have_output:
+            a = small.ravel()
+            b = self._prev_small.ravel()
+            na, nb = float(np.linalg.norm(a)), float(np.linalg.norm(b))
+            if na > 0.0 and nb > 0.0:
+                sim = float(a @ b) / (na * nb)
+            else:
+                # an all-black frame is only "similar" to another all-black
+                # frame — never to arbitrary content (a fade to black must
+                # not freeze the stream on stale frames)
+                sim = 1.0 if na == nb else 0.0
+            thr = self.threshold
+            prob = (
+                0.0 if thr >= 1.0
+                else max(0.0, 1.0 - (1.0 - sim) / (1.0 - thr))
+            )
+            if (
+                self._rng.random() < prob
+                and self._skip_count < self.max_skip
+            ):
+                self._skip_count += 1
+                return True
+        self._prev_small = small
+        self._skip_count = 0
+        return False
+
+
 def _annotate(img01_nhwc, cfg: StreamConfig, params=None):
     """In-graph conditioning annotator.
 
@@ -625,7 +681,6 @@ class StreamEngine:
             self._step = _jit(_wrap_sp(make_step_fn(models, cfg)))
             self._step_cached = None
         self.state = None
-        self._skip_count = 0
         self._last_out = None
         self._last_submitted = None
         # observability flag (obs/trace.py): True when the most recent
@@ -643,8 +698,9 @@ class StreamEngine:
         from ..resilience import faults as _faults
 
         self._fault_scope = _faults.scope("engine")
-        self._prev_frame_small = None
-        self._skip_rng = np.random.default_rng(0)  # similarity-filter draws
+        self._sim_filter = SimilarityFilter(
+            cfg.similar_image_threshold, cfg.similar_image_max_skip, seed=0
+        )
         # submit() is a read-modify-write of self.state; concurrent tracks
         # (several connections sharing one pipeline, each stepping on a
         # worker thread) must serialize it.  The reference gets this for
@@ -903,45 +959,30 @@ class StreamEngine:
         return out
 
     def _maybe_skip(self, frame_u8) -> bool:
-        """Host-side STOCHASTIC similar-image filter — the fork's
-        SimilarImageFilter semantics (reference lib/wrapper.py:192-195):
-        cosine similarity between consecutive (subsampled) frames; the skip
-        probability ramps linearly from 0 at the threshold to 1 at sim=1,
-        sampled per frame, with a max-skip guard so a static scene still
-        refreshes.  An identical frame (sim=1) always skips; anything at or
-        below the threshold never does — the stochastic band between keeps
-        slow pans alive instead of hard-freezing them at a cliff.
+        """One :class:`SimilarityFilter` draw under the submit lock.
         Skipping avoids the device call entirely (the real saving — an
         in-graph select would still burn the FLOPs)."""
-        # subsample BEFORE the float cast: touch ~1/256 of the pixels, not a
-        # full-frame float32 copy per submitted frame (hot path, under the
-        # submit lock)
-        small = np.asarray(frame_u8)[..., ::16, ::16, :].astype(np.float32)
-        if self._prev_frame_small is not None and self._last_out is not None:
-            a = small.ravel()
-            b = self._prev_frame_small.ravel()
-            na, nb = float(np.linalg.norm(a)), float(np.linalg.norm(b))
-            if na > 0.0 and nb > 0.0:
-                sim = float(a @ b) / (na * nb)
-            else:
-                # an all-black frame is only "similar" to another all-black
-                # frame — never to arbitrary content (a fade to black must
-                # not freeze the stream on stale frames)
-                sim = 1.0 if na == nb else 0.0
-            thr = self.cfg.similar_image_threshold
-            prob = (
-                0.0 if thr >= 1.0
-                else max(0.0, 1.0 - (1.0 - sim) / (1.0 - thr))
-            )
-            if (
-                self._skip_rng.random() < prob
-                and self._skip_count < self.cfg.similar_image_max_skip
-            ):
-                self._skip_count += 1
-                return True
-        self._prev_frame_small = small
-        self._skip_count = 0
-        return False
+        return self._sim_filter.should_skip(
+            frame_u8, have_output=self._last_out is not None
+        )
+
+    # back-compat views over the extracted SimilarityFilter state (tests
+    # and diagnostics poke these directly)
+    @property
+    def _skip_count(self) -> int:
+        return self._sim_filter._skip_count
+
+    @_skip_count.setter
+    def _skip_count(self, v: int):
+        self._sim_filter._skip_count = v
+
+    @property
+    def _prev_frame_small(self):
+        return self._sim_filter._prev_small
+
+    @_prev_frame_small.setter
+    def _prev_frame_small(self, v):
+        self._sim_filter._prev_small = v
 
     # -- control plane (no recompiles) -------------------------------------
 

@@ -363,6 +363,25 @@ class StreamDiffusionPipeline:
         return out
 
 
+def finish_output(out, src_frame=None, safety_checker=None, trace=None):
+    """The single home of the output contract every serving plane shares:
+    safety-check the pixels, then wrap pts metadata unless HW_ENCODE
+    serving wants bare ndarrays (stamping the postprocess span when a
+    trace rides along).  Used by the pipelined fetch paths of the batch
+    scheduler (stream/scheduler.py) and --multipeer's PeerPipeline so the
+    contract cannot drift between serving modes."""
+    if safety_checker is not None:
+        out = safety_checker(out)
+    if src_frame is not None and hasattr(src_frame, "pts") and not env.hw_encode():
+        from ..media.frames import wrap_processed
+
+        if trace is None:
+            return wrap_processed(out, src_frame)
+        with trace.span("postprocess"):
+            return wrap_processed(out, src_frame)
+    return out
+
+
 def maybe_load_safety_checker(model_id: str, use: bool | None = None):
     """NSFW-gate loader shared by single- and multi-peer serving (reference
     use_safety_checker, lib/wrapper.py:930-942).  ``use=None`` defers to the

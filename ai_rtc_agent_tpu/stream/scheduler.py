@@ -1,0 +1,1271 @@
+"""Cross-session continuous batching: one jitted step, many sessions.
+
+The stream-batch law (PAPER.md; reference lib/wrapper.py:159-163) buys
+multi-step quality at one UNet pass per frame — but on the default serving
+path that batch axis carries *bubbles*: every non-``--multipeer`` session
+shares one :class:`StreamEngine` and serializes through its submit lock, so
+N sessions cost N sequential device steps.  This module fills the batch
+axis with other users' frames instead:
+
+* Per-session stream state lives in a **stacked pytree** ``[S, ...]``
+  (the :class:`MultiPeerEngine` slot design, made dynamic): prompt
+  embeddings, guidance/delta, stock noise, the latent ring — everything a
+  session owns rides as a batched operand, so sessions keep fully
+  independent control planes.
+* ``submit()`` enqueues ``(session, frame)`` into a short bounded
+  **coalescing window** (a :class:`DeadlineQueue` per slot — the
+  bounded-queue invariant holds; a shed frame's waiter resolves as
+  passthrough immediately).
+* A dispatcher thread drains all waiting sessions into **ONE vmapped
+  jitted step** at the nearest power-of-two bucket geometry
+  (:func:`make_bucket_step` — gather active rows, step, scatter back).
+  Padding repeats the last active row: identical compute, identical
+  scatter writes.
+* Dynamic join/leave never retraces: the bucket geometries are a small
+  fixed set, AOT-compiled through ``aot/cache.py``
+  (``stream_engine_key(..., sbucket=k, sessions=S)``) and warmed at build
+  time (``BATCHSCHED_PREWARM`` / the build CLI's ``--sched-buckets``).
+* Overload joins at **batch composition**: the per-session
+  ``OverloadLadder`` sheds/skips BEFORE a frame enters the window (the
+  resilient wrapper's ``admit_frame`` gate), never mid-batch; and the
+  scheduler feeds the admission step-EWMA **per-batch-amortized** latency
+  (``dt / occupancy``) via :attr:`on_step`, so advertised capacity
+  reflects the batching gain.
+
+Outputs are bit-identical to a dedicated engine per session (pinned by
+tests/test_batch_scheduler.py across join/leave, prompt updates and
+similarity skips): the bucket step applies the SAME pure step function to
+the session's state row that a dedicated engine would apply to its state.
+
+Single-session behavior is pass-through-cheap: with one live session the
+dispatcher never waits out the window — the frame dispatches immediately
+through the k=1 bucket.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import CancelledError, Future, InvalidStateError
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.trace import get_trace, safe_list
+from ..parallel.multipeer import CapacityError, make_bucket_step
+from ..resilience.overload import DeadlineQueue, ShedFrame
+from ..utils import env
+from .engine import SimilarityFilter, StreamEngine, make_step_fn, stream_engine_key
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BatchScheduler", "ScheduledSession", "CapacityError"]
+
+
+class _InlineBatch:
+    """A batch dispatched INLINE on a submitter's thread (every live
+    session had work the moment this frame arrived — no dispatcher
+    handoff, no window).  Each rider's fetch resolves the shared device
+    buffer independently (jax caches the host copy after the first
+    conversion); the first resolver does the per-batch accounting.
+    ``feed``: False when this was a bucket's first (possibly lazily
+    compiled) use — its duration must not reach the admission EWMA."""
+
+    __slots__ = (
+        "out", "entries", "t_dispatch", "occupancy", "resolved", "feed",
+    )
+
+    def __init__(self, out, entries, t_dispatch, occupancy, feed=True):
+        self.out = out
+        self.entries = entries
+        self.t_dispatch = t_dispatch
+        self.occupancy = occupancy
+        self.resolved = False
+        self.feed = feed
+
+
+class _PendingFrame:
+    """One enqueued frame: the waiter future plus the stamps the
+    observability spans need (enqueue -> dispatch = batch_join; dispatch
+    -> resolve = engine_step)."""
+
+    __slots__ = (
+        "frame", "future", "trace", "t_enq", "t_dispatch", "occupancy",
+        "skipped", "inline_out",
+    )
+
+    def __init__(self, frame, trace=None):
+        self.frame = frame
+        self.future: Future = Future()
+        self.trace = trace
+        self.t_enq = time.monotonic()
+        self.t_dispatch: float | None = None
+        self.occupancy = 0
+        self.skipped = False
+        # inline fast path: (batch, row) of an _InlineBatch this frame
+        # rode — resolved directly at fetch, bypassing the future
+        self.inline_out: tuple | None = None
+
+
+class ScheduledSession:
+    """Per-session view over the shared batch scheduler (one claimed slot).
+
+    Duck-types the pipeline surface ``VideoStreamTrack`` / the resilience
+    wrapper expect — ``__call__`` / ``submit`` / ``fetch`` /
+    ``update_prompt`` / ``update_t_index_list`` / ``update_guidance`` /
+    ``restart`` — so the track layer is identical to single-engine
+    serving (the same contract PeerPipeline keeps for ``--multipeer``)."""
+
+    # the scheduler feeds the admission step-EWMA per-batch-amortized
+    # latency itself; the resilient wrapper must not double-feed the raw
+    # submit->fetch duration (resilience/supervisor.py reads this flag)
+    owns_step_signal = True
+
+    def __init__(self, owner: "BatchScheduler", slot: int, session_key: str,
+                 prompt: str, seed: int):
+        self._owner = owner
+        self.slot = slot
+        self.session_key = session_key
+        # live control-plane snapshot — restart() restores THESE, never
+        # module defaults (the restart-defaults invariant)
+        self.prompt = prompt
+        self.guidance_scale = owner.guidance_scale
+        self.delta = owner.delta
+        self.t_index_list = list(owner.t_index_list)
+        self._seed = seed
+        self._released = False
+        cfg = owner.cfg
+        # per-SESSION similarity filter: one session's static scene must
+        # never skip (or perturb) another session's frames — the reason
+        # the shared engine needed a thread-local flag is gone here
+        self._sim = (
+            SimilarityFilter(
+                cfg.similar_image_threshold, cfg.similar_image_max_skip,
+                seed=0,
+            )
+            if cfg.similar_image_filter
+            else None
+        )
+        self._last_pending: _PendingFrame | None = None
+        self._had_output = False
+        self.frames_submitted = 0
+        self.frames_skipped_similar = 0
+
+    # -- pipeline duck-type ---------------------------------------------------
+
+    @property
+    def frame_buffer_size(self) -> int:
+        return 1
+
+    @property
+    def window_queue(self) -> DeadlineQueue:
+        """This session's coalescing-window queue (registered with the
+        overload plane's /metrics queue registry by the agent)."""
+        return self._owner._queues[self.slot]
+
+    def submit(self, frame):
+        """Coerce + enqueue one frame into the coalescing window; returns
+        a handle for :meth:`fetch`.  A similarity skip never enters the
+        window — the handle duplicates the most recent submit's output
+        (same dup discipline as StreamEngine.submit)."""
+        from .pipeline import coerce_frame
+
+        trace = get_trace(frame)
+        if trace is None:
+            arr = coerce_frame(frame, self._owner.height, self._owner.width)
+            return self._submit_arr(arr, trace)
+        with trace.span("submit"):
+            arr = coerce_frame(frame, self._owner.height, self._owner.width)
+            handle = self._submit_arr(arr, trace)
+        if handle.skipped:
+            trace.mark("similar_skip")
+        return handle
+
+    def _submit_arr(self, arr: np.ndarray, trace) -> _PendingFrame:
+        self.frames_submitted += 1
+        if (
+            self._sim is not None
+            and self._sim.should_skip(
+                arr,
+                have_output=self._had_output
+                and self._last_pending is not None,
+            )
+        ):
+            # skip the window entirely: the handle resolves with whatever
+            # the most recent submit resolves with, so resolution order
+            # stays correct even while that step is still in flight
+            self.frames_skipped_similar += 1
+            p = _PendingFrame(arr, trace)
+            p.skipped = True
+            last = self._last_pending
+
+            def _copy(f, p=p, last=last):
+                if f.cancelled():
+                    p.future.cancel()
+                    return
+                exc = f.exception()
+                if exc is not None:
+                    p.future.set_exception(exc)
+                    return
+                p.t_dispatch = last.t_dispatch
+                p.occupancy = last.occupancy
+                p.future.set_result(f.result())
+
+            last.future.add_done_callback(_copy)
+            return p
+        p = _PendingFrame(arr, trace)
+        self._owner._enqueue(self.slot, p)
+        if self._sim is not None:
+            # dup-chain anchor — only the similarity filter ever reads it
+            self._last_pending = p
+        return p
+
+    def fetch(self, handle: _PendingFrame, src_frame=None):
+        """Resolve a submit handle to the session's output frame.
+        ShedFrame markers (window shed under pressure) pass through raw so
+        the resilience wrapper accounts them as passthrough."""
+        trace = handle.trace
+        if trace is None and src_frame is not None:
+            trace = get_trace(src_frame)
+        t0 = time.monotonic()
+        if handle.inline_out is not None:
+            # fast path: resolve the inline batch's buffer right here (the
+            # dedicated-engine flow — submit dispatched, fetch blocks on
+            # readback, zero thread handoffs)
+            batch, row = handle.inline_out
+            out, t1 = self._owner._resolve_inline(batch, row, t0)
+        else:
+            try:
+                out = handle.future.result(timeout=self._owner.fetch_timeout)
+            except CancelledError:
+                # teardown race: the slot was released with this frame
+                # queued — deliver passthrough, never crash the (dying)
+                # track
+                return ShedFrame(handle.frame)
+            if (
+                isinstance(out, tuple)
+                and len(out) == 2
+                and isinstance(out[0], _InlineBatch)
+            ):
+                # this frame was waiting in the window when another
+                # session's submit completed the batch and dispatched it
+                # inline — the marker routes us to the shared buffer
+                out, t1 = self._owner._resolve_inline(out[0], out[1], t0)
+            else:
+                t1 = time.monotonic()
+        if isinstance(out, ShedFrame):
+            return out
+        self._had_output = True
+        if trace is not None:
+            td = handle.t_dispatch
+            if td is not None and not handle.skipped:
+                # batch_join: the coalescing-window wait this frame paid to
+                # ride a wider batch; engine_step: the batch's device
+                # residency (dispatch -> resolve), stamped OUTSIDE jit.
+                # A similarity-skipped dup rode NO batch — its inherited
+                # t_dispatch predates its own enqueue, so stamping these
+                # spans would render negative durations (similar_skip is
+                # its marker instead).
+                trace.add_span("batch_join", handle.t_enq, td)
+                trace.add_span("engine_step", td, t1)
+                if handle.occupancy:
+                    trace.mark(f"batch_k{handle.occupancy}")
+            trace.add_span("fetch", t0, t1)
+        from .pipeline import finish_output
+
+        return finish_output(
+            out, src_frame,
+            safety_checker=self._owner.safety_checker, trace=trace,
+        )
+
+    def __call__(self, frame):
+        return self.fetch(self.submit(frame), frame)
+
+    # -- per-session control plane (no recompiles) ----------------------------
+
+    def update_prompt(self, prompt: str):
+        encoded = self._owner._encode(prompt)  # heavy — outside the step lock
+        self._owner._apply_prompt(self.slot, encoded)
+        self.prompt = prompt
+
+    def update_t_index_list(self, t_index_list):
+        self._owner._apply_t_index(self.slot, t_index_list)
+        self.t_index_list = list(int(t) for t in t_index_list)
+
+    def update_guidance(self, guidance_scale=None, delta=None):
+        g = None if guidance_scale is None else float(guidance_scale)
+        d = None if delta is None else float(delta)
+        self._owner._apply_guidance(self.slot, g, d)
+        if g is not None:
+            self.guidance_scale = g
+        if d is not None:
+            self.delta = d
+
+    def restart(self):
+        """Supervisor recovery hook: a fresh stream state for THIS slot
+        (clearing poisoned latents) on the same compiled bucket
+        executables — the live prompt/guidance/t-indices are restored, not
+        module defaults."""
+        state = self._owner._build_state(
+            self.prompt, self.guidance_scale, self.delta, self._seed,
+            t_index_list=self.t_index_list,
+        )
+        self._owner._install(self.slot, state)
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self._owner.release(self.slot)
+
+    def snapshot(self) -> dict:
+        q = self.window_queue
+        return {
+            "slot": self.slot,
+            "frames_submitted": self.frames_submitted,
+            "frames_skipped_similar": self.frames_skipped_similar,
+            "window_depth": q.depth,
+            "window_shed": q.shed_overflow + q.shed_stale,
+        }
+
+
+class BatchScheduler:
+    """Owns the stacked per-session states, the bucket executables and the
+    coalescing dispatcher; sessions are claimed per connection
+    (:meth:`claim` -> :class:`ScheduledSession`)."""
+
+    def __init__(
+        self,
+        models,
+        params,
+        cfg,
+        encode_prompt,
+        *,
+        model_id: str = "",
+        max_sessions: int | None = None,
+        window_ms: float | None = None,
+        queue_bound: int | None = None,
+        fetch_timeout: float = 120.0,
+        default_prompt: str = "",
+        guidance_scale: float | None = None,
+        delta: float | None = None,
+        schedule=None,
+        safety_checker=None,
+        prewarm: bool | None = None,
+        aot_build_on_miss: bool | None = None,
+        cache_dir: str | None = None,
+    ):
+        from .pipeline import (
+            DEFAULT_DELTA,
+            DEFAULT_GUIDANCE_SCALE,
+            DEFAULT_PROMPT,
+        )
+
+        if cfg.unet_cache_interval >= 2:
+            raise ValueError(
+                "the batch scheduler does not support UNET_CACHE (per-slot "
+                "DeepCache cadence would diverge from dedicated engines); "
+                "use the shared engine or --multipeer"
+            )
+        if cfg.frame_buffer_size != 1:
+            raise ValueError(
+                "the batch scheduler batches SESSIONS; frame_buffer_size "
+                "must stay 1 (--fbs and the scheduler are mutually "
+                "exclusive batch axes)"
+            )
+        self.cfg = cfg
+        self.model_id = model_id
+        self.height, self.width = cfg.height, cfg.width
+        self.max_sessions = (
+            env.get_int("BATCHSCHED_MAX_SESSIONS", 8)
+            if max_sessions is None
+            else int(max_sessions)
+        )
+        if self.max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        self.window_s = (
+            env.get_float("BATCHSCHED_WINDOW_MS", 3.0)
+            if window_ms is None
+            else float(window_ms)
+        ) / 1e3
+        self.queue_bound = (
+            env.get_int("BATCHSCHED_QUEUE_BOUND", 2)
+            if queue_bound is None
+            else int(queue_bound)
+        )
+        self.fetch_timeout = fetch_timeout
+        self.safety_checker = safety_checker
+        # scheduler-level defaults for new sessions; the global /config
+        # surface (update_prompt & co below) moves these so operator
+        # config keeps its pre-scheduler semantics of outliving sessions
+        self.prompt = default_prompt or DEFAULT_PROMPT
+        self.guidance_scale = (
+            DEFAULT_GUIDANCE_SCALE if guidance_scale is None else guidance_scale
+        )
+        self.delta = DEFAULT_DELTA if delta is None else delta
+        self.t_index_list = list(cfg.t_index_list)
+        # amortized admission feed: callable(dt_s, occupancy) — the agent
+        # wires this to the overload plane's step EWMA as dt/occupancy
+        self.on_step = None
+        self.params = params
+        self._template = StreamEngine(
+            models, params, cfg, encode_prompt,
+            schedule=schedule, jit_compile=False,
+        )
+        self._vstep = jax.vmap(make_step_fn(models, cfg), in_axes=(None, 0, 0))
+        S = self.max_sessions
+        sizes, b = [], 1
+        while b < S:
+            sizes.append(b)
+            b *= 2
+        sizes.append(S)
+        self._bucket_sizes = sizes
+        self._bucket_steps: dict = {}
+        # ONE template prepare, tiled: inactive rows are placeholders —
+        # claim() installs a freshly prepared state before any frame runs
+        self._template.prepare(
+            self.prompt, guidance_scale=self.guidance_scale,
+            delta=self.delta, seed=0,
+        )
+        self.states = jax.tree.map(
+            lambda x: jnp.stack([x] * S), self._template.state
+        )
+        self.active = [False] * S
+        self._sessions: dict = {}  # slot -> ScheduledSession
+        self._queues = [
+            DeadlineQueue(self.queue_bound, on_evict=self._evict)
+            for _ in range(S)
+        ]
+        # guards the template engine during heavy builds (text-encode +
+        # prepare); deliberately separate from the step/states lock
+        self._heavy_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._has_work = threading.Condition(self._lock)
+        self._stop = False
+        # in-flight throttles for the inline fast path: dispatcher batches
+        # (counter) + inline batches (bounded ring of _InlineBatch refs;
+        # resolved flags flip at fetch, abandoned batches age out so a
+        # caller that stops fetching degrades to the bounded queue path
+        # instead of wedging the fast path forever)
+        self._dispatcher_inflight = 0
+        self._inline_batches: deque = deque(maxlen=16)
+        self._stats_lock = threading.Lock()
+        # bucket sizes that have completed at least one dispatch (or were
+        # prewarmed/AOT-adopted): a bucket's FIRST use may carry a lazy
+        # jit compile, and compile-sized latency must never feed the
+        # admission EWMA (the ResilientPipeline warm-step rule — every
+        # cold occupancy transition would otherwise 503 concurrent offers)
+        self._warmed_buckets: set = set()
+        # pad-tuple -> device index array: materializing a jnp.int32 array
+        # from a python list costs ~0.4 ms per dispatch on CPU — a real
+        # tax at small step sizes, and the pads repeat heavily (stable
+        # active sets).  Bounded: cleared wholesale if it ever grows past
+        # 512 entries (possible only under pathological churn).
+        self._idx_cache: dict = {}
+        # observability reservoirs (bounded; appended by the dispatcher
+        # only, percentiles computed per snapshot over <=512 floats)
+        self._occ: deque = deque(maxlen=512)
+        self._waits: deque = deque(maxlen=512)
+        self._occ_hist: dict = {}
+        self.steps_total = 0
+        self._aot_adopted = False
+        # warm the bucket geometries so join/leave never retraces at serve
+        # time: adopt serialized engines when the cache has them (build
+        # them with AOT_ENGINES=1 / the build CLI), then optionally
+        # eager-compile whatever is still cold
+        if model_id:
+            try:
+                if self.use_aot_cache(
+                    model_id,
+                    cache_dir=cache_dir,
+                    build_on_miss=(
+                        env.get_bool("AOT_ENGINES", False)
+                        if aot_build_on_miss is None
+                        else aot_build_on_miss
+                    ),
+                ):
+                    logger.info(
+                        "batch scheduler serving from AOT engine cache "
+                        "(buckets %s)", self._bucket_sizes,
+                    )
+            except Exception as e:  # cache trouble must never block serving
+                logger.warning(
+                    "batch-scheduler AOT adoption failed (%s); using jit", e
+                )
+        if prewarm is None:
+            prewarm = env.get_bool("BATCHSCHED_PREWARM", True)
+        if prewarm and not self._aot_adopted:
+            self.prewarm_buckets()
+        self._thread = threading.Thread(
+            target=self._run, name="batchsched-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    @classmethod
+    def from_pipeline(cls, pipeline, **kw) -> "BatchScheduler":
+        """Build a scheduler that serves the same model/config as an
+        already-built :class:`StreamDiffusionPipeline` — the bundle
+        (weights, encode_prompt) and the post-Pallas-probe config are
+        reused, so the scheduler compiles exactly the graphs the probe
+        validated."""
+        eng = pipeline.engine
+        if eng.mesh is not None and any(
+            n > 1 for n in eng.mesh.shape.values()
+        ):
+            raise ValueError(
+                "the batch scheduler is single-device (the session axis "
+                "IS the batch); tp/sp meshes keep the shared-engine path"
+            )
+        return cls(
+            eng.models,
+            eng.params,
+            pipeline.config,
+            eng.encode_prompt,
+            model_id=pipeline.model_id,
+            default_prompt=pipeline.prompt,
+            guidance_scale=pipeline.guidance_scale,
+            delta=pipeline.delta,
+            schedule=eng.schedule,
+            safety_checker=pipeline.safety_checker,
+            **kw,
+        )
+
+    # -- session lifecycle ----------------------------------------------------
+
+    # lock-FREE gauge reads (GIL-atomic list scans, the DeadlineQueue
+    # counter discipline): /capacity and /health read these on the event
+    # loop, which must never queue behind a dispatch — or, with
+    # BATCHSCHED_PREWARM=0, behind a lazy bucket compile — holding _lock
+    @property
+    def free_slots(self) -> int:
+        return self.active.count(False)
+
+    @property
+    def live_sessions(self) -> int:
+        return self.active.count(True)
+
+    def claim(
+        self,
+        session_key: str | None = None,
+        prompt: str | None = None,
+        seed: int | None = None,
+    ) -> ScheduledSession:
+        """Claim a slot for a new connection; raises CapacityError when
+        full (the agent maps it to 503 + Retry-After).  The heavy state
+        build (text-encode + prepare) runs OUTSIDE the step lock so live
+        sessions keep batching while someone joins."""
+        with self._lock:
+            try:
+                slot = self.active.index(False)
+            except ValueError:
+                raise CapacityError(
+                    f"all {self.max_sessions} scheduler session slots in use"
+                ) from None
+            self.active[slot] = True
+        prompt = self.prompt if prompt is None else prompt
+        seed = slot if seed is None else seed
+        try:
+            state = self._build_state(
+                prompt, self.guidance_scale, self.delta, seed,
+                t_index_list=self.t_index_list,
+            )
+        except Exception:
+            with self._lock:
+                self.active[slot] = False
+            raise
+        sess = ScheduledSession(
+            self, slot, session_key or f"slot-{slot}", prompt, seed
+        )
+        try:
+            with self._has_work:
+                self._install_locked(slot, state)
+                self._sessions[slot] = sess
+        except Exception:
+            # a failed install (e.g. states poisoned by a concurrent step
+            # failure) must not leak the slot into permanent 503s
+            with self._lock:
+                self.active[slot] = False
+                self._sessions.pop(slot, None)
+            raise
+        logger.info("batchsched session claimed -> slot %d", slot)
+        return sess
+
+    def release(self, slot: int):
+        if not (0 <= slot < self.max_sessions):
+            raise ValueError(
+                f"slot {slot} out of range [0, {self.max_sessions})"
+            )
+        with self._lock:
+            self.active[slot] = False
+            self._sessions.pop(slot, None)
+        # drain this slot's window outside the step lock; waiters (there
+        # should be none on an orderly teardown) unblock as cancelled
+        q = self._queues[slot]
+        while True:
+            got = q.pop()
+            if got is None:
+                break
+            got[0].future.cancel()
+        logger.info("batchsched session released <- slot %d", slot)
+
+    # -- heavy/cheap state plumbing -------------------------------------------
+
+    def _build_state(self, prompt, guidance, delta, seed, t_index_list=None):
+        from .engine import _coeff_state
+
+        with self._heavy_lock:
+            self._template.prepare(
+                prompt, guidance_scale=guidance, delta=delta, seed=seed
+            )
+            state = self._template.state
+            if t_index_list is not None and tuple(t_index_list) != tuple(
+                self.cfg.t_index_list
+            ):
+                state = dict(state)
+                state["coeffs"] = _coeff_state(
+                    self.cfg, self._template.schedule, tuple(t_index_list)
+                )
+            return state
+
+    def _install(self, slot: int, state):
+        with self._lock:
+            self._install_locked(slot, state)
+
+    def _install_locked(self, slot: int, state):
+        self.states = jax.tree.map(
+            lambda stacked, fresh: stacked.at[slot].set(fresh),
+            self.states, state,
+        )
+
+    def _encode(self, prompt: str):
+        with self._heavy_lock:
+            res = self._template.encode_prompt(prompt)
+            return res if len(res) == 3 else (*res, {})
+
+    def _apply_prompt(self, slot: int, encoded):
+        cond, uncond, extras = encoded
+        dt = self.cfg.jdtype
+        with self._lock:
+            self.states["cond"] = (
+                self.states["cond"].at[slot].set(jnp.asarray(cond, dt))
+            )
+            self.states["uncond"] = (
+                self.states["uncond"].at[slot].set(jnp.asarray(uncond, dt))
+            )
+            if self.cfg.use_added_cond and "pooled" in extras:
+                self.states["added_text"] = (
+                    self.states["added_text"]
+                    .at[slot]
+                    .set(jnp.asarray(extras["pooled"], dt))
+                )
+
+    def _apply_t_index(self, slot: int, t_index_list):
+        from .engine import _coeff_state
+
+        t_index_list = tuple(int(t) for t in t_index_list)
+        if len(t_index_list) != self.cfg.n_stages:
+            raise ValueError(
+                f"t_index_list length must stay {self.cfg.n_stages} "
+                "(compiled batch size)"
+            )
+        coeffs = _coeff_state(self.cfg, self._template.schedule, t_index_list)
+        with self._lock:
+            for k, v in coeffs.items():
+                self.states["coeffs"][k] = (
+                    self.states["coeffs"][k].at[slot].set(v)
+                )
+
+    def _apply_guidance(self, slot: int, guidance, delta):
+        with self._lock:
+            if guidance is not None:
+                self.states["guidance"] = (
+                    self.states["guidance"]
+                    .at[slot]
+                    .set(jnp.asarray(guidance, jnp.float32))
+                )
+            if delta is not None:
+                self.states["delta"] = (
+                    self.states["delta"]
+                    .at[slot]
+                    .set(jnp.asarray(delta, jnp.float32))
+                )
+
+    # -- global control plane (POST /config parity: applies to every live
+    # session AND becomes the default for future ones) ------------------------
+
+    def update_prompt(self, prompt: str):
+        encoded = self._encode(prompt)  # heavy — outside the step lock
+        with self._lock:
+            slots = [s for s, sess in self._sessions.items()]
+        for s in slots:
+            self._apply_prompt(s, encoded)
+            sess = self._sessions.get(s)
+            if sess is not None:
+                sess.prompt = prompt
+        self.prompt = prompt
+
+    def update_t_index_list(self, t_index_list):
+        from .engine import _coeff_state
+
+        t_index_list = [int(t) for t in t_index_list]
+        if len(t_index_list) != self.cfg.n_stages:
+            raise ValueError(
+                f"t_index_list length must stay {self.cfg.n_stages} "
+                "(compiled batch size)"
+            )
+        # validate the values NOW even with zero live sessions — a bad
+        # default must fail this call, not the next claim()
+        _coeff_state(self.cfg, self._template.schedule, tuple(t_index_list))
+        with self._lock:
+            slots = list(self._sessions)
+        for s in slots:
+            self._apply_t_index(s, t_index_list)
+            sess = self._sessions.get(s)
+            if sess is not None:
+                sess.t_index_list = list(int(t) for t in t_index_list)
+        # the operator default outlives sessions (shared-pipeline
+        # semantics): future claims prepare with THESE indices, exactly
+        # like the prompt/guidance defaults above
+        self.t_index_list = list(int(t) for t in t_index_list)
+
+    def update_guidance(self, guidance_scale=None, delta=None):
+        g = None if guidance_scale is None else float(guidance_scale)
+        d = None if delta is None else float(delta)
+        with self._lock:
+            slots = list(self._sessions)
+        for s in slots:
+            self._apply_guidance(s, g, d)
+            sess = self._sessions.get(s)
+            if sess is not None:
+                if g is not None:
+                    sess.guidance_scale = g
+                if d is not None:
+                    sess.delta = d
+        if g is not None:
+            self.guidance_scale = g
+        if d is not None:
+            self.delta = d
+
+    # -- bucket executables ---------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._bucket_sizes:
+            if b >= n:
+                return b
+        return self._bucket_sizes[-1]
+
+    def _idx_for(self, pad):
+        key = tuple(pad)
+        idx = self._idx_cache.get(key)
+        if idx is None:
+            if len(self._idx_cache) > 512:
+                self._idx_cache.clear()
+            idx = jnp.asarray(pad, jnp.int32)
+            self._idx_cache[key] = idx
+        return idx
+
+    def _bucket_step(self, k: int):
+        step = self._bucket_steps.get(k)
+        if step is None:
+            step = jax.jit(
+                make_bucket_step(
+                    self._vstep, self.max_sessions, scatter_output=False
+                ),
+                donate_argnums=(1,),
+            )
+            self._bucket_steps[k] = step
+            logger.info(
+                "batchsched bucket step %d/%d registered (compiles on "
+                "first use unless prewarmed)", k, self.max_sessions,
+            )
+        return step
+
+    def _bucket_specs(self, k: int):
+        spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        return (
+            jax.tree.map(spec, self.params),
+            jax.tree.map(spec, self.states),
+            jax.ShapeDtypeStruct((k, self.height, self.width, 3), jnp.uint8),
+            jax.ShapeDtypeStruct((k,), jnp.int32),
+        )
+
+    def bucket_keys(self, model_id: str | None = None) -> dict:
+        """{bucket size k -> engine-cache key} — the single key recipe
+        shared by serving adoption and the build CLI (``sbucket``/
+        ``sessions`` extend the stream key exactly like ``peers`` does
+        for --multipeer)."""
+        model_id = model_id or self.model_id
+        return {
+            k: stream_engine_key(
+                model_id, self.cfg, sbucket=k, sessions=self.max_sessions
+            )
+            for k in self._bucket_sizes
+        }
+
+    def aot_status(self, model_id: str | None = None,
+                   cache_dir: str | None = None) -> dict:
+        """{bucket size -> already serialized?} via EngineCache.has() —
+        lets the build CLI pre-warm only the missing geometries."""
+        from ..aot.cache import EngineCache
+
+        cache = EngineCache(cache_dir)
+        return {
+            k: cache.has(key, self._bucket_specs(k))
+            for k, key in self.bucket_keys(model_id).items()
+        }
+
+    def use_aot_cache(
+        self, model_id: str | None = None, cache_dir: str | None = None,
+        build_on_miss: bool = True,
+    ) -> bool:
+        """Swap every bucket step for a serialized AOT executable (the
+        StreamEngine.use_aot_cache discipline, one key per bucket
+        geometry).  All-or-nothing: a partial adoption would stall the
+        missing occupancy on a lazy compile mid-serve."""
+        from ..aot.cache import EngineCache
+
+        cache = EngineCache(cache_dir)
+        keys = self.bucket_keys(model_id)
+        if not build_on_miss and not all(
+            cache.has(key, self._bucket_specs(k)) for k, key in keys.items()
+        ):
+            return False
+        calls = {}
+        for k, key in keys.items():
+            call = cache.load_or_build(
+                key,
+                make_bucket_step(
+                    self._vstep, self.max_sessions, scatter_output=False
+                ),
+                self._bucket_specs(k),
+                donate_argnums=(1,),
+                build=build_on_miss,
+            )
+            if call is None:
+                return False
+            calls[k] = call
+        self._bucket_steps.update(calls)
+        self._warmed_buckets.update(calls)
+        self._aot_adopted = True
+        return True
+
+    def prewarm_buckets(self):
+        """Eagerly compile every bucket geometry NOW (jit alone is lazy):
+        occupancy transitions at serve time must dispatch, not compile —
+        a join stalling every live session on a retrace is exactly what
+        this subsystem exists to remove."""
+        for k in self._bucket_sizes:
+            if self._aot_adopted and k in self._bucket_steps:
+                continue
+            params_s, states_s, frames_s, idx_s = self._bucket_specs(k)
+            compiled = (
+                self._bucket_step(k)
+                .lower(params_s, states_s, frames_s, idx_s)
+                .compile()
+            )
+            self._bucket_steps[k] = compiled
+            self._warmed_buckets.add(k)
+            logger.info(
+                "prewarmed batchsched bucket %d/%d", k, self.max_sessions
+            )
+
+    # -- coalescing window + dispatcher ---------------------------------------
+
+    def _evict(self, pending: _PendingFrame, reason: str):
+        """A bounded window queue shed this frame: unblock its waiter with
+        passthrough pixels immediately (recv never hangs), marked so the
+        resilience wrapper never accounts it as an engine step."""
+        fut = pending.future
+        try:
+            if not fut.cancelled() and not fut.done():
+                fut.set_result(ShedFrame(pending.frame))
+        except InvalidStateError:
+            pass  # lost a teardown race — the waiter is unblocked either way
+
+    def _inline_in_flight(self, now: float) -> int:
+        return sum(
+            1
+            for b in self._inline_batches
+            if not b.resolved and now - b.t_dispatch < 60.0
+        )
+
+    def _enqueue(self, slot: int, pending: _PendingFrame):
+        with self._has_work:
+            room = (
+                self._dispatcher_inflight
+                + self._inline_in_flight(pending.t_enq)
+                < self.PIPELINE_DEPTH
+            )
+            if (
+                room
+                and self.active.count(True) == 1
+                and self._queues[slot].depth == 0
+            ):
+                # solo ultra path: one live session, nothing queued ahead
+                # — dispatch THIS frame without touching the window queue
+                # at all (the pass-through-cheap promise: a lock and a
+                # gather/scatter, not a queue round-trip + thread handoff)
+                self._dispatch_entries_locked([(slot, pending)], slot)
+                return
+            self._queues[slot].push(pending, stamp=pending.t_enq)
+            if room and len(self._waiting_slots()) >= self.active.count(
+                True
+            ):
+                # fast path: THIS frame completed the batch (every live
+                # session has work) — dispatch NOW on the caller thread:
+                # no window, no dispatcher handoff; fetch resolves the
+                # shared buffer directly
+                self._dispatch_inline_locked(slot)
+                return
+            self._has_work.notify()
+
+    def _dispatch_inline_locked(self, submitter_slot: int):
+        entries = []
+        for s in self._waiting_slots():
+            got = self._queues[s].pop()
+            if got is not None:
+                entries.append((s, got[0]))
+        if not entries:
+            return
+        self._dispatch_entries_locked(entries, submitter_slot)
+
+    def _step_batch_locked(self, entries):
+        """The ONE dispatch sequence both paths share (dispatcher loop and
+        inline fast path): bucket-select, pad with the last ready row,
+        stack, stamp, step, kick the async readback.  Caller holds the
+        lock; a raising step is the caller's to deliver to the waiters.
+        -> (out, t_disp, occ, feed): ``feed`` False on a bucket's first
+        use (a lazy compile may ride it — not a capacity signal)."""
+        idx = [s for s, _ in entries]
+        k = self._bucket_for(len(idx))
+        pad = (idx + [idx[-1]] * k)[:k]
+        by_slot = {s: p.frame for s, p in entries}
+        frames_k = (
+            entries[0][1].frame[None]
+            if k == 1
+            else np.stack([by_slot[s] for s in pad])
+        )
+        t_disp = time.monotonic()
+        occ = len(entries)
+        for _, p in entries:
+            p.t_dispatch = t_disp
+            p.occupancy = occ
+        feed = k in self._warmed_buckets
+        self.states, out = self._bucket_step(k)(
+            self.params,
+            self.states,
+            jax.device_put(frames_k),
+            self._idx_for(pad),
+        )
+        self._warmed_buckets.add(k)
+        try:  # overlap readback with subsequent compute
+            out.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return out, t_disp, occ, feed
+
+    @staticmethod
+    def _fail_entries(entries, exc):
+        for _, p in entries:
+            if not p.future.cancelled():
+                try:
+                    p.future.set_exception(exc)
+                except InvalidStateError:
+                    pass
+
+    def _recover_states_locked(self, cause):
+        """A failed step invalidated the DONATED stacked state — left
+        alone, every later dispatch and control-plane write would raise
+        'Array has been deleted' forever (the dedicated-engine path
+        recovers via restart()->prepare(); the scheduler must too).
+        Rebuild every live session's row from its tracked control plane
+        (a fresh stream state — the engine-restart recovery semantics);
+        inactive rows share one placeholder.  Best-effort: if the model
+        itself is broken this raises nothing and leaves the next dispatch
+        to surface it."""
+        try:
+            placeholder = None
+            per = []
+            for slot in range(self.max_sessions):
+                sess = self._sessions.get(slot) if self.active[slot] else None
+                if sess is not None:
+                    per.append(
+                        self._build_state(
+                            sess.prompt, sess.guidance_scale, sess.delta,
+                            sess._seed, t_index_list=sess.t_index_list,
+                        )
+                    )
+                else:
+                    if placeholder is None:
+                        placeholder = self._build_state(
+                            self.prompt, self.guidance_scale, self.delta,
+                            slot, t_index_list=self.t_index_list,
+                        )
+                    per.append(placeholder)
+            self.states = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+            logger.warning(
+                "batchsched: rebuilt %d session state rows after a failed "
+                "step (%r)", self.max_sessions, cause,
+            )
+        except Exception:
+            logger.exception(
+                "batchsched state recovery failed — sessions will "
+                "passthrough until restart/reclaim"
+            )
+
+    def _dispatch_entries_locked(self, entries, submitter_slot: int):
+        try:
+            out, t_disp, occ, feed = self._step_batch_locked(entries)
+        except Exception as e:
+            # an inline dispatch failing must unblock EVERY rider's future
+            # (the other sessions' fetches would otherwise hang out the
+            # full fetch timeout) and surface in the submitter's track
+            self._fail_entries(entries, e)
+            self._recover_states_locked(e)
+            raise
+        batch = _InlineBatch(out, entries, t_disp, occ, feed=feed)
+        if any(b.resolved for b in self._inline_batches):
+            # drop resolved batches WHEREVER they sit — the ring exists
+            # only for the in-flight count, and a resolved batch kept
+            # behind an unresolved head would pin its input frames +
+            # output buffer (MBs each at real geometry) until it aged out;
+            # riders still mid-resolve hold their own refs via the handle
+            self._inline_batches = deque(
+                (b for b in self._inline_batches if not b.resolved),
+                maxlen=self._inline_batches.maxlen,
+            )
+        self._inline_batches.append(batch)
+        for i, (s, p) in enumerate(entries):
+            p.inline_out = (batch, i)
+            # other sessions may ALREADY be blocked on their future (their
+            # frame sat in the window when this dispatch claimed it) — a
+            # marker result wakes them into the shared-buffer resolve.
+            # The submitter's own entry skips the Future machinery unless
+            # a similarity-skip dup may chain off it.
+            sess = self._sessions.get(s)
+            if s != submitter_slot or (
+                sess is not None and sess._sim is not None
+            ):
+                try:
+                    if not p.future.cancelled():
+                        p.future.set_result((batch, i))
+                except InvalidStateError:
+                    pass
+
+    def _resolve_inline(self, batch: _InlineBatch, row: int, t0: float):
+        """Resolve one rider of an inline batch against the shared device
+        buffer (jax caches the host copy, so concurrent riders pay one
+        readback between them); the first resolver does the per-batch
+        accounting."""
+        arr = np.asarray(batch.out)
+        if arr.ndim == 5 and arr.shape[1] == 1:
+            arr = arr[:, 0]
+        out = arr[row]
+        t1 = time.monotonic()
+        first = False
+        with self._lock:
+            if not batch.resolved:
+                batch.resolved = True
+                first = True
+        if first:
+            # step-cost estimate for the admission EWMA: dispatch->resolve
+            # OVERSTATES when the caller pipelines (frame N's fetch runs an
+            # inter-frame interval after its dispatch — an idle 10 fps solo
+            # box would read as a 100 ms "step" and 503 new offers), while
+            # the observed BLOCKING time (t1 - t0) understates by the
+            # pre-fetch head start.  The min of the two is exact whenever
+            # the device is the bottleneck (fetch arrives before compute
+            # finishes) and near-zero when the box is idle — both correct
+            # directions for a capacity signal.
+            self._note_step(
+                min(t1 - batch.t_dispatch, t1 - t0),
+                batch.occupancy,
+                batch.entries,
+                feed=batch.feed,
+            )
+        return out, t1
+
+    def _waiting_slots(self):
+        return [
+            s
+            for s in range(self.max_sessions)
+            if self.active[s] and self._queues[s].depth > 0
+        ]
+
+    def _oldest_enqueue(self, waiting):
+        stamps = [
+            t
+            for t in (self._queues[s].oldest_stamp() for s in waiting)
+            if t is not None
+        ]
+        return min(stamps) if stamps else None
+
+    # keep up to this many batch steps in flight: step N's readback
+    # overlaps step N+1's dispatch (same rationale as the single-engine
+    # submit/fetch pipeline and the multipeer coordinator)
+    PIPELINE_DEPTH = 2
+
+    def _run(self):
+        inflight: deque = deque(maxlen=self.PIPELINE_DEPTH)
+        while True:
+            with self._has_work:
+                while not self._stop:
+                    waiting = self._waiting_slots()
+                    if not waiting:
+                        if inflight:
+                            break  # drain the readback below
+                        self._has_work.wait(timeout=0.5)
+                        continue
+                    live = self.active.count(True)
+                    if (
+                        len(waiting) >= live
+                        or live <= 1
+                        or self.window_s <= 0.0
+                    ):
+                        # every live session has work (or there's nobody
+                        # to wait for): dispatch NOW — the single-session
+                        # fast path never pays the window
+                        break
+                    oldest = self._oldest_enqueue(waiting)
+                    remain = (
+                        0.0
+                        if oldest is None
+                        else oldest + self.window_s - time.monotonic()
+                    )
+                    if remain <= 0.0:
+                        break  # window expired: go with who showed up
+                    self._has_work.wait(timeout=remain)
+                if self._stop:
+                    break
+                entries = []
+                for s in self._waiting_slots():
+                    got = self._queues[s].pop()
+                    if got is not None:
+                        entries.append((s, got[0]))
+                if entries:
+                    try:
+                        out, t_disp, occ, feed = self._step_batch_locked(
+                            entries
+                        )
+                        inflight.append((out, entries, t_disp, occ, feed))
+                        self._dispatcher_inflight = len(inflight)
+                    except Exception as e:
+                        self._fail_entries(entries, e)
+                        self._recover_states_locked(e)
+                more_waiting = bool(self._waiting_slots())
+            # readback (device->host) outside the lock: control traffic
+            # and the next dispatch proceed while this drains
+            if inflight and (
+                len(inflight) >= self.PIPELINE_DEPTH or not more_waiting
+            ):
+                out, entries, t_disp, occ, feed = inflight.popleft()
+                try:
+                    arr = np.asarray(out)
+                except Exception as e:
+                    with self._lock:
+                        self._dispatcher_inflight = len(inflight)
+                    for _, p in entries:
+                        if not p.future.cancelled():
+                            p.future.set_exception(e)
+                    continue
+                if arr.ndim == 5 and arr.shape[1] == 1:  # [k, fbs=1, H, W, 3]
+                    arr = arr[:, 0]
+                self._note_step(
+                    time.monotonic() - t_disp, occ, entries, feed=feed
+                )
+                # k-shaped output: entries[i] rode batch row i (padding
+                # rows, if any, sit past len(entries) and are discarded)
+                for i, (_s, p) in enumerate(entries):
+                    if not p.future.cancelled():
+                        p.future.set_result(arr[i])
+                with self._lock:
+                    self._dispatcher_inflight = len(inflight)
+        # drain on stop
+        while inflight:
+            _, entries, _, _, _ = inflight.popleft()
+            for _, p in entries:
+                p.future.cancel()
+        for q in self._queues:
+            while True:
+                got = q.pop()
+                if got is None:
+                    break
+                got[0].future.cancel()
+
+    def _note_step(self, dt_s: float, occupancy: int, entries, feed=True):
+        with self._stats_lock:  # dispatcher + inline-fetch callers
+            self.steps_total += 1
+            self._occ.append(occupancy)
+            # copy-on-new-key: snapshot() iterates this dict WITHOUT the
+            # stats lock (it must never block on a dispatch) — replacing
+            # the dict wholesale when a new occupancy first appears keeps
+            # every published dict iteration-safe forever after
+            if occupancy in self._occ_hist:
+                self._occ_hist[occupancy] += 1
+            else:
+                hist = dict(self._occ_hist)
+                hist[occupancy] = 1
+                self._occ_hist = hist
+            for _, p in entries:
+                if p.t_dispatch is not None:
+                    self._waits.append(p.t_dispatch - p.t_enq)
+        cb = self.on_step
+        if cb is not None and feed:
+            # feed=False on a bucket's first use: a lazy compile may ride
+            # that step, and compile time is not capacity (the warm-step
+            # rule ResilientPipeline applies to its own EWMA feed)
+            try:
+                # per-batch-amortized: N sessions riding one step cost
+                # dt/N each — THE number advertised capacity must reflect
+                cb(dt_s / max(1, occupancy), occupancy)
+            except Exception:
+                logger.exception("batchsched on_step hook failed")
+
+    def close(self):
+        with self._has_work:
+            self._stop = True
+            self._has_work.notify()
+        self._thread.join(timeout=10)
+
+    # -- observability --------------------------------------------------------
+
+    @staticmethod
+    def _percentile(sorted_vals, frac):
+        n = len(sorted_vals)
+        return sorted_vals[min(n - 1, int(n * frac))]
+
+    def snapshot(self) -> dict:
+        """/metrics gauges — O(1) int reads + two <=512-float reservoirs
+        (safe_list: the obs retry-copy idiom for lock-free appenders),
+        never a frame-queue traversal."""
+        occ = sorted(safe_list(self._occ))
+        waits = sorted(safe_list(self._waits))
+        out = {
+            "batchsched_sessions": self.active.count(True),
+            "batchsched_max_sessions": self.max_sessions,
+            "batchsched_steps_total": self.steps_total,
+            "batchsched_window_ms": round(1e3 * self.window_s, 3),
+            "batchsched_occupancy_hist": {
+                str(k): v for k, v in sorted(self._occ_hist.items())
+            },
+        }
+        if occ:
+            out["batchsched_occupancy_p50"] = self._percentile(occ, 0.5)
+            out["batchsched_occupancy_max"] = occ[-1]
+        if waits:
+            out["batchsched_window_wait_ms_p50"] = round(
+                1e3 * self._percentile(waits, 0.5), 3
+            )
+            out["batchsched_window_wait_ms_p99"] = round(
+                1e3 * self._percentile(waits, 0.99), 3
+            )
+        return out
+
+    def session_snapshots(self) -> dict:
+        """{session_key -> per-session scheduler view} for /health —
+        lock-free like the gauges above (safe_list retries the racy dict
+        copy instead of queueing the event loop behind a dispatch)."""
+        sessions = safe_list(self._sessions.values())
+        return {sess.session_key: sess.snapshot() for sess in sessions}

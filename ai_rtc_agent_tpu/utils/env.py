@@ -128,6 +128,15 @@ def hw_decode() -> bool:
     return get_bool("HW_DECODE", get_bool("NVDEC", False))
 
 
+def batchsched_enabled() -> bool:
+    """Continuous cross-session batch scheduler (stream/scheduler.py) —
+    the default single-device serving path.  BATCHSCHED=0 restores the
+    shared single-engine pipeline (sessions serialize through one
+    submit lock); the remaining BATCHSCHED_* knobs are read by the
+    scheduler itself."""
+    return get_bool("BATCHSCHED", True)
+
+
 def pipeline_depth() -> int:
     """Frames kept in flight on the device per track (PIPELINE_DEPTH).
 

@@ -1,0 +1,219 @@
+"""Continuous batch scheduler amortization: batched vs serialized sessions.
+
+Measures the cost-per-user lever ROADMAP open item 1 names: today N
+concurrent sessions share one ``StreamEngine`` and serialize through its
+submit lock (N sequential device steps per wall-clock frame tick); the
+batch scheduler (stream/scheduler.py) coalesces them into ONE vmapped
+step.  Two legs on the hermetic tiny model (single-stage turbo config —
+the per-step dispatch overhead the scheduler amortizes is the same host
+machinery at every model scale; on real accelerators the batch
+additionally rides idle matrix-unit capacity):
+
+  serialized: 4 sessions' frames through the shared engine, back to back
+              (the pre-scheduler serving path, measured end to end).
+  batched:    the same 4 frames through a real BatchScheduler — 4
+              submits coalesce into one k=4 bucket step.
+
+Plus the single-session guard: ONE session through the scheduler
+(dispatcher thread, window bypass, future resolution) vs the engine
+called directly — the pass-through-cheap promise as a measured overhead
+percentage.
+
+Prints ONE JSON line (bank-and-commit contract) and appends it to
+PERF_LOG.jsonl (PERF_LOG_PATH overrides; empty value disables).
+
+Env knobs: BATCHSCHED_BENCH_FRAMES (default 16 per rep), BATCHSCHED_BENCH_PAIRS (default 24), BATCHSCHED_BENCH_SESSIONS (default 4; the tier-1 smoke uses 2 to halve compile cost).
+"""
+
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+FRAMES = int(os.getenv("BATCHSCHED_BENCH_FRAMES") or 16)
+PAIRS = int(os.getenv("BATCHSCHED_BENCH_PAIRS") or 24)
+# the acceptance number is measured at 4 sessions; the tier-1 smoke runs
+# 2 (half the bucket compiles) — the metric name carries the count
+SESSIONS = int(os.getenv("BATCHSCHED_BENCH_SESSIONS") or 4)
+
+
+def run() -> dict:
+    import numpy as np
+
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+    from ai_rtc_agent_tpu.stream.scheduler import BatchScheduler
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception  # noqa: F401
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config(
+        "tiny-test", t_index_list=(0,), num_inference_steps=1,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        height=24, width=24,
+    )
+
+    # --- today's path: ONE shared engine, sessions serialize through it
+    engine = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    )
+    engine.prepare("bench prompt", seed=0)
+
+    # --- the scheduler path: 4 claimed sessions, one vmapped bucket step
+    sched = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=SESSIONS, prewarm=True,
+    )
+    sessions = [
+        sched.claim(f"bench-{i}", prompt="bench prompt", seed=i)
+        for i in range(SESSIONS)
+    ]
+
+    rng = np.random.default_rng(7)
+    frames = rng.integers(
+        0, 256, (SESSIONS, cfg.height, cfg.width, 3), dtype=np.uint8
+    )
+
+    # Per-TICK latency amortization: at every wall-clock frame tick all 4
+    # sessions need a result before their next frame.  Today that costs 4
+    # sequential engine steps through the shared submit lock; batched, one
+    # vmapped step.  Each leg runs its tick to completion (submit all,
+    # resolve all) — the latency shape a 30 fps deadline actually imposes.
+    def serialized_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            for j in range(SESSIONS):
+                engine(frames[j])
+        return (time.perf_counter() - t0) / FRAMES
+
+    def batched_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            handles = [s.submit(frames[j]) for j, s in enumerate(sessions)]
+            for s, h in zip(sessions, handles):
+                s.fetch(h)
+        return (time.perf_counter() - t0) / FRAMES
+
+    # Warmup (compiles + pool growth), then MANY SHORT paired reps with
+    # the leg order alternating: this box's throughput swings up to 5x in
+    # sub-second throttle bursts, so absolute times are meaningless — but
+    # two short legs measured adjacently see the same box state, and the
+    # MEDIAN of the paired ratios converges.  Per-leg mins are reported
+    # for the absolute ms fields.
+    def _paired(leg_a, leg_b, reps: int):
+        a_times, b_times, ratios = [], [], []
+        for i in range(reps):
+            if i % 2 == 0:
+                a = leg_a()
+                b = leg_b()
+            else:
+                b = leg_b()
+                a = leg_a()
+            a_times.append(a)
+            b_times.append(b)
+            ratios.append(a / b if b > 0 else 0.0)
+        ratios.sort()
+        return min(a_times), min(b_times), ratios[len(ratios) // 2]
+
+    serialized_rep()
+    batched_rep()
+    serialized_s, batched_s, amortization = _paired(
+        serialized_rep, batched_rep, PAIRS
+    )
+
+    # --- single-session overhead: scheduler machinery vs direct engine
+    for s in sessions[1:]:
+        s.release()
+    solo = sessions[0]
+    f0 = frames[0]
+    solo(f0)
+    engine(f0)
+    def direct_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            engine(f0)
+        return (time.perf_counter() - t0) / FRAMES
+
+    def solo_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            solo(f0)
+        return (time.perf_counter() - t0) / FRAMES
+
+    # the two legs differ by well under the box's throttle jitter — the
+    # paired-ratio median (solo/direct measured adjacently) is the only
+    # stable estimator here; extra pairs because the difference itself
+    # is small
+    solo_s, direct_s, inv_ratio = _paired(solo_rep, direct_rep, 3 * PAIRS)
+    overhead_pct = 100.0 * (inv_ratio - 1.0)
+    sched.close()
+
+    return {
+        "check": "batch_scheduler_bench",
+        "sessions": SESSIONS,
+        "frames": FRAMES,
+        "config": "tiny24-turbo1",
+        "serialized_ms_per_frame": round(1e3 * serialized_s, 2),
+        "batched_ms_per_frame": round(1e3 * batched_s, 2),
+        "serialized_ms_per_session_frame": round(
+            1e3 * serialized_s / SESSIONS, 2
+        ),
+        "batched_ms_per_session_frame": round(1e3 * batched_s / SESSIONS, 2),
+        "single_direct_ms": round(1e3 * direct_s, 2),
+        "single_scheduler_ms": round(1e3 * solo_s, 2),
+        "single_session_overhead_pct": round(overhead_pct, 1),
+        # the contract quartet
+        "metric": f"batchsched_amortization_{SESSIONS}s",
+        "value": round(amortization, 2),
+        "unit": "x",
+        "vs_baseline": round(amortization, 2),
+        "backend": "cpu",
+        "live": True,
+        "label": f"batchsched_{SESSIONS}s_{FRAMES}f",
+        "recorded_at": datetime.now(timezone.utc).isoformat(),
+    }
+
+
+def _bank(entry: dict) -> None:
+    path = os.getenv("PERF_LOG_PATH")
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "PERF_LOG.jsonl",
+        )
+    if not path or path == os.devnull:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        entry["bank_error"] = str(e)
+
+
+def main():
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("batch_scheduler_bench timeout")
+    entry = {
+        "check": "batch_scheduler_bench",
+        "metric": f"batchsched_amortization_{SESSIONS}s",
+        "value": 0.0,
+        "unit": "x",
+        "vs_baseline": 0.0,
+    }
+    try:
+        entry = run()
+        _bank(entry)
+    except BaseException as e:  # the contract line must survive any exit
+        entry["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(entry))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Subprocess driver for the batch-scheduler bit-identity test.
+
+Runs OUTSIDE the test harness's ``--xla_force_host_platform_device_count=8``
+simulation: under that flag XLA's CPU thread partitioning differs between
+the batch-1 and batch-4 graphs, and a float rounding tie can flip one
+uint8 by 1 — on a real single-device runtime (what serving runs) the
+scheduler is bit-identical to dedicated engines, and THIS process asserts
+exactly that.  Prints ``EQUIV_OK <n>`` (n = frame comparisons, all exact)
+or raises on the first mismatch.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import numpy as np  # noqa: E402
+
+from ai_rtc_agent_tpu.models import registry  # noqa: E402
+from ai_rtc_agent_tpu.stream.engine import (  # noqa: E402
+    SimilarityFilter,
+    StreamEngine,
+)
+from ai_rtc_agent_tpu.stream.scheduler import BatchScheduler  # noqa: E402
+
+
+def main():
+    bundle = registry.load_model_bundle("tiny-test")
+    # 8 sub-timesteps with a single stage so update_t_index_list([5]) is a
+    # REAL coefficient change (a 1-step schedule only admits index 0)
+    cfg = registry.default_stream_config(
+        "tiny-test", t_index_list=(2,), num_inference_steps=8,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        similar_image_filter=True, similar_image_threshold=1.0,
+    )
+    sched = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=4, window_ms=2.0, prewarm=False,
+    )
+    engines = [
+        StreamEngine(
+            bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+        )
+        for _ in range(3)
+    ]
+    rng = np.random.default_rng(0)
+    compared = 0
+
+    def frames(n):
+        return [rng.integers(0, 256, (64, 64, 3), np.uint8) for _ in range(n)]
+
+    def step_pairs(sessions, dedicated, fs):
+        nonlocal compared
+        handles = [s.submit(f) for s, f in zip(sessions, fs)]
+        outs = [s.fetch(h) for s, h in zip(sessions, handles)]
+        for out, eng, f in zip(outs, dedicated, fs):
+            np.testing.assert_array_equal(out, eng(f))
+            compared += 1
+
+    e1, e2, e3 = engines
+    s1 = sched.claim("sess-a", prompt="a red cat", seed=11)
+    e1.prepare("a red cat", seed=11)
+    s2 = sched.claim("sess-b", prompt="a blue dog", seed=22)
+    e2.prepare("a blue dog", seed=22)
+
+    # k=2 bucket
+    for _ in range(3):
+        step_pairs([s1, s2], [e1, e2], frames(2))
+
+    # mid-stream JOIN -> padded k=4 bucket
+    s3 = sched.claim("sess-c", prompt="green hills", seed=33)
+    e3.prepare("green hills", seed=33)
+    for _ in range(2):
+        step_pairs([s1, s2, s3], [e1, e2, e3], frames(3))
+
+    # per-session control plane: only the updated session changes
+    s2.update_prompt("a completely different prompt")
+    e2.update_prompt("a completely different prompt")
+    s3.update_guidance(guidance_scale=1.7, delta=0.8)
+    e3.update_guidance(1.7, 0.8)
+    s1.update_t_index_list([5])
+    e1.update_t_index_list([5])
+    for _ in range(2):
+        step_pairs([s1, s2, s3], [e1, e2, e3], frames(3))
+
+    # mid-stream LEAVE: survivors stay bit-exact
+    s2.release()
+    for _ in range(2):
+        step_pairs([s1, s3], [e1, e3], frames(2))
+
+    # down to one: the solo inline fast path
+    s3.release()
+    for _ in range(3):
+        f = frames(1)[0]
+        np.testing.assert_array_equal(s1(f), e1(f))
+        compared += 1
+
+    # rejoin on the freed slot: a fresh state, not the old tenant's
+    s2b = sched.claim("sess-d", prompt="a blue dog", seed=22)
+    e2.prepare("a blue dog", seed=22)
+    step_pairs([s1, s2b], [e1, e2], frames(2))
+
+    # restart() restores the LIVE control plane (t_index [5], not the
+    # config default) on a fresh stream state
+    s1.restart()
+    e1.prepare("a red cat", seed=11)
+    e1.update_t_index_list([5])
+    step_pairs([s1, s2b], [e1, e2], frames(2))
+
+    # similarity skips: per-session filters in lockstep with dedicated
+    # engines; one session's static scene never perturbs the other
+    s1._sim = SimilarityFilter(0.9, 3, seed=0)
+    e1._sim_filter = SimilarityFilter(0.9, 3, seed=0)
+    s2b._sim = SimilarityFilter(0.9, 3, seed=0)
+    e2._sim_filter = SimilarityFilter(0.9, 3, seed=0)
+    static = frames(1)[0]
+    for _ in range(8):
+        fresh = frames(1)[0]
+        step_pairs([s1, s2b], [e1, e2], [static, fresh])
+    assert s1.frames_skipped_similar > 0, "static scene never skipped"
+    assert s2b.frames_skipped_similar == 0, "live scene skipped"
+
+    snap = sched.snapshot()
+    assert snap["batchsched_steps_total"] > 0
+    assert snap["batchsched_occupancy_hist"]
+    sched.close()
+    print(f"EQUIV_OK {compared}")
+
+
+if __name__ == "__main__":
+    main()

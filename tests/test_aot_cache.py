@@ -62,3 +62,32 @@ def test_pytree_args(tmp_path):
     call = cache.load_or_build("engines--tree", f, (state, jnp.ones((3,))))
     out = call(state, jnp.ones((3,)))
     np.testing.assert_allclose(np.asarray(out["a"]), 2 * np.ones((3,)))
+
+
+def test_entries_skips_corrupt_meta(tmp_path, caplog):
+    """ISSUE 7 satellite: one truncated/corrupt meta JSON (crashed build,
+    partial copy) must not crash the whole listing — the bad entry is
+    skipped with a warning and every readable entry still reports."""
+    import json
+    import logging
+    import os
+
+    cache = EngineCache(cache_dir=str(tmp_path))
+    call = cache.load_or_build(
+        "engines--good", lambda x: x + 1, (jnp.ones((2,)),)
+    )
+    assert call is not None
+    # a second key whose meta is truncated mid-write
+    bad_dir = os.path.join(str(tmp_path), "engines--bad")
+    os.makedirs(bad_dir)
+    with open(os.path.join(bad_dir, "deadbeef.json"), "w") as f:
+        f.write('{"key": "engines--bad", "plat')  # truncated
+    with caplog.at_level(logging.WARNING):
+        entries = cache.entries()
+    assert [e["key"] for e in entries] == ["engines--good"]
+    assert any("unreadable engine meta" in r.message for r in caplog.records)
+    # and a corrupt meta does not block serving the (intact) blob either
+    reload = cache.load_or_build(
+        "engines--good", lambda x: x + 1, (jnp.ones((2,)),), build=False
+    )
+    assert reload is not None
