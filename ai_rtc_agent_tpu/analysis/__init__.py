@@ -17,6 +17,8 @@ fix).  This package encodes the rules as checkers over stdlib ``ast``
                      (obs/trace.py frame timelines must stay well-formed)
   trace-purity       host state reads inside jitted/pallas functions
   env-registry       env knobs <-> docs/environment.md, both directions
+  metric-cardinality exported metric label values must come from closed
+                     enums (per-session/frame/packet ids are findings)
   metrics-registry   /metrics name grammar + collision freedom
   retry-4xx          permanent HTTP 4xx retried as transient (shipped
                      bug: server/worker.py default_publish)

@@ -216,6 +216,7 @@ def run_checkers(project: Project, checkers=None) -> list:
         bounded_queues,
         encoder_reconfig,
         env_registry,
+        metric_cardinality,
         metrics_registry,
         pooled_views,
         regressions,
@@ -227,6 +228,7 @@ def run_checkers(project: Project, checkers=None) -> list:
         "async-blocking": async_blocking.check,
         "bounded-queue": bounded_queues.check,
         "encoder-reconfig": encoder_reconfig.check,
+        "metric-cardinality": metric_cardinality.check,
         "pooled-view": pooled_views.check,
         "span-pairing": span_pairing.check,
         "trace-purity": trace_purity.check,
@@ -248,6 +250,7 @@ ALL_CHECKERS = (
     "async-blocking",
     "bounded-queue",
     "encoder-reconfig",
+    "metric-cardinality",
     "pooled-view",
     "span-pairing",
     "trace-purity",

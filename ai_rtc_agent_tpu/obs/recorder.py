@@ -41,9 +41,10 @@ class SessionRecorder:
         session_id: str,
         controller: TraceController,
         clock=time.monotonic,
+        slo=None,
     ):
         self.session_id = session_id
-        self.tracer = SessionTracer(session_id, controller)
+        self.tracer = SessionTracer(session_id, controller, slo=slo)
         self._clock = clock
         n = env.get_int("FLIGHT_EVENTS", 256)
         self.events: collections.deque = collections.deque(maxlen=max(1, n))
@@ -78,9 +79,10 @@ class FlightRecorder:
     tracer shares, so ``/debug/trace`` start/stop flips the whole
     process at once."""
 
-    def __init__(self, stats=None, clock=time.monotonic):
+    def __init__(self, stats=None, clock=time.monotonic, slo=None):
         self.controller = TraceController(clock=clock)
         self.stats = stats  # FrameStats: snapshots count as flight_snapshots_total
+        self.slo = slo  # SloPlane (obs/slo.py): every session tracer feeds it
         self._clock = clock
         self.sessions: dict = {}
         n = env.get_int("FLIGHT_SNAPSHOTS", 8)
@@ -95,14 +97,19 @@ class FlightRecorder:
         wiring both register, whichever runs first wins)."""
         rec = self.sessions.get(session_id)
         if rec is None:
-            rec = SessionRecorder(session_id, self.controller, self._clock)
+            rec = SessionRecorder(
+                session_id, self.controller, self._clock, slo=self.slo
+            )
             self.sessions[session_id] = rec
         return rec
 
     def unregister(self, session_id: str):
         """Session teardown.  Stored snapshots survive — that is the
-        point of a black box."""
+        point of a black box.  The SLO plane's per-session burn state
+        goes with the session (aggregate histograms keep the history)."""
         self.sessions.pop(session_id, None)
+        if self.slo is not None:
+            self.slo.unregister(session_id)
 
     def session(self, session_id: str) -> SessionRecorder | None:
         return self.sessions.get(session_id)

@@ -299,9 +299,14 @@ class SessionTracer:
         session_id: str,
         controller: TraceController,
         ring_frames: int | None = None,
+        slo=None,
     ):
         self.session_id = session_id
         self.controller = controller
+        # SLO plane (obs/slo.py): when enabled, timelines mint even with
+        # tracing off and every sealed one feeds the stage histograms —
+        # the ring is only retained while tracing proper is on
+        self.slo = slo
         n = (
             env.get_int("TRACE_RING_FRAMES", 256)
             if ring_frames is None
@@ -332,10 +337,15 @@ class SessionTracer:
         if frame_trace is not None:     # is a numpy method, never a trace
             return frame_trace
         controller = self.controller
-        # split gate: the off path pays ONE attribute read; the (already
-        # paying-for-allocation) on path takes the lazy-expiry check
+        # split gate: the off path pays ONE attribute read per plane (the
+        # trace switch, then the SLO switch); the (already paying-for-
+        # allocation) on path takes the lazy-expiry check
         if not controller.enabled or not controller.active():
-            return None
+            slo = self.slo
+            if slo is None or not slo.enabled:
+                return None
+            # SLO-only mint: the timeline exists to feed the stage
+            # histograms at finish(); complete() skips the ring
         frame_trace = self.mint()
         try:
             frame.trace = frame_trace
@@ -344,6 +354,17 @@ class SessionTracer:
         return frame_trace
 
     def complete(self, frame_trace: FrameTrace):
+        slo = self.slo
+        if slo is not None:
+            # stage histograms + over-budget counters (obs/slo.py);
+            # observe() no-ops when the plane is disabled
+            slo.observe(self.session_id, frame_trace)
+            if not self.controller.enabled:
+                # SLO-only mode: aggregation happened, but completed
+                # timelines are only RETAINED while tracing is on — the
+                # /debug/flight frame ring must reflect capture windows,
+                # not the always-on budget bookkeeping
+                return
         self.ring.append(frame_trace)  # deque append: atomic, bounded
         self.frames_completed += 1
 

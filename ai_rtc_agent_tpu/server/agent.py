@@ -821,6 +821,14 @@ async def health_detail(request):
         for k, snap in sched.session_snapshots().items():
             if k in sessions:
                 sessions[k]["batchsched"] = snap
+    slo_plane = app.get("slo")
+    if slo_plane is not None:
+        # per-session SLO state (obs/slo.py): stage → budget/burn/breach;
+        # O(stages) int reads per session, like everything else here
+        for k in sessions:
+            snap = slo_plane.session_snapshot(k)
+            if snap is not None:
+                sessions[k]["slo"] = snap
     body = {
         "status": worst_state(s["state"] for s in sessions.values()),
         "sessions": sessions,
@@ -999,6 +1007,24 @@ async def metrics(request):
         out["trace_enabled"] = int(flight.controller.active())
         out["flight_sessions"] = len(flight.sessions)
         out["flight_snapshots_stored"] = len(flight.snapshots)
+    # stage-latency SLO plane (obs/slo.py): aggregate histograms summary
+    # + breach counts — per-session burn state stays on /health
+    slo_plane = request.app.get("slo")
+    if slo_plane is not None:
+        out.update(slo_plane.snapshot())
+    fmt = request.query.get("format", "json")
+    if fmt == "prom":
+        # genuine Prometheus text exposition (obs/promexport.py): the
+        # same scalars plus the SLO stage histograms with cumulative
+        # le-buckets; the JSON body above stays the default
+        from ..obs.promexport import CONTENT_TYPE, render
+
+        return web.Response(
+            body=render(out, slo=slo_plane).encode("utf-8"),
+            headers={"Content-Type": CONTENT_TYPE},
+        )
+    if fmt != "json":
+        return web.Response(status=400, text=f"unknown format {fmt!r}")
     return web.json_response(out)
 
 
@@ -1196,11 +1222,55 @@ async def on_startup(app):
     # decode/encode/glass-to-glass stages next to submit->fetch latency
     if hasattr(app["provider"], "attach_stats"):
         app["provider"].attach_stats(app["stats"])
+    # stage-latency SLO plane (obs/slo.py): always-on per-hop budget
+    # tracking fed by the tracer mint path below; SLO_ENABLE=0 restores
+    # the PR-5 hot path exactly.  Built BEFORE the recorder so every
+    # session tracer is born with the feed attached.
+    slo_plane = None
+    if env.slo_enabled() and env.get_bool("FLIGHT_RECORDER", True):
+        from ..obs.slo import SloPlane
+
+        slo_plane = SloPlane(stats=app["stats"])
+        loop = asyncio.get_event_loop()
+        handler = app["stream_event_handler"]
+
+        def _slo_breach(session_key, stage, state, info):
+            rec = (
+                app["flight"].session(session_key)
+                if app.get("flight") is not None
+                else None
+            )
+            if rec is not None:
+                rec.event("slo", stage=stage, state=state, **info)
+            if state != "breach":
+                return
+            recent = rec.recent_events() if rec is not None else None
+            reason = (
+                f"slo breach: {stage} over {info['budget_ms']}ms budget "
+                f"(burn fast={info['burn_fast']} slow={info['burn_slow']})"
+            )
+
+            def fire():
+                # rides the StreamDegraded webhook path so orchestrators
+                # hear about a blown budget without polling /health
+                handler.handle_session_state(
+                    session_key, "", "SLO_BREACH", reason,
+                    recent_events=recent,
+                )
+
+            try:  # tick may one day run off-loop; webhooks belong on it
+                loop.call_soon_threadsafe(fire)
+            except RuntimeError:
+                pass  # loop already closed (teardown race)
+
+        slo_plane.on_breach = _slo_breach
+        await slo_plane.start()
+    app["slo"] = slo_plane
     # flight recorder + frame tracing (obs/): the black box every session
     # writes into; FLIGHT_RECORDER=0 removes the whole subsystem (and the
-    # /debug endpoints 404)
+    # /debug endpoints 404) — including the SLO plane's feed
     if env.get_bool("FLIGHT_RECORDER", True):
-        flight = FlightRecorder(stats=app["stats"])
+        flight = FlightRecorder(stats=app["stats"], slo=slo_plane)
         app["flight"] = flight
 
         def _webhook_emitted(event_name, stream_id):
@@ -1240,6 +1310,9 @@ async def on_startup(app):
 
 
 async def on_shutdown(app):
+    slo_plane = app.get("slo")
+    if slo_plane is not None:
+        slo_plane.stop()
     ov = app.get("overload")
     if ov is not None:
         ov.stop()

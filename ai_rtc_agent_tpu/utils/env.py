@@ -128,6 +128,15 @@ def hw_decode() -> bool:
     return get_bool("HW_DECODE", get_bool("NVDEC", False))
 
 
+def slo_enabled() -> bool:
+    """Stage-latency SLO plane (obs/slo.py) — always-on per-hop budget
+    aggregation fed by the tracer mint path.  SLO_ENABLE=0 restores the
+    bare tracing hot path (one fewer attribute read per frame); the
+    plane also requires FLIGHT_RECORDER on, since its feed rides the
+    session tracers."""
+    return get_bool("SLO_ENABLE", True)
+
+
 def batchsched_enabled() -> bool:
     """Continuous cross-session batch scheduler (stream/scheduler.py) —
     the default single-device serving path.  BATCHSCHED=0 restores the

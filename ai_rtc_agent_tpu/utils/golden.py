@@ -63,15 +63,23 @@ def capture(model_id: str = "stabilityai/sd-turbo") -> dict:
     from ..stream.engine import StreamEngine
 
     dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    no_weights = RuntimeError(
+        f"no local weights for {model_id} — the golden procedure is "
+        "only meaningful with real safetensors (assets/download.py)"
+    )
+    if registry.family_of(model_id) not in ("tiny", "tinyxl"):
+        # fail fast on the cheap snapshot probe: full-geometry random
+        # init costs ~30s of CPU before load_model_bundle would notice
+        # the weights are absent, and weightless hosts are the common
+        # case (three rounds of them — see the tiny-golden rationale)
+        if not registry.resolve_snapshot_dir(model_id):
+            raise no_weights
     bundle = registry.load_model_bundle(model_id)
     if not bundle.loaded_real_weights and bundle.family not in (
         "tiny",
         "tinyxl",
     ):
-        raise RuntimeError(
-            f"no local weights for {model_id} — the golden procedure is "
-            "only meaningful with real safetensors (assets/download.py)"
-        )
+        raise no_weights
     # the tiny families' "weights" are the seeded init itself — their
     # golden is hermetic and exists to keep the REPLAY machinery running
     # in every environment (a real-weight golden had no host to run on

@@ -1,0 +1,45 @@
+"""Hardware fingerprint for bench records — the honest-benching anchor.
+
+BENCH_r05 banked 0.04 fps from a 1-core CPU fallback *as if it were an
+accelerator run* because nothing in the record said what hardware
+produced it.  Every bench emitter (bench.py, scripts/*_bench.py) now
+stamps the same ``fingerprint`` dict into its PERF_LOG/BENCH line via
+this ONE helper, so a reader (human or scripts/perf_compare.py) can
+always tell a v5e number from a laptop number:
+
+    {"jax_backend": "tpu", "device_kind": "TPU v5e", "device_count": 1,
+     "host_cpus": 64, "machine": "x86_64", "python": "3.11.8"}
+
+``probe_jax=False`` keeps jax out of the picture for the pure-host
+microbenches (host-plane, trace-overhead — importing a backend there
+would cost more than the measurement); they fingerprint the host and
+say so with ``jax_backend: "unprobed"``.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+
+
+def fingerprint(probe_jax: bool = True) -> dict:
+    """The hardware identity dict every bench record carries."""
+    fp = {
+        "host_cpus": os.cpu_count() or 1,
+        "machine": platform.machine(),
+        "python": platform.python_version(),
+    }
+    if not probe_jax:
+        fp["jax_backend"] = "unprobed"
+        return fp
+    try:
+        import jax
+
+        fp["jax_backend"] = jax.default_backend()
+        devices = jax.devices()
+        fp["device_count"] = len(devices)
+        fp["device_kind"] = devices[0].device_kind if devices else "none"
+    except Exception as e:  # backend init failure IS a fingerprint fact
+        fp["jax_backend"] = "unavailable"
+        fp["jax_error"] = f"{type(e).__name__}: {e}"
+    return fp

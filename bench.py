@@ -30,6 +30,25 @@ import numpy as np
 logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 logger = logging.getLogger("bench")
 
+# exit code for an honest refusal: an accelerator-tier record was requested
+# (--expect-backend / BENCH_EXPECT_BACKEND) but the detected backend is a
+# CPU fallback — NO contract line is emitted, nothing can be banked
+# (BENCH_r05 banked 0.04 fps from a 1-core CPU fallback as if it were an
+# accelerator run; this is the loud-failure path that makes that
+# impossible).  Distinct from generic rc=1/2 so the parent/child protocol
+# can tell a refusal from a crash.
+REFUSE_RC = 3
+
+
+def _refuse_backend(expected: str, actual: str):
+    logger.error(
+        "BENCH REFUSED: accelerator-tier run expected backend %r but "
+        "detected %r (CPU fallback?) — exiting rc=%d with NO contract "
+        "line; nothing will be banked. Fix the accelerator tunnel or "
+        "drop --expect-backend to measure the fallback tier explicitly.",
+        expected, actual, REFUSE_RC,
+    )
+
 
 def build_engine(config: str, fbs: int = 1, unet_cache: int = 0):
     import jax
@@ -554,6 +573,11 @@ def _run_measurement_child(result: dict, config: str = "turbo512"):
             return lines[-1]
         except ValueError:
             pass
+    if p.returncode == REFUSE_RC:
+        # the child refused to measure a CPU fallback as accelerator-tier
+        # (its stderr already carried the loud message) — the parent must
+        # NOT soften that into a replay line
+        return "REFUSED"
     result.setdefault(
         "error", f"measurement child rc={p.returncode} without contract line"
     )
@@ -583,6 +607,12 @@ def main():
     ap.add_argument("--probe-timeout", type=int, default=300,
                     help="seconds to wait for backend init before declaring "
                          "the accelerator unreachable (0 = skip probe)")
+    ap.add_argument("--expect-backend", default=None,
+                    help="declare the hardware tier this record claims "
+                         "(e.g. tpu). A detected mismatch — the classic "
+                         "silent CPU fallback — exits rc=3 with NO contract "
+                         "line instead of banking a dishonest number. "
+                         "Equivalent env: BENCH_EXPECT_BACKEND")
     args = ap.parse_args()
     # same clamp as the serving path (server/tracks.py): depth 0 would blow
     # up ThreadPoolExecutor instead of measuring synchronously
@@ -594,16 +624,23 @@ def main():
     # bench progresses, and print from a finally block.  SIGTERM (driver
     # timeout) is converted to an exception so the finally block still runs.
     from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+    from ai_rtc_agent_tpu.utils.hwfp import fingerprint as hw_fingerprint
 
     sigterm_to_exception("driver timeout")
     import os
 
+    expected_backend = (
+        args.expect_backend or os.getenv("BENCH_EXPECT_BACKEND") or ""
+    ).strip().lower()
     result = {
         "metric": f"e2e_fps_{args.config}_singlechip",
         "value": 0.0,
         "unit": "fps",
         "vs_baseline": 0.0,
         "backend": "unknown",
+        # host-only fingerprint up front (the parent never imports jax);
+        # the measurement path upgrades it to the full device identity
+        "fingerprint": hw_fingerprint(probe_jax=False),
     }
     # config-distinguishing fields, set UP FRONT so even a failed run's
     # replay lookup matches only same-config PERF_LOG entries
@@ -621,21 +658,36 @@ def main():
             result["active"] = args.active
     is_child = os.getenv("BENCH_CHILD") == "1"
     emitted = False
+    refused = False
     try:
         if not is_child and not _yield_watcher_claim(result):
             return  # claim never released; finally emits the replay line
         if args.probe_timeout and not is_child:  # child: parent already probed
             ok, info = _backend_responsive(args.probe_timeout)
             if not ok:
+                if expected_backend:
+                    # an unreachable accelerator with a declared tier is a
+                    # refusal, not a replay: emitting ANY line here is how
+                    # stale numbers masquerade as fresh accelerator runs
+                    _refuse_backend(expected_backend, f"unreachable: {info}")
+                    refused = True
+                    sys.exit(REFUSE_RC)
                 # Do NOT import jax here: the claim would hang this process
                 # beyond any SIGTERM.  The finally block emits the contract
                 # line.
                 result["error"] = f"accelerator unreachable: {info}"
                 return
             logger.info("backend probe ok: %s", info)
+            if expected_backend and info.strip().lower() != expected_backend:
+                _refuse_backend(expected_backend, info.strip())
+                refused = True
+                sys.exit(REFUSE_RC)
 
         if not is_child and os.getenv("BENCH_NO_CHILD", "") not in ("1", "true"):
             line = _run_measurement_child(result, config=args.config)
+            if line == "REFUSED":  # child detected a CPU fallback mid-run
+                refused = True
+                sys.exit(REFUSE_RC)
             if line is not None:
                 print(line)
                 sys.stdout.flush()
@@ -652,6 +704,18 @@ def main():
             logger.exception("backend init failed; retrying on cpu")
             jax.config.update("jax_platforms", "cpu")
             result["backend"] = jax.default_backend()
+        if (
+            expected_backend
+            and result["backend"].strip().lower() != expected_backend
+        ):
+            # the in-process guard: covers BENCH_NO_CHILD mode and a
+            # backend that probes as one thing but inits as another
+            _refuse_backend(expected_backend, result["backend"])
+            refused = True
+            sys.exit(REFUSE_RC)
+        # full hardware identity now that a backend exists — the line a
+        # PERF_LOG reader uses to tell a v5e number from a laptop number
+        result["fingerprint"] = hw_fingerprint()
 
         # record which graph variant this number measured: the safe-path
         # queue items (ATTN_IMPL=xla FUSED_EPILOGUE=0) and the TPU-default
@@ -686,11 +750,14 @@ def main():
             if r.get(extra) is not None:
                 result[extra] = r[extra]
     except BaseException as e:  # noqa: BLE001 — contract line on ANY failure
+        if refused:
+            raise  # honest refusal: rc=REFUSE_RC, no contract line
         logger.exception("bench failed")
         result["error"] = f"{type(e).__name__}: {e}"
     finally:
         _clear_watcher_pause()
-        if not emitted:  # child-success path already printed its line
+        if not emitted and not refused:  # child-success already printed;
+            # a refusal must leave NOTHING to bank
             print(json.dumps(_maybe_replay(result)))
             sys.stdout.flush()
 

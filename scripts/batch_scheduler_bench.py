@@ -35,6 +35,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+
 FRAMES = int(os.getenv("BATCHSCHED_BENCH_FRAMES") or 16)
 PAIRS = int(os.getenv("BATCHSCHED_BENCH_PAIRS") or 24)
 # the acceptance number is measured at 4 sessions; the tier-1 smoke runs
@@ -175,6 +177,9 @@ def run() -> dict:
         "live": True,
         "label": f"batchsched_{SESSIONS}s_{FRAMES}f",
         "recorded_at": datetime.now(timezone.utc).isoformat(),
+        # shared hardware identity (utils/hwfp.py) — full probe: jax is
+        # already initialized by the measurement itself
+        "fingerprint": fingerprint(),
     }
 
 

@@ -106,8 +106,8 @@ def main(argv=None) -> int:
         # a partial scan set cannot prove registry completeness (unread
         # knobs / metric collisions live across files) — per-file rules only
         checkers = ("async-blocking", "bounded-queue", "encoder-reconfig",
-                    "pooled-view", "span-pairing", "trace-purity",
-                    "retry-4xx", "restart-defaults")
+                    "metric-cardinality", "pooled-view", "span-pairing",
+                    "trace-purity", "retry-4xx", "restart-defaults")
 
     project, parse_errors = load_project(root, files=files)
     findings = list(parse_errors) + run_checkers(project, checkers)

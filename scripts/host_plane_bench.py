@@ -35,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ai_rtc_agent_tpu.media.rtp import BatchedRtpPacketizer, PyRtpPacketizer
 from ai_rtc_agent_tpu.media.sockio import BatchSender
 from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint
 
 FRAMES = int(os.getenv("HOST_PLANE_BENCH_FRAMES") or 300)
 AU_BYTES = int(os.getenv("HOST_PLANE_BENCH_AU") or 24000)
@@ -187,6 +188,10 @@ def run() -> dict:
         "live": True,
         "label": f"host_plane_{'full' if secure else 'nosrtp'}_{FRAMES}f",
         "recorded_at": datetime.now(timezone.utc).isoformat(),
+        # shared hardware identity (utils/hwfp.py) — host-only: this is a
+        # pure numpy/socket microbench, importing a jax backend here would
+        # cost more than the measurement
+        "fingerprint": fingerprint(probe_jax=False),
     }
 
 

@@ -1,0 +1,259 @@
+#!/usr/bin/env python
+"""Guard a fresh bench run against the banked PERF_LOG trajectory.
+
+The PERF_LOG.jsonl discipline (bank-and-commit every contract line) gives
+this repo a per-metric performance *trajectory*; what it lacked was teeth:
+nothing failed when a fresh number regressed against the banked one.
+This script is the fence:
+
+    python scripts/perf_compare.py --fresh fresh.jsonl
+    some_bench | python scripts/perf_compare.py --fresh -
+
+For every contract line in ``--fresh`` it finds the most recent banked
+entry with the SAME metric, the SAME config labels (fbs/quant/peers/
+active/pipeline_depth/unet_cache/sessions — the predicate bench.py's
+replay tier already uses) and a COMPARABLE hardware tier (same
+``backend``; with fingerprints present on both sides, the same device
+kind — comparing a v5e number against a laptop number is exactly the
+dishonesty this PR exists to kill), then applies a per-metric tolerance
+fence in the metric's *better* direction:
+
+* higher-is-better (fps, speedups, amortization): fresh must be at least
+  ``banked × (1 − tolerance)``;
+* lower-is-better (``*_ratio`` overhead metrics, ``*_ms``/``*_us``
+  latencies): fresh must be at most ``banked × (1 + tolerance)``.
+
+Improvements always pass.  Fresh entries with no comparable banked entry
+are reported as ``no-trajectory`` and pass (``--strict`` fails them) —
+a NEW metric must be bankable before its first trajectory point exists.
+
+Exit codes: 0 within fences, 1 regression (or --strict miss), 2 usage.
+Tier-1 gate: tests/test_bench_contract.py drives all three paths.
+
+Env knobs: PERF_LOG_PATH (same default as every bench emitter).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the config axes that make two entries "the same measurement" — one
+# predicate, shared in spirit with bench._replay_from_perf_log
+CONFIG_KEYS = (
+    "fbs", "quant", "peers", "active", "pipeline_depth", "unet_cache",
+    "sessions", "secure", "label",
+)
+
+# cost-shaped metrics (smaller is better): overhead ratios, latencies,
+# and resource shares (secure_core_share_at_rate's acceptance bound is
+# "< 0.05 core", not ">=").  Throughput-shaped names (fps, speedup,
+# amortization) fall through to higher-is-better.  --lower-better /
+# --higher-better force a metric explicitly when a new name defeats the
+# heuristic — a silently inverted fence is the dishonesty this script
+# exists to kill.
+_LOWER_BETTER_SUBSTRINGS = (
+    "_ratio", "_ms", "_us", "latency", "overhead", "share",
+)
+
+
+def lower_is_better(metric: str, force_lower=(), force_higher=()) -> bool:
+    if metric in force_lower:
+        return True
+    if metric in force_higher:
+        return False
+    return any(s in metric for s in _LOWER_BETTER_SUBSTRINGS)
+
+
+def _load_jsonl(path: str) -> list:
+    entries = []
+    f = sys.stdin if path == "-" else open(path)
+    try:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue  # torn/non-JSON lines never break the fence
+            if isinstance(d, dict) and "metric" in d:
+                entries.append(d)
+    finally:
+        if f is not sys.stdin:
+            f.close()
+    return entries
+
+
+def same_config(a: dict, b: dict) -> bool:
+    return all(a.get(k) == b.get(k) for k in CONFIG_KEYS)
+
+
+def comparable_hw(fresh: dict, banked: dict) -> bool:
+    """Same hardware tier: backend must match; device kind too when both
+    records carry a fingerprint (pre-fingerprint entries compare on
+    backend alone — the trajectory predates the identity stamp)."""
+    if fresh.get("backend") != banked.get("backend"):
+        return False
+    fp_f = fresh.get("fingerprint") or {}
+    fp_b = banked.get("fingerprint") or {}
+    kind_f, kind_b = fp_f.get("device_kind"), fp_b.get("device_kind")
+    if kind_f is not None and kind_b is not None and kind_f != kind_b:
+        return False
+    return True
+
+
+def latest_banked(fresh: dict, banked: list):
+    """Most recent comparable banked entry for this fresh line (the log
+    is append-only, so last match wins), or None."""
+    match = None
+    for entry in banked:
+        if entry.get("metric") != fresh.get("metric"):
+            continue
+        if not entry.get("value"):
+            continue  # failed runs (value 0.0 + error) are not trajectory
+        if entry.get("live") is False:
+            continue  # a replayed line must not become its own baseline
+        if not same_config(fresh, entry) or not comparable_hw(fresh, entry):
+            continue
+        match = entry
+    return match
+
+
+def check(fresh: dict, banked_entry: dict, tolerance: float,
+          force_lower=(), force_higher=()) -> dict:
+    metric = fresh["metric"]
+    fv, bv = float(fresh.get("value", 0.0)), float(banked_entry["value"])
+    if lower_is_better(metric, force_lower, force_higher):
+        fence = bv * (1.0 + tolerance)
+        ok = fv <= fence
+        direction = "<="
+    else:
+        fence = bv * (1.0 - tolerance)
+        ok = fv >= fence
+        direction = ">="
+    return {
+        "metric": metric,
+        "status": "ok" if ok else "regression",
+        "fresh": fv,
+        "banked": bv,
+        "fence": round(fence, 4),
+        "direction": direction,
+        "tolerance": tolerance,
+        "banked_recorded_at": banked_entry.get("recorded_at"),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh", required=True,
+                    help="JSONL file of fresh contract lines ('-' = stdin)")
+    ap.add_argument("--log", default=None,
+                    help="banked trajectory (default: PERF_LOG_PATH or the "
+                         "repo PERF_LOG.jsonl)")
+    ap.add_argument("--tolerance", type=float, default=0.35,
+                    help="default per-metric relative fence (0.35 = a fresh "
+                         "number may be up to 35%% worse than banked — "
+                         "sized for shared-CI throttle noise; tighten per "
+                         "metric with --tolerance-metric)")
+    ap.add_argument("--tolerance-metric", action="append", default=[],
+                    metavar="METRIC=FRac",
+                    help="per-metric override, e.g. "
+                         "trace_off_overhead_ratio=0.1 (repeatable)")
+    ap.add_argument("--lower-better", action="append", default=[],
+                    metavar="METRIC",
+                    help="force a metric to lower-is-better (repeatable; "
+                         "overrides the name heuristic)")
+    ap.add_argument("--higher-better", action="append", default=[],
+                    metavar="METRIC",
+                    help="force a metric to higher-is-better (repeatable)")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail fresh metrics with no banked trajectory")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    overrides = {}
+    for spec in args.tolerance_metric:
+        name, _, frac = spec.partition("=")
+        if not name or not frac:
+            print(f"bad --tolerance-metric {spec!r} (want METRIC=FRAC)",
+                  file=sys.stderr)
+            return 2
+        try:
+            overrides[name] = float(frac)
+        except ValueError:
+            print(f"bad tolerance {frac!r} in {spec!r}", file=sys.stderr)
+            return 2
+
+    log_path = args.log or os.getenv("PERF_LOG_PATH") or os.path.join(
+        REPO, "PERF_LOG.jsonl"
+    )
+    try:
+        banked = _load_jsonl(log_path)
+    except OSError as e:
+        print(f"cannot read banked log {log_path}: {e}", file=sys.stderr)
+        return 2
+    try:
+        fresh_entries = _load_jsonl(args.fresh)
+    except OSError as e:
+        print(f"cannot read fresh run {args.fresh}: {e}", file=sys.stderr)
+        return 2
+    if not fresh_entries:
+        print("no fresh contract lines to check", file=sys.stderr)
+        return 2
+
+    results = []
+    regressions = 0
+    for fresh in fresh_entries:
+        if "error" in fresh or not fresh.get("value"):
+            results.append({
+                "metric": fresh.get("metric"),
+                "status": "fresh-run-failed",
+                "error": fresh.get("error", "value 0.0"),
+            })
+            regressions += 1  # a failed fresh run can never pass the fence
+            continue
+        banked_entry = latest_banked(fresh, banked)
+        if banked_entry is None:
+            results.append({
+                "metric": fresh.get("metric"),
+                "status": "no-trajectory",
+            })
+            if args.strict:
+                regressions += 1
+            continue
+        tol = overrides.get(fresh["metric"], args.tolerance)
+        r = check(fresh, banked_entry, tol,
+                  force_lower=args.lower_better,
+                  force_higher=args.higher_better)
+        results.append(r)
+        if r["status"] != "ok":
+            regressions += 1
+
+    if args.format == "json":
+        print(json.dumps({"results": results, "regressions": regressions},
+                         indent=2))
+    else:
+        for r in results:
+            if r["status"] == "ok":
+                print(f"OK          {r['metric']}: {r['fresh']} "
+                      f"{r['direction']} fence {r['fence']} "
+                      f"(banked {r['banked']})")
+            elif r["status"] == "regression":
+                print(f"REGRESSION  {r['metric']}: {r['fresh']} vs fence "
+                      f"{r['fence']} (banked {r['banked']} at "
+                      f"{r['banked_recorded_at']})")
+            else:
+                print(f"{r['status'].upper():<11} {r['metric']}"
+                      + (f": {r['error']}" if r.get("error") else ""))
+        print(f"perf_compare: {len(results)} metric(s), "
+              f"{regressions} failing")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,11 @@ host-plane bench (shared CI boxes throttle in bursts):
 
   baseline  the kernel alone — no obs calls at all
   off       kernel + the real hop guards, tracing disabled
+  slo_off   `off` with the SLO plane (obs/slo.py) attached but DISABLED —
+            the serving hot path under SLO_ENABLE=0 (one extra attribute
+            read at the mint site)
+  slo_on    SLO enabled, tracing off: mint + span stamping + the
+            histogram observe at finish — the always-on SLO cost
   on        kernel + full span stamping + finish("sent") per frame
   flight    `on` + a FlightRecorder ring + a snapshot every 100 frames
 
@@ -23,6 +28,9 @@ Prints ONE JSON contract line and appends it to PERF_LOG.jsonl
 ``trace_off_overhead_ratio`` = off / baseline — the number that must stay
 within noise of 1.0 (tests/test_bench_contract.py guards it loosely; the
 absolute per-frame figures ride along for the log).
+``slo_off_overhead_ratio`` = slo_off / baseline is the SLO plane's
+off-mode contract (ISSUE 8 acceptance: ≤5% over the trace-off ratio on
+an uncontended box) and is guarded by the same test.
 
 Env knobs: TRACE_BENCH_FRAMES (default 2000).
 """
@@ -39,8 +47,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ai_rtc_agent_tpu.media.frames import VideoFrame
 from ai_rtc_agent_tpu.obs.recorder import FlightRecorder
+from ai_rtc_agent_tpu.obs.slo import SloPlane
 from ai_rtc_agent_tpu.obs.trace import SessionTracer, TraceController, get_trace
 from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint
 
 FRAMES = int(os.getenv("TRACE_BENCH_FRAMES") or 2000)
 
@@ -121,6 +131,19 @@ def run() -> dict:
     ctrl_off.stop()
     tracer_off = SessionTracer("bench-off", ctrl_off)
 
+    # SLO legs (obs/slo.py): slo_off = the serving hot path with the plane
+    # attached but disabled; slo_on = always-on aggregation, tracing off
+    ctrl_slo_off = TraceController()
+    ctrl_slo_off.stop()
+    plane_off = SloPlane()
+    plane_off.enabled = False
+    tracer_slo_off = SessionTracer("bench-slo-off", ctrl_slo_off, slo=plane_off)
+    ctrl_slo_on = TraceController()
+    ctrl_slo_on.stop()
+    plane_on = SloPlane()
+    plane_on.enabled = True
+    tracer_slo_on = SessionTracer("bench-slo-on", ctrl_slo_on, slo=plane_on)
+
     ctrl_on = TraceController()
     ctrl_on.enabled = True
     tracer_on = SessionTracer("bench-on", ctrl_on)
@@ -132,29 +155,43 @@ def run() -> dict:
     # warmup (allocator, numpy dispatch, code paths)
     _leg_baseline(frames[:64])
     _leg_off(frames[:64], tracer_off)
+    _leg_off(frames[:64], tracer_slo_off)
+    _leg_on(frames[:64], tracer_slo_on)
     _leg_on(frames[:64], tracer_on)
 
     base_r, off_r, on_r, flight_r = [], [], [], []
+    slo_off_r, slo_on_r = [], []
     for _ in range(5):  # interleaved best-of (CI boxes throttle in bursts)
         base_r.append(_leg_baseline(frames))
         off_r.append(_leg_off(frames, tracer_off))
+        slo_off_r.append(_leg_off(frames, tracer_slo_off))
+        slo_on_r.append(_leg_on(frames, tracer_slo_on))
         on_r.append(_leg_on(frames, tracer_on))
         flight_r.append(_leg_on(frames, rec.tracer, flight=flight))
     base_s, off_s = min(base_r), min(off_r)
     on_s, flight_s = min(on_r), min(flight_r)
+    slo_off_s, slo_on_s = min(slo_off_r), min(slo_on_r)
 
     us = lambda s: round(1e6 * s / FRAMES, 3)  # noqa: E731
     ratio = off_s / base_s if base_s > 0 else 0.0
+    slo_ratio = slo_off_s / base_s if base_s > 0 else 0.0
     return {
         "check": "trace_overhead_bench",
         "frames": FRAMES,
         "hops": len(_HOPS) + 1,
         "baseline_us_per_frame": us(base_s),
         "trace_off_us_per_frame": us(off_s),
+        "slo_off_us_per_frame": us(slo_off_s),
+        "slo_on_us_per_frame": us(slo_on_s),
         "trace_on_us_per_frame": us(on_s),
         "flight_on_us_per_frame": us(flight_s),
         "off_overhead_us_per_frame": us(off_s - base_s),
+        "slo_off_overhead_us_per_frame": us(slo_off_s - base_s),
+        "slo_on_overhead_us_per_frame": us(slo_on_s - base_s),
         "on_overhead_us_per_frame": us(on_s - base_s),
+        # the SLO plane's off-mode contract (ISSUE 8 acceptance)
+        "slo_off_overhead_ratio": round(slo_ratio, 4),
+        "slo_frames_observed": plane_on.frames_observed,
         # the contract quartet (same shape as host_plane_bench)
         "metric": "trace_off_overhead_ratio",
         "value": round(ratio, 4),
@@ -164,6 +201,7 @@ def run() -> dict:
         "live": True,
         "label": f"trace_overhead_{FRAMES}f",
         "recorded_at": datetime.now(timezone.utc).isoformat(),
+        "fingerprint": fingerprint(probe_jax=False),
     }
 
 

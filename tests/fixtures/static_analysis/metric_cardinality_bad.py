@@ -1,0 +1,31 @@
+"""Known-bad fixture for the metric-cardinality checker: label values
+carrying per-session / per-frame identities (unbounded series growth).
+Mirrors the tempting-but-wrong way to export the per-queue overload
+snapshot as labeled Prometheus series."""
+
+
+def labeled(name, labels, value):  # the promexport-style helper shape
+    return f"{name}{labels} {value}"
+
+
+def export_queues(queues):
+    out = []
+    for qname, q in queues.items():
+        # BAD: queue names embed session keys ("ingest:<session>")
+        out.append(labeled("queue_depth", {"queue": qname}, q.depth))
+    return out
+
+
+def export_frame(frame, session_id):
+    # BAD: per-session and per-frame identities as label values
+    lines = [labeled("frame_latency_ms", {"session": session_id}, 1.0)]
+    lines.append(
+        labeled("frame_done", {"frame": str(frame.frame_id)}, 1)
+    )
+    return lines
+
+
+def export_dynamic(samples):
+    # BAD: label set built elsewhere — cardinality unreadable at the site
+    for labels, v in samples:
+        yield labeled("sample", labels, v)

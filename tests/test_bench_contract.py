@@ -37,6 +37,34 @@ def _contract_line(stdout: str) -> dict:
     return d
 
 
+def test_accelerator_tier_refuses_cpu_fallback():
+    """ISSUE 8 acceptance: an accelerator-tier record (--expect-backend
+    tpu) running on a CPU-fallback backend must exit NONZERO with NO
+    contract line — nothing bankable, loudly (BENCH_r05 banked 0.04 fps
+    from exactly this silent fallback).  Fast: the probe path refuses
+    before any model builds."""
+    r = _run_bench(
+        {"JAX_PLATFORMS": "cpu", "PERF_LOG_PATH": os.devnull},
+        args=("--frames", "2", "--probe-timeout", "120",
+              "--expect-backend", "tpu"),
+        config="tiny64",
+    )
+    assert r.returncode == 3, (r.returncode, r.stderr[-400:])
+    assert not [ln for ln in r.stdout.splitlines() if ln.startswith("{")], (
+        "a refusal must not emit a contract line: " + r.stdout
+    )
+    assert "BENCH REFUSED" in r.stderr and "tpu" in r.stderr
+
+    # env spelling, and an UNREACHABLE accelerator with a declared tier
+    # is also a refusal (replaying a stale number would defeat the gate)
+    r = _run_bench(
+        {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": os.devnull,
+         "BENCH_EXPECT_BACKEND": "tpu"},
+    )
+    assert r.returncode == 3, (r.returncode, r.stderr[-400:])
+    assert not [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+
+
 def test_contract_line_when_backend_unreachable():
     """A bogus platform makes the subprocess probe fail -> the bench must
     still print the parseable contract line and exit 0.  PERF_LOG_PATH is
@@ -258,6 +286,9 @@ def test_host_plane_bench_contract_and_speedup(tmp_path):
     assert "error" not in d, d
     assert d["metric"] == "host_plane_batched_speedup"
     assert d["pkts_per_frame"] >= 15  # 512²-rate FU-A shape at 1200 MTU
+    # honest-bench fingerprint (ISSUE 8): shared utils/hwfp.py dict
+    assert d["fingerprint"]["host_cpus"] >= 1
+    assert d["fingerprint"]["jax_backend"] == "unprobed"  # pure-host bench
     # not-slower fence with headroom for a contended 1-core CI box
     assert d["value"] >= 0.9, d
     # banked: the same entry landed in the log
@@ -299,6 +330,15 @@ def test_trace_overhead_bench_contract(tmp_path):
     # absolute off-mode residue stays in single-digit µs per frame
     assert d["trace_on_us_per_frame"] >= d["trace_off_us_per_frame"], d
     assert d["off_overhead_us_per_frame"] < 25.0, d
+    # the SLO plane's off-mode contract (ISSUE 8 acceptance: ≤5% over the
+    # trace-off ratio on an uncontended box; this CI fence is loose the
+    # same way the trace one is — what it catches is allocation/locking
+    # landing back on the SLO_ENABLE=0 hot path, a multi-x blowup)
+    assert 0 < d["slo_off_overhead_ratio"] <= 1.5, d
+    assert d["slo_off_overhead_us_per_frame"] < 25.0, d
+    # slo-on actually aggregated (the bench fed real timelines)
+    assert d["slo_frames_observed"] > 0, d
+    assert d["fingerprint"]["jax_backend"] == "unprobed"
     # banked: the same entry landed in the log
     banked = [json.loads(x) for x in log.read_text().splitlines()]
     assert banked and banked[-1]["metric"] == "trace_off_overhead_ratio"
@@ -482,5 +522,164 @@ def test_batch_scheduler_bench_contract(tmp_path):
     # committed PERF_LOG line carries the real 4-session ≥1.5x / ≤5%
     assert d["value"] >= 0.8, d
     assert d["single_session_overhead_pct"] <= 40.0, d
+    # full fingerprint: this bench initializes jax for the measurement
+    assert d["fingerprint"]["jax_backend"] == "cpu"
+    assert d["fingerprint"]["device_count"] >= 1
     banked = [json.loads(x) for x in log.read_text().splitlines()]
     assert banked and banked[-1]["metric"] == "batchsched_amortization_2s"
+
+
+# -- perf_compare.py: the trajectory fence (ISSUE 8) -------------------------
+
+def _perf_compare(args, timeout=60):
+    return subprocess.run(
+        [sys.executable, "scripts/perf_compare.py", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+def _write_jsonl(path, entries):
+    path.write_text("".join(json.dumps(e) + "\n" for e in entries))
+
+
+def test_perf_compare_passes_within_fence_and_fails_regression(tmp_path):
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "e2e_fps_turbo512_singlechip", "value": 30.0,
+         "unit": "fps", "backend": "tpu", "live": True,
+         "recorded_at": "2026-08-01T00:00:00+00:00"},
+    ])
+    # within tolerance (and improvements always pass)
+    _write_jsonl(fresh, [
+        {"metric": "e2e_fps_turbo512_singlechip", "value": 28.0,
+         "unit": "fps", "backend": "tpu"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+    # a real regression (beyond the default 35% fence) fails the run
+    _write_jsonl(fresh, [
+        {"metric": "e2e_fps_turbo512_singlechip", "value": 10.0,
+         "unit": "fps", "backend": "tpu"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout
+
+
+def test_perf_compare_direction_and_per_metric_tolerance(tmp_path):
+    """Overhead ratios are lower-is-better: a RISE past the fence fails;
+    per-metric tolerance overrides tighten the default."""
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "trace_off_overhead_ratio", "value": 1.06, "unit": "x",
+         "backend": "cpu", "live": True},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "trace_off_overhead_ratio", "value": 1.30, "unit": "x",
+         "backend": "cpu"},
+    ])
+    # 1.30 vs banked 1.06: inside the loose default fence...
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout
+    # ...but outside a tightened 10% per-metric fence
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked),
+                       "--tolerance-metric",
+                       "trace_off_overhead_ratio=0.1"])
+    assert r.returncode == 1, r.stdout
+    # and a LOWER ratio (improvement) always passes
+    _write_jsonl(fresh, [
+        {"metric": "trace_off_overhead_ratio", "value": 0.95, "unit": "x",
+         "backend": "cpu"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked),
+                       "--tolerance-metric",
+                       "trace_off_overhead_ratio=0.1"])
+    assert r.returncode == 0, r.stdout
+
+
+def test_perf_compare_share_metrics_are_lower_better(tmp_path):
+    """secure_core_share_at_rate's acceptance bound is '< 0.05 core' —
+    a cost metric: a 10x core-share blowup must FAIL and a halving must
+    pass (the heuristic must not silently invert the fence; explicit
+    --higher-better can still force the other reading)."""
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "secure_core_share_at_rate", "value": 0.0118,
+         "unit": "core_frac", "backend": "cpu", "live": True},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "secure_core_share_at_rate", "value": 0.118,
+         "unit": "core_frac", "backend": "cpu"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+    _write_jsonl(fresh, [
+        {"metric": "secure_core_share_at_rate", "value": 0.006,
+         "unit": "core_frac", "backend": "cpu"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout
+    # explicit overrides beat the heuristic for future metric names
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked),
+                       "--higher-better", "secure_core_share_at_rate"])
+    assert r.returncode == 1, r.stdout
+
+
+def test_perf_compare_hardware_tier_isolation(tmp_path):
+    """A CPU fresh run must NOT be fenced against a TPU banked number
+    (no-trajectory; --strict makes that a failure), and fingerprinted
+    entries must also match on device kind."""
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "e2e_fps_turbo512_singlechip", "value": 30.0,
+         "unit": "fps", "backend": "tpu", "live": True},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "e2e_fps_turbo512_singlechip", "value": 0.04,
+         "unit": "fps", "backend": "cpu"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0 and "NO-TRAJECTORY" in r.stdout
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked),
+                       "--strict"])
+    assert r.returncode == 1
+    # same backend, different silicon: fingerprints keep them apart
+    _write_jsonl(banked, [
+        {"metric": "m", "value": 30.0, "backend": "tpu", "live": True,
+         "fingerprint": {"device_kind": "TPU v5e"}},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "m", "value": 1.0, "backend": "tpu",
+         "fingerprint": {"device_kind": "TPU v2"}},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0 and "NO-TRAJECTORY" in r.stdout
+
+
+def test_perf_compare_skips_replays_and_failed_runs(tmp_path):
+    """live:false replay lines must never become their own baseline, and
+    a failed fresh run (value 0 + error) always fails the fence."""
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "m", "value": 50.0, "backend": "tpu", "live": False},
+        {"metric": "m", "value": 30.0, "backend": "tpu", "live": True},
+        {"metric": "m", "value": 0.0, "backend": "tpu",
+         "error": "it died"},
+    ])
+    _write_jsonl(fresh, [{"metric": "m", "value": 29.0, "backend": "tpu"}])
+    # fenced against the live 30.0, not the replayed 50.0 or the failure
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked),
+                       "--tolerance", "0.1"])
+    assert r.returncode == 0, r.stdout
+    _write_jsonl(fresh, [
+        {"metric": "m", "value": 0.0, "backend": "tpu",
+         "error": "unreachable"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "FRESH-RUN-FAILED" in r.stdout

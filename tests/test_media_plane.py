@@ -201,6 +201,11 @@ def test_agent_native_rtp_real_engine_e2e(native_lib, monkeypatch):
     # seconds behind the CPU jit compile by design, and must NOT be shed
     # at the encode-hop overload deadline
     monkeypatch.setenv("OVERLOAD_TX_DEADLINE_MS", "0")
+    # ONE session is served here: cap the scheduler at one slot so
+    # startup prewarm compiles only the k=1 bucket instead of {1,2,4,8}
+    # (~20s of tier-1 wall-time; multi-bucket compile coverage lives in
+    # test_batch_scheduler.py)
+    monkeypatch.setenv("BATCHSCHED_MAX_SESSIONS", "1")
     use_h264 = _h264()
 
     async def go():

@@ -217,6 +217,81 @@ def test_bounded_queue_exempts_operator_tooling(tmp_path):
     assert [f.path for f in fs] == ["ai_rtc_agent_tpu/serving.py"]
 
 
+def test_metric_cardinality_catches_identity_labels():
+    """ISSUE 8 satellite: metric label values must come from closed
+    enums — per-session/per-frame identity label values and opaque label
+    sets are findings."""
+    fs = run_on(["metric_cardinality_bad.py"], ("metric-cardinality",))
+    scopes = {f.scope for f in fs}
+    msgs = " | ".join(f.message for f in fs)
+    assert "export_queues" in scopes  # queue names embed session keys
+    assert "export_frame" in scopes
+    assert "per-session identity" in msgs
+    assert "per-frame identity" in msgs
+    assert "not a literal dict" in msgs  # export_dynamic's opaque labels
+    assert len(fs) == 4, "\n".join(f.render() for f in fs)
+
+
+def test_metric_cardinality_precision(tmp_path):
+    """Closed-enum spellings stay clean: literals, for-targets over
+    ALL-CAPS constants (statement + comprehension, wrapped in sorted()),
+    and the `le` histogram-bucket key; an open-domain loop target is
+    still flagged."""
+    root = tmp_path
+    (root / "ai_rtc_agent_tpu").mkdir()
+    (root / "ai_rtc_agent_tpu" / "exp.py").write_text(
+        'STAGES = ("decode", "encode")\n'
+        "\n"
+        "def labeled(name, labels, value):\n"
+        "    return name\n"
+        "\n"
+        "def ok(hist):\n"
+        "    out = [labeled('x', {'stage': 'decode'}, 1)]\n"
+        "    for stage in STAGES:\n"
+        "        out.append(labeled('x', {'stage': stage}, 2))\n"
+        "    out += [labeled('y', {'stage': s}, 3) for s in sorted(STAGES)]\n"
+        "    for le, n in hist.cumulative():\n"
+        "        out.append(labeled('x_bucket', {'stage': 'decode', 'le': le}, n))\n"
+        "    return out\n"
+        "\n"
+        "def bad(rows):\n"
+        "    return [labeled('z', {'row': r}, 1) for r in rows]\n"
+        "\n"
+        "def bad_name_reuse(per_session):\n"
+        "    # `stage` is closed in ok() — NOT here: a closed loop in one\n"
+        "    # function must never whitelist another function's variable\n"
+        "    out = []\n"
+        "    for stage in per_session:\n"
+        "        out.append(labeled('w', {'stage': stage}, 1))\n"
+        "    return out\n"
+    )
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("metric-cardinality",))
+    assert sorted(f.scope for f in fs) == ["bad", "bad_name_reuse"], [
+        f.render() for f in fs
+    ]
+
+
+def test_metric_cardinality_exempts_operator_tooling(tmp_path):
+    """scripts/, examples/ and bench.py compose ad-hoc report lines, not
+    scrape surfaces — same carve-out as bounded-queue."""
+    root = tmp_path
+    (root / "scripts").mkdir()
+    (root / "ai_rtc_agent_tpu").mkdir()
+    body = (
+        "def labeled(n, labels, v):\n    return n\n"
+        "def f(sid):\n    return labeled('m', {'session': sid}, 1)\n"
+    )
+    (root / "scripts" / "tool.py").write_text(body)
+    (root / "bench.py").write_text(body)
+    (root / "ai_rtc_agent_tpu" / "exp.py").write_text(body)
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("metric-cardinality",))
+    assert [f.path for f in fs] == ["ai_rtc_agent_tpu/exp.py"]
+
+
 # -- shipped-bug reproductions (ROADMAP open items 2 and 3) ------------------
 
 def test_retry_4xx_reproduces_shipped_worker_bug():
