@@ -33,6 +33,22 @@ from ..utils import env
 logger = logging.getLogger(__name__)
 
 
+def _donating_call(exp, donate_argnums):
+    """Wrap a (de)serialized export's ``call`` so buffer donation survives.
+
+    ``jax.export`` records the donation aliasing in the StableHLO module
+    (``tf.aliasing_output`` on the donated args) but ``Exported.call``
+    re-enters jit WITHOUT donate_argnums, so the outer executable keeps a
+    defensive copy of every "donated" arg alive — an AOT-adopted stream
+    engine silently paid a full state-pytree copy (latent ring + noise +
+    embeddings) per step.  Re-declaring the donation on the outer jit
+    restores in-place aliasing end to end (audited by
+    tests/test_aot_cache.py::test_aot_call_donates_state)."""
+    if not donate_argnums:
+        return exp.call
+    return jax.jit(exp.call, donate_argnums=tuple(donate_argnums))
+
+
 def engine_key(model_id: str, mode: str, **attrs) -> str:
     """Human-readable cache key (reference lib/wrapper.py:732-746 analog)."""
     safe_model = model_id.replace("/", "--")
@@ -96,7 +112,7 @@ class EngineCache:
                 with open(blob_path, "rb") as f:
                     exp = jax_export.deserialize(f.read())
                 logger.info("engine cache HIT %s (%s)", key, digest)
-                return exp.call
+                return _donating_call(exp, donate_argnums)
             except Exception as e:  # corrupted/incompatible
                 logger.warning("engine cache entry unreadable (%s)", e)
         if not build:
@@ -127,7 +143,7 @@ class EngineCache:
                 indent=2,
             )
         logger.info("engine built in %.1fs -> %s", time.time() - t0, blob_path)
-        return exp.call
+        return _donating_call(exp, donate_argnums)
 
     def entries(self):
         """Metadata of every cached engine.  One corrupt/truncated meta

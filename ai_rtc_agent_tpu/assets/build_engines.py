@@ -32,7 +32,12 @@ def build(
 ):
     from ..aot.cache import EngineCache
     from ..models import registry
-    from ..stream.engine import StreamEngine, make_step_fn, stream_engine_key
+    from ..stream.engine import (
+        StreamEngine,
+        make_step_fn,
+        params_variant_extra,
+        stream_engine_key,
+    )
 
     bundle = registry.load_model_bundle(
         model_id, lora_dict=lora_dict, controlnet=controlnet
@@ -67,10 +72,14 @@ def build(
         variants = [("full", None)]
     keys = []
     state = engine.state
+    # params-variant key field (QUANT_WEIGHTS=w8): the build and serving
+    # adoption must agree, or a quantized build would never be found (and
+    # a dense engine could be adopted by a quantized server)
+    qextra = params_variant_extra(bundle.params)
     for unet_variant, key_variant in variants:
         step = make_step_fn(bundle.stream_models, cfg, unet_variant=unet_variant)
         extra = {"variant": key_variant} if key_variant else {}
-        key = stream_engine_key(model_id, cfg, **extra)
+        key = stream_engine_key(model_id, cfg, **extra, **qextra)
         call = cache.load_or_build(
             key, step, (bundle.params, state, frame), donate_argnums=(1,)
         )
@@ -156,11 +165,11 @@ def build_scheduler_buckets(
     )
     try:
         status = sched.aot_status(model_id, cache_dir=cache_dir)
-        missing = [k for k, built in status.items() if not built]
-        for k, built in sorted(status.items()):
+        missing = [kv for kv, built in status.items() if not built]
+        for (k, variant), built in sorted(status.items()):
             logger.info(
-                "scheduler bucket %d/%d: %s",
-                k, sessions, "cached" if built else "building",
+                "scheduler bucket %d/%d (%s): %s",
+                k, sessions, variant, "cached" if built else "building",
             )
         if missing and not sched.use_aot_cache(
             model_id, cache_dir=cache_dir, build_on_miss=True

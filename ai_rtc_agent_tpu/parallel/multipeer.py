@@ -319,7 +319,7 @@ class MultiPeerEngine:
         if self.states is None:
             raise RuntimeError("call start() first (states define the signature)")
         from ..aot.cache import EngineCache
-        from ..stream.engine import stream_engine_key
+        from ..stream.engine import params_variant_extra, stream_engine_key
 
         # the single-peer key recipe (incl. cnet/fused/attn graph flags)
         # plus the peer dimension — one recipe, no drift between the two
@@ -339,8 +339,11 @@ class MultiPeerEngine:
             ]
         else:
             plan = [(self._vstep, {}, "_step")]
+        qextra = params_variant_extra(self.params)  # w8 never aliases dense
         keys = [
-            stream_engine_key(model_id, self.cfg, peers=self.max_peers, **extra)
+            stream_engine_key(
+                model_id, self.cfg, peers=self.max_peers, **extra, **qextra
+            )
             for _, extra, _ in plan
         ]
         if not build_on_miss and not all(cache.has(k, args) for k in keys):

@@ -1186,14 +1186,17 @@ async def on_startup(app):
         # single-device serving path — concurrent sessions coalesce into
         # one vmapped device step instead of serializing through the
         # shared engine.  BATCHSCHED=0 kill-switch restores the shared
-        # pipeline; tp/sp meshes, --fbs and UNET_CACHE keep it (those
-        # batch/cadence axes don't compose with the session axis).
+        # pipeline; tp/sp meshes and --fbs keep it (those batch axes
+        # don't compose with the session axis).  UNET_CACHE and
+        # QUANT_WEIGHTS serve THROUGH the scheduler (ISSUE 9): the
+        # DeepCache cadence runs globally over (k, variant)-keyed bucket
+        # steps and quantized params ride unchanged — parity pinned by
+        # tests/batchsched_equiv_driver.py.
         if (
             app.get("batch_scheduler") is None
             and env.batchsched_enabled()
             and mesh is None
             and app["pipeline"].config.frame_buffer_size == 1
-            and app["pipeline"].config.unet_cache_interval < 2
         ):
             from ..stream.scheduler import BatchScheduler
 

@@ -460,6 +460,34 @@ def _has_quantized_kernels(tree) -> bool:
     return False
 
 
+def params_variant_extra(params) -> dict:
+    """AOT-cache key extras derived from the PARAMS variant.
+
+    QUANT_WEIGHTS=w8 changes the traced graph (int8 kernels + fused
+    dequant) without touching StreamConfig, so stream_engine_key alone
+    cannot distinguish a quantized engine from the dense baseline.  Every
+    key producer (StreamEngine.use_aot_cache, BatchScheduler.bucket_keys,
+    the build CLI) splices this in so a quantized executable can never
+    collide with — or stand in for — the dense one.  Empty when dense, so
+    every pre-existing engine key stays valid."""
+    return {"quant": "w8"} if _has_quantized_kernels(params) else {}
+
+
+def stage_frame(frame_u8):
+    """Start the host->HBM transfer for one frame WITHOUT blocking.
+
+    The single reusable staging path shared by StreamEngine.submit and the
+    batch scheduler's per-session submit (stream/scheduler.py): device_put
+    returns immediately and the copy rides under in-flight compute
+    (reference NVDEC zero-copy analog, README.md:11-15).  Called BEFORE
+    any dispatch lock is taken — a large-frame H2D copy must never
+    serialize concurrent sessions' dispatches on what looks like
+    microseconds of host work."""
+    if isinstance(frame_u8, np.ndarray):
+        return jax.device_put(frame_u8)
+    return frame_u8
+
+
 def current_attn_impl() -> str:
     """Resolved ATTN_IMPL default — THE single definition shared by the
     bundle builder (models/registry), the serving build probe
@@ -840,7 +868,10 @@ class StreamEngine:
                     ("cached", {"variant": "cached"}, "_step_cached")]
         else:
             plan = [("full", {}, "_step")]
-        keys = [stream_engine_key(model_id, self.cfg, **extra)
+        # the params variant (w8 quant) is part of the key: a quantized
+        # executable must never collide with the dense baseline's slot
+        qextra = params_variant_extra(self.params)
+        keys = [stream_engine_key(model_id, self.cfg, **extra, **qextra)
                 for _, extra, _ in plan]
         if not build_on_miss and not all(
             cache.has(k, args) for k in keys
@@ -908,26 +939,40 @@ class StreamEngine:
                 )
                 poisoned = np.full(shape, np.nan, np.float32)
                 return ("fault", poisoned, frame_u8.ndim == 3)
+        squeeze = frame_u8.ndim == 3
+        # async host->HBM staging BEFORE the dispatch lock: device_put
+        # returns immediately and the copy rides under in-flight compute,
+        # so a large-frame transfer can't serialize concurrent sessions'
+        # dispatches behind the submit lock.  Filter-enabled engines keep
+        # the ORIGINAL single-lock discipline instead (staging inside the
+        # lock, AFTER the skip check): splitting check and step across two
+        # acquisitions would let a concurrent skip dup a STALE
+        # _last_submitted (stream steps backwards — code-review r2), and
+        # staging first would pay an H2D for every skipped frame of a
+        # static scene (code-review r1).  The default serving configs run
+        # the filter per-session in the scheduler, not here, so the hot
+        # path gets the lock-free staging.
+        staged = (
+            stage_frame(frame_u8)
+            if not self.cfg.similar_image_filter
+            else None
+        )
         with self._submit_lock:
-            if self.cfg.similar_image_filter and self._maybe_skip(frame_u8):
-                # skip the device step entirely: the handle DUPLICATES the
-                # most recently submitted output buffer, so resolution order
-                # stays correct even when fetches run concurrently on pool
-                # threads (resolving against host-side _last_out would race
-                # the in-flight frames and step the stream backwards)
-                self.last_submit_was_skip = True
-                if self._last_submitted is not None:
-                    return ("dup",) + self._last_submitted
-                return None, frame_u8.ndim == 3
-            squeeze = frame_u8.ndim == 3
-            if isinstance(frame_u8, np.ndarray):
-                # async host->HBM staging BEFORE dispatch (the DeviceFeeder
-                # pattern from media/ring.py, inlined): device_put returns
-                # immediately and the transfer rides under in-flight
-                # compute; a numpy arg would block the dispatch on a
-                # synchronous copy (reference NVDEC zero-copy analog,
-                # README.md:11-15)
-                frame_u8 = jax.device_put(frame_u8)
+            if self.cfg.similar_image_filter:
+                if self._maybe_skip(frame_u8):
+                    # skip the device step entirely: the handle DUPLICATES
+                    # the most recently submitted output buffer, so
+                    # resolution order stays correct even when fetches run
+                    # concurrently on pool threads (resolving against
+                    # host-side _last_out would race the in-flight frames
+                    # and step the stream backwards)
+                    self.last_submit_was_skip = True
+                    if self._last_submitted is not None:
+                        return ("dup",) + self._last_submitted
+                    return None, squeeze
+                # not skipped: stage now (under the lock — the price of
+                # exact dup-anchor semantics; skipped frames never pay it)
+                staged = stage_frame(frame_u8)
             fn = self._step
             if self._cache_interval:
                 # full/capture every Nth step, cached between (static
@@ -936,7 +981,7 @@ class StreamEngine:
                 if self._tick % self._cache_interval != 0:
                     fn = self._step_cached
                 self._tick += 1
-            self.state, out = fn(self.params, self.state, frame_u8)
+            self.state, out = fn(self.params, self.state, staged)
             try:  # overlap device->host copy with subsequent compute
                 out.copy_to_host_async()
             except (AttributeError, RuntimeError):

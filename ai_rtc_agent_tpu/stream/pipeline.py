@@ -344,10 +344,15 @@ class StreamDiffusionPipeline:
         if trace is not None:
             t0 = time.monotonic()
         out = self.engine.fetch(handle)
+        if trace is not None:
+            # resolve-end stamped BEFORE the safety checker: fetch is the
+            # blocking readback hop, and a CLIP forward riding its span
+            # would inflate exactly the histogram the SLO fetch budget
+            # fences (the scheduler's fetch stamps the same way)
+            t1 = time.monotonic()
         if self.safety_checker is not None:
             out = self.safety_checker(out)
         if trace is not None:
-            t1 = time.monotonic()
             # fetch = the blocking host-side resolve; engine_step = the
             # frame's device residency, submit-end -> resolve-end (the
             # host-observable bound on the async step — stamped OUTSIDE

@@ -31,6 +31,21 @@ axis with other users' frames instead:
   scheduler feeds the admission step-EWMA **per-batch-amortized** latency
   (``dt / occupancy``) via :attr:`on_step`, so advertised capacity
   reflects the batching gain.
+* The frame path is **device-resident between the locks** (ISSUE 9): a
+  session's submit stages its H2D copy (``stage_frame``) before any lock
+  is taken, the bucket step consumes device-side rows (``jnp.stack`` of
+  already-transferred frames), and at dispatch the output is sliced into
+  per-slot rows ON DEVICE with ``copy_to_host_async`` kicked per row —
+  each session's fetch resolves ONLY its own buffer (memoized on the
+  batch row, so dup/skip fetches never re-resolve), so frame N's dispatch
+  overlaps frame N−1's readback and one session's readback never bills
+  the others.
+* **Speed variants ride the same bucket steps**: ``QUANT_WEIGHTS=w8``
+  params serve unchanged (the dequant lives in the layer primitives; the
+  AOT keys gain ``quant-w8``), and the DeepCache cadence (``UNET_CACHE``)
+  runs as a GLOBAL tick over (k, capture|cached)-keyed bucket executables
+  — the multipeer discipline: any install/prompt/t-index write resets the
+  cadence so a zeroed or stale deep-feature cache is never consumed.
 
 Outputs are bit-identical to a dedicated engine per session (pinned by
 tests/test_batch_scheduler.py across join/leave, prompt updates and
@@ -58,32 +73,46 @@ from ..obs.trace import get_trace, safe_list
 from ..parallel.multipeer import CapacityError, make_bucket_step
 from ..resilience.overload import DeadlineQueue, ShedFrame
 from ..utils import env
-from .engine import SimilarityFilter, StreamEngine, make_step_fn, stream_engine_key
+from .engine import (
+    SimilarityFilter,
+    StreamEngine,
+    make_step_fn,
+    params_variant_extra,
+    stage_frame,
+    stream_engine_key,
+)
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["BatchScheduler", "ScheduledSession", "CapacityError"]
 
 
-class _InlineBatch:
-    """A batch dispatched INLINE on a submitter's thread (every live
-    session had work the moment this frame arrived — no dispatcher
-    handoff, no window).  Each rider's fetch resolves the shared device
-    buffer independently (jax caches the host copy after the first
-    conversion); the first resolver does the per-batch accounting.
-    ``feed``: False when this was a bucket's first (possibly lazily
-    compiled) use — its duration must not reach the admission EWMA."""
+class _DispatchedBatch:
+    """One dispatched bucket step's per-slot readback plane.
+
+    At dispatch the ``[k, ...]`` output is sliced into per-entry device
+    rows and every row's D2H copy is started asynchronously — each
+    rider's fetch resolves ONLY its own row (``BatchScheduler.
+    _resolve_row``), so one session's readback never bills the others and
+    the next dispatch overlaps this batch's readbacks.  Host copies are
+    memoized per row (dup/skip fetches re-read the cached array, never
+    the device).  ``feed``: False when this was a bucket's first
+    (possibly lazily compiled) use — its duration must not reach the
+    admission EWMA."""
 
     __slots__ = (
-        "out", "entries", "t_dispatch", "occupancy", "resolved", "feed",
+        "rows", "host", "rlocks", "entries", "t_dispatch", "occupancy",
+        "resolved", "feed",
     )
 
-    def __init__(self, out, entries, t_dispatch, occupancy, feed=True):
-        self.out = out
+    def __init__(self, rows, entries, t_dispatch, occupancy, feed=True):
+        self.rows = rows  # per-entry device buffers (async D2H in flight)
+        self.host = [None] * len(rows)  # memoized per-row host copies
+        self.rlocks = [threading.Lock() for _ in rows]
         self.entries = entries
         self.t_dispatch = t_dispatch
         self.occupancy = occupancy
-        self.resolved = False
+        self.resolved = False  # first-row-resolved: accounting + in-flight
         self.feed = feed
 
 
@@ -93,21 +122,22 @@ class _PendingFrame:
     -> resolve = engine_step)."""
 
     __slots__ = (
-        "frame", "future", "trace", "t_enq", "t_dispatch", "occupancy",
-        "skipped", "inline_out",
+        "frame", "frame_dev", "future", "trace", "t_enq", "t_dispatch",
+        "occupancy", "skipped", "readback",
     )
 
     def __init__(self, frame, trace=None):
-        self.frame = frame
+        self.frame = frame  # host pixels (shed-passthrough + similarity)
+        self.frame_dev = None  # staged device copy (stage_frame at submit)
         self.future: Future = Future()
         self.trace = trace
         self.t_enq = time.monotonic()
         self.t_dispatch: float | None = None
         self.occupancy = 0
         self.skipped = False
-        # inline fast path: (batch, row) of an _InlineBatch this frame
-        # rode — resolved directly at fetch, bypassing the future
-        self.inline_out: tuple | None = None
+        # (batch, row) of the _DispatchedBatch this frame rode — the
+        # submitter resolves it directly at fetch, bypassing the future
+        self.readback: tuple | None = None
 
 
 class ScheduledSession:
@@ -217,6 +247,15 @@ class ScheduledSession:
             last.future.add_done_callback(_copy)
             return p
         p = _PendingFrame(arr, trace)
+        # stage the H2D copy NOW, on the caller's thread, before any
+        # scheduler lock: concurrent sessions' transfers overlap each
+        # other and in-flight compute instead of serializing behind the
+        # dispatch (the engine-submit staging rule, shared helper).
+        # Staged ROW-SHAPED ([1,H,W,3] — the [None] is a free host view):
+        # a solo dispatch uses the buffer as-is and a batch is one
+        # device-side concatenate, so the hot path never pays a per-frame
+        # reshape op (per-op dispatch is real money at small step sizes)
+        p.frame_dev = stage_frame(arr[None])
         self._owner._enqueue(self.slot, p)
         if self._sim is not None:
             # dup-chain anchor — only the similarity filter ever reads it
@@ -231,12 +270,12 @@ class ScheduledSession:
         if trace is None and src_frame is not None:
             trace = get_trace(src_frame)
         t0 = time.monotonic()
-        if handle.inline_out is not None:
-            # fast path: resolve the inline batch's buffer right here (the
+        if handle.readback is not None:
+            # fast path: resolve THIS session's row right here (the
             # dedicated-engine flow — submit dispatched, fetch blocks on
-            # readback, zero thread handoffs)
-            batch, row = handle.inline_out
-            out, t1 = self._owner._resolve_inline(batch, row, t0)
+            # its own per-slot readback, zero thread handoffs)
+            batch, row = handle.readback
+            out, t1 = self._owner._resolve_row(batch, row, t0)
         else:
             try:
                 out = handle.future.result(timeout=self._owner.fetch_timeout)
@@ -248,12 +287,12 @@ class ScheduledSession:
             if (
                 isinstance(out, tuple)
                 and len(out) == 2
-                and isinstance(out[0], _InlineBatch)
+                and isinstance(out[0], _DispatchedBatch)
             ):
-                # this frame was waiting in the window when another
-                # session's submit completed the batch and dispatched it
-                # inline — the marker routes us to the shared buffer
-                out, t1 = self._owner._resolve_inline(out[0], out[1], t0)
+                # this frame was waiting in the window when a dispatch
+                # (inline or dispatcher) claimed it — the marker routes us
+                # to our own per-slot row of that batch
+                out, t1 = self._owner._resolve_row(out[0], out[1], t0)
             else:
                 t1 = time.monotonic()
         if isinstance(out, ShedFrame):
@@ -363,12 +402,6 @@ class BatchScheduler:
             DEFAULT_PROMPT,
         )
 
-        if cfg.unet_cache_interval >= 2:
-            raise ValueError(
-                "the batch scheduler does not support UNET_CACHE (per-slot "
-                "DeepCache cadence would diverge from dedicated engines); "
-                "use the shared engine or --multipeer"
-            )
         if cfg.frame_buffer_size != 1:
             raise ValueError(
                 "the batch scheduler batches SESSIONS; frame_buffer_size "
@@ -414,7 +447,33 @@ class BatchScheduler:
             models, params, cfg, encode_prompt,
             schedule=schedule, jit_compile=False,
         )
-        self._vstep = jax.vmap(make_step_fn(models, cfg), in_axes=(None, 0, 0))
+        # DeepCache (UNET_CACHE) rides the scheduler as a GLOBAL cadence
+        # over TWO vmapped graphs per bucket size — the multipeer
+        # discipline: every slot captures on the same tick, installs and
+        # control-plane writes reset the cadence so a zeroed/stale deep
+        # cache is never consumed (sessions stay output-identical to a
+        # dedicated engine stepping the same cadence)
+        self._cache_interval = (
+            cfg.unet_cache_interval if cfg.unet_cache_interval >= 2 else 0
+        )
+        self._tick = 0
+        # slots whose unet_cache row must NOT be consumed (zeroed by
+        # install/recovery, or stale after a prompt/t-index write).  The
+        # global tick reset alone is NOT enough: a bucket step only
+        # touches its RIDERS' rows, so a freshly joined slot that sits
+        # out the post-install capture batch would later ride a cached
+        # batch with an all-zeros deep-feature row (code-review r1) —
+        # any batch carrying an uncaptured rider is FORCED to capture
+        self._uncaptured: set = set()
+        self._variants = (
+            ("capture", "cached") if self._cache_interval else ("full",)
+        )
+        self._vsteps = {
+            v: jax.vmap(
+                make_step_fn(models, cfg, unet_variant=v), in_axes=(None, 0, 0)
+            )
+            for v in self._variants
+        }
         S = self.max_sessions
         sizes, b = [], 1
         while b < S:
@@ -444,19 +503,23 @@ class BatchScheduler:
         self._lock = threading.Lock()
         self._has_work = threading.Condition(self._lock)
         self._stop = False
-        # in-flight throttles for the inline fast path: dispatcher batches
-        # (counter) + inline batches (bounded ring of _InlineBatch refs;
-        # resolved flags flip at fetch, abandoned batches age out so a
-        # caller that stops fetching degrades to the bounded queue path
-        # instead of wedging the fast path forever)
-        self._dispatcher_inflight = 0
-        self._inline_batches: deque = deque(maxlen=16)
+        # in-flight throttle: bounded ring of _DispatchedBatch refs (every
+        # dispatch path registers here); resolved flags flip at the first
+        # per-row fetch, abandoned batches age out so a caller that stops
+        # fetching degrades to the bounded queue path instead of wedging
+        # dispatch forever.  _throttled: the dispatcher is parked on the
+        # in-flight cap — the ONLY case a resolver must pay a lock to
+        # notify (a plain-attribute read keeps the hot fetch path off the
+        # dispatch lock)
+        self._batches: deque = deque(maxlen=16)
+        self._throttled = False
         self._stats_lock = threading.Lock()
-        # bucket sizes that have completed at least one dispatch (or were
-        # prewarmed/AOT-adopted): a bucket's FIRST use may carry a lazy
-        # jit compile, and compile-sized latency must never feed the
-        # admission EWMA (the ResilientPipeline warm-step rule — every
-        # cold occupancy transition would otherwise 503 concurrent offers)
+        # (bucket size, variant) pairs that have completed at least one
+        # dispatch (or were prewarmed/AOT-adopted): a bucket's FIRST use
+        # may carry a lazy jit compile, and compile-sized latency must
+        # never feed the admission EWMA (the ResilientPipeline warm-step
+        # rule — every cold occupancy transition would otherwise 503
+        # concurrent offers)
         self._warmed_buckets: set = set()
         # pad-tuple -> device index array: materializing a jnp.int32 array
         # from a python list costs ~0.4 ms per dispatch on CPU — a real
@@ -638,6 +701,13 @@ class BatchScheduler:
             lambda stacked, fresh: stacked.at[slot].set(fresh),
             self.states, state,
         )
+        if self._cache_interval:
+            # the fresh slot's unet_cache row is zeros — make the NEXT
+            # global step a capture (multipeer install() contract) AND
+            # track the slot: if it sits out that batch, its first ride
+            # still forces a capture
+            self._tick = 0
+            self._uncaptured.add(slot)
 
     def _encode(self, prompt: str):
         with self._heavy_lock:
@@ -660,6 +730,13 @@ class BatchScheduler:
                     .at[slot]
                     .set(jnp.asarray(extras["pooled"], dt))
                 )
+            if self._cache_interval:
+                # DeepCache: stale deep cross-attention features must not
+                # serve under the NEW prompt — recapture globally (same
+                # contract as StreamEngine.update_prompt) and pin THIS
+                # slot until a capture batch actually carries it
+                self._tick = 0
+                self._uncaptured.add(slot)
 
     def _apply_t_index(self, slot: int, t_index_list):
         from .engine import _coeff_state
@@ -676,6 +753,9 @@ class BatchScheduler:
                 self.states["coeffs"][k] = (
                     self.states["coeffs"][k].at[slot].set(v)
                 )
+            if self._cache_interval:
+                self._tick = 0  # new timesteps -> global recapture
+                self._uncaptured.add(slot)
 
     def _apply_guidance(self, slot: int, guidance, delta):
         with self._lock:
@@ -766,19 +846,21 @@ class BatchScheduler:
             self._idx_cache[key] = idx
         return idx
 
-    def _bucket_step(self, k: int):
-        step = self._bucket_steps.get(k)
+    def _bucket_step(self, k: int, variant: str = "full"):
+        step = self._bucket_steps.get((k, variant))
         if step is None:
             step = jax.jit(
                 make_bucket_step(
-                    self._vstep, self.max_sessions, scatter_output=False
+                    self._vsteps[variant], self.max_sessions,
+                    scatter_output=False,
                 ),
                 donate_argnums=(1,),
             )
-            self._bucket_steps[k] = step
+            self._bucket_steps[(k, variant)] = step
             logger.info(
-                "batchsched bucket step %d/%d registered (compiles on "
-                "first use unless prewarmed)", k, self.max_sessions,
+                "batchsched bucket step %d/%d (%s) registered (compiles "
+                "on first use unless prewarmed)", k, self.max_sessions,
+                variant,
             )
         return step
 
@@ -792,28 +874,35 @@ class BatchScheduler:
         )
 
     def bucket_keys(self, model_id: str | None = None) -> dict:
-        """{bucket size k -> engine-cache key} — the single key recipe
-        shared by serving adoption and the build CLI (``sbucket``/
-        ``sessions`` extend the stream key exactly like ``peers`` does
-        for --multipeer)."""
+        """{(bucket size k, unet variant) -> engine-cache key} — the
+        single key recipe shared by serving adoption and the build CLI
+        (``sbucket``/``sessions`` extend the stream key exactly like
+        ``peers`` does for --multipeer; a DeepCache config keys a
+        capture+cached PAIR per bucket, and w8-quantized params add
+        ``quant-w8`` the way ``attn``/``fused`` already ride the key)."""
         model_id = model_id or self.model_id
+        qextra = params_variant_extra(self.params)
         return {
-            k: stream_engine_key(
-                model_id, self.cfg, sbucket=k, sessions=self.max_sessions
+            (k, v): stream_engine_key(
+                model_id, self.cfg, sbucket=k, sessions=self.max_sessions,
+                **({"variant": v} if v != "full" else {}),
+                **qextra,
             )
             for k in self._bucket_sizes
+            for v in self._variants
         }
 
     def aot_status(self, model_id: str | None = None,
                    cache_dir: str | None = None) -> dict:
-        """{bucket size -> already serialized?} via EngineCache.has() —
-        lets the build CLI pre-warm only the missing geometries."""
+        """{(bucket size, variant) -> already serialized?} via
+        EngineCache.has() — lets the build CLI pre-warm only the missing
+        geometries."""
         from ..aot.cache import EngineCache
 
         cache = EngineCache(cache_dir)
         return {
-            k: cache.has(key, self._bucket_specs(k))
-            for k, key in self.bucket_keys(model_id).items()
+            kv: cache.has(key, self._bucket_specs(kv[0]))
+            for kv, key in self.bucket_keys(model_id).items()
         }
 
     def use_aot_cache(
@@ -829,15 +918,16 @@ class BatchScheduler:
         cache = EngineCache(cache_dir)
         keys = self.bucket_keys(model_id)
         if not build_on_miss and not all(
-            cache.has(key, self._bucket_specs(k)) for k, key in keys.items()
+            cache.has(key, self._bucket_specs(k))
+            for (k, _v), key in keys.items()
         ):
             return False
         calls = {}
-        for k, key in keys.items():
+        for (k, v), key in keys.items():
             call = cache.load_or_build(
                 key,
                 make_bucket_step(
-                    self._vstep, self.max_sessions, scatter_output=False
+                    self._vsteps[v], self.max_sessions, scatter_output=False
                 ),
                 self._bucket_specs(k),
                 donate_argnums=(1,),
@@ -845,31 +935,33 @@ class BatchScheduler:
             )
             if call is None:
                 return False
-            calls[k] = call
+            calls[(k, v)] = call
         self._bucket_steps.update(calls)
         self._warmed_buckets.update(calls)
         self._aot_adopted = True
         return True
 
     def prewarm_buckets(self):
-        """Eagerly compile every bucket geometry NOW (jit alone is lazy):
-        occupancy transitions at serve time must dispatch, not compile —
-        a join stalling every live session on a retrace is exactly what
-        this subsystem exists to remove."""
+        """Eagerly compile every (bucket geometry, unet variant) NOW (jit
+        alone is lazy): occupancy transitions at serve time must dispatch,
+        not compile — a join stalling every live session on a retrace is
+        exactly what this subsystem exists to remove."""
         for k in self._bucket_sizes:
-            if self._aot_adopted and k in self._bucket_steps:
-                continue
-            params_s, states_s, frames_s, idx_s = self._bucket_specs(k)
-            compiled = (
-                self._bucket_step(k)
-                .lower(params_s, states_s, frames_s, idx_s)
-                .compile()
-            )
-            self._bucket_steps[k] = compiled
-            self._warmed_buckets.add(k)
-            logger.info(
-                "prewarmed batchsched bucket %d/%d", k, self.max_sessions
-            )
+            for v in self._variants:
+                if self._aot_adopted and (k, v) in self._bucket_steps:
+                    continue
+                params_s, states_s, frames_s, idx_s = self._bucket_specs(k)
+                compiled = (
+                    self._bucket_step(k, v)
+                    .lower(params_s, states_s, frames_s, idx_s)
+                    .compile()
+                )
+                self._bucket_steps[(k, v)] = compiled
+                self._warmed_buckets.add((k, v))
+                logger.info(
+                    "prewarmed batchsched bucket %d/%d (%s)",
+                    k, self.max_sessions, v,
+                )
 
     # -- coalescing window + dispatcher ---------------------------------------
 
@@ -884,19 +976,17 @@ class BatchScheduler:
         except InvalidStateError:
             pass  # lost a teardown race — the waiter is unblocked either way
 
-    def _inline_in_flight(self, now: float) -> int:
+    def _batches_in_flight(self, now: float) -> int:
         return sum(
             1
-            for b in self._inline_batches
+            for b in self._batches
             if not b.resolved and now - b.t_dispatch < 60.0
         )
 
     def _enqueue(self, slot: int, pending: _PendingFrame):
         with self._has_work:
             room = (
-                self._dispatcher_inflight
-                + self._inline_in_flight(pending.t_enq)
-                < self.PIPELINE_DEPTH
+                self._batches_in_flight(pending.t_enq) < self.PIPELINE_DEPTH
             )
             if (
                 room
@@ -907,7 +997,7 @@ class BatchScheduler:
                 # — dispatch THIS frame without touching the window queue
                 # at all (the pass-through-cheap promise: a lock and a
                 # gather/scatter, not a queue round-trip + thread handoff)
-                self._dispatch_entries_locked([(slot, pending)], slot)
+                self._dispatch_entries_locked([(slot, pending)], pending)
                 return
             self._queues[slot].push(pending, stamp=pending.t_enq)
             if room and len(self._waiting_slots()) >= self.active.count(
@@ -915,13 +1005,13 @@ class BatchScheduler:
             ):
                 # fast path: THIS frame completed the batch (every live
                 # session has work) — dispatch NOW on the caller thread:
-                # no window, no dispatcher handoff; fetch resolves the
-                # shared buffer directly
-                self._dispatch_inline_locked(slot)
+                # no window, no dispatcher handoff; each rider's fetch
+                # resolves its own per-slot row
+                self._dispatch_inline_locked(pending)
                 return
             self._has_work.notify()
 
-    def _dispatch_inline_locked(self, submitter_slot: int):
+    def _dispatch_inline_locked(self, submitter: _PendingFrame):
         entries = []
         for s in self._waiting_slots():
             got = self._queues[s].pop()
@@ -929,42 +1019,87 @@ class BatchScheduler:
                 entries.append((s, got[0]))
         if not entries:
             return
-        self._dispatch_entries_locked(entries, submitter_slot)
+        self._dispatch_entries_locked(entries, submitter)
 
     def _step_batch_locked(self, entries):
         """The ONE dispatch sequence both paths share (dispatcher loop and
         inline fast path): bucket-select, pad with the last ready row,
-        stack, stamp, step, kick the async readback.  Caller holds the
-        lock; a raising step is the caller's to deliver to the waiters.
-        -> (out, t_disp, occ, feed): ``feed`` False on a bucket's first
-        use (a lazy compile may ride it — not a capacity signal)."""
+        stack the PRE-STAGED device frames, stamp, step, slice per-slot
+        rows on device and kick each row's async readback.  Caller holds
+        the lock; a raising step is the caller's to deliver to the
+        waiters.  -> (rows, t_disp, occ, feed): ``feed`` False on a
+        bucket variant's first use (a lazy compile may ride it — not a
+        capacity signal)."""
         idx = [s for s, _ in entries]
         k = self._bucket_for(len(idx))
         pad = (idx + [idx[-1]] * k)[:k]
-        by_slot = {s: p.frame for s, p in entries}
+        # frames were staged to device ROW-SHAPED at submit time
+        # (stage_frame, outside any lock): a solo bucket consumes the
+        # staged buffer with ZERO extra device ops, a wider bucket pays
+        # one concatenate — never an H2D copy under the dispatch lock
+        by_slot = {
+            s: (
+                stage_frame(p.frame[None])
+                if p.frame_dev is None
+                else p.frame_dev
+            )
+            for s, p in entries
+        }
         frames_k = (
-            entries[0][1].frame[None]
+            by_slot[idx[0]]
             if k == 1
-            else np.stack([by_slot[s] for s in pad])
+            else jnp.concatenate([by_slot[s] for s in pad], axis=0)
         )
         t_disp = time.monotonic()
         occ = len(entries)
         for _, p in entries:
             p.t_dispatch = t_disp
             p.occupancy = occ
-        feed = k in self._warmed_buckets
-        self.states, out = self._bucket_step(k)(
+        variant = "full"
+        if self._cache_interval:
+            # global DeepCache cadence: full capture every Nth batch step,
+            # the cheap cached graph between (both compiled; the host just
+            # picks one — no data-dependent control flow on device).  A
+            # batch carrying any UNCAPTURED rider (joined/prompt-updated
+            # slot that sat out the post-reset capture) is FORCED to
+            # capture: an off-cadence extra capture is merely slower, a
+            # cached step over a zeroed/stale deep-feature row is wrong
+            variant = (
+                "capture"
+                if (
+                    self._tick % self._cache_interval == 0
+                    or any(s in self._uncaptured for s in idx)
+                )
+                else "cached"
+            )
+            self._tick += 1
+            if variant == "capture":
+                self._uncaptured.difference_update(idx)
+        feed = (k, variant) in self._warmed_buckets
+        self.states, out = self._bucket_step(k, variant)(
             self.params,
             self.states,
-            jax.device_put(frames_k),
+            frames_k,
             self._idx_for(pad),
         )
-        self._warmed_buckets.add(k)
-        try:  # overlap readback with subsequent compute
-            out.copy_to_host_async()
-        except (AttributeError, RuntimeError):
-            pass
-        return out, t_disp, occ, feed
+        self._warmed_buckets.add((k, variant))
+        # per-slot readback plane: slice each rider's row ON DEVICE and
+        # start its D2H copy now — a fetch resolves only its own buffer,
+        # so one session's readback never bills the others and the next
+        # dispatch overlaps these copies.  A solo batch skips the slice
+        # (its whole output IS the row — _resolve_row squeezes leading
+        # singleton axes on the host for free)
+        rows = (
+            [out]
+            if len(entries) == 1
+            else [out[i] for i in range(len(entries))]
+        )
+        for r in rows:
+            try:
+                r.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+        return rows, t_disp, occ, feed
 
     @staticmethod
     def _fail_entries(entries, exc):
@@ -1005,6 +1140,9 @@ class BatchScheduler:
                         )
                     per.append(placeholder)
             self.states = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+            if self._cache_interval:
+                self._tick = 0  # fresh (zeroed) deep caches -> recapture
+                self._uncaptured.update(range(self.max_sessions))
             logger.warning(
                 "batchsched: rebuilt %d session state rows after a failed "
                 "step (%r)", self.max_sessions, cause,
@@ -1015,37 +1153,52 @@ class BatchScheduler:
                 "passthrough until restart/reclaim"
             )
 
-    def _dispatch_entries_locked(self, entries, submitter_slot: int):
+    def _dispatch_entries_locked(
+        self, entries, submitter: "_PendingFrame | None"
+    ):
+        """Dispatch + hand every rider its per-slot readback.
+        ``submitter``: the EXACT pending whose submit is running this
+        dispatch inline (None = dispatcher thread — every future gets the
+        marker; there is no caller to re-raise into).  Identity matters:
+        the inline path pops each slot's OLDEST queued frame, which for
+        the submitter's own slot may be an EARLIER frame than the one
+        just submitted — that frame's waiter may already be blocked on
+        its future, so only the submitted pending itself may skip the
+        future machinery (code-review r1)."""
         try:
-            out, t_disp, occ, feed = self._step_batch_locked(entries)
+            rows, t_disp, occ, feed = self._step_batch_locked(entries)
         except Exception as e:
-            # an inline dispatch failing must unblock EVERY rider's future
-            # (the other sessions' fetches would otherwise hang out the
-            # full fetch timeout) and surface in the submitter's track
+            # a dispatch failing must unblock EVERY rider's future (the
+            # other sessions' fetches would otherwise hang out the full
+            # fetch timeout) and surface in the submitter's track
             self._fail_entries(entries, e)
             self._recover_states_locked(e)
+            if submitter is None:
+                return
             raise
-        batch = _InlineBatch(out, entries, t_disp, occ, feed=feed)
-        if any(b.resolved for b in self._inline_batches):
+        batch = _DispatchedBatch(rows, entries, t_disp, occ, feed=feed)
+        if any(b.resolved for b in self._batches):
             # drop resolved batches WHEREVER they sit — the ring exists
             # only for the in-flight count, and a resolved batch kept
-            # behind an unresolved head would pin its input frames +
-            # output buffer (MBs each at real geometry) until it aged out;
-            # riders still mid-resolve hold their own refs via the handle
-            self._inline_batches = deque(
-                (b for b in self._inline_batches if not b.resolved),
-                maxlen=self._inline_batches.maxlen,
+            # behind an unresolved head would pin its unread row buffers
+            # (MBs each at real geometry) until it aged out; riders still
+            # mid-resolve hold their own refs via the handle
+            self._batches = deque(
+                (b for b in self._batches if not b.resolved),
+                maxlen=self._batches.maxlen,
             )
-        self._inline_batches.append(batch)
+        self._batches.append(batch)
         for i, (s, p) in enumerate(entries):
-            p.inline_out = (batch, i)
-            # other sessions may ALREADY be blocked on their future (their
+            p.readback = (batch, i)
+            # other riders may ALREADY be blocked on their future (their
             # frame sat in the window when this dispatch claimed it) — a
-            # marker result wakes them into the shared-buffer resolve.
-            # The submitter's own entry skips the Future machinery unless
-            # a similarity-skip dup may chain off it.
+            # marker result wakes them into their own per-row resolve.
+            # Only the EXACT pending whose submit is running this dispatch
+            # skips the Future machinery (its fetch hasn't started yet),
+            # and even it keeps the future when a similarity-skip dup may
+            # chain off it.
             sess = self._sessions.get(s)
-            if s != submitter_slot or (
+            if p is not submitter or (
                 sess is not None and sess._sim is not None
             ):
                 try:
@@ -1054,18 +1207,44 @@ class BatchScheduler:
                 except InvalidStateError:
                     pass
 
-    def _resolve_inline(self, batch: _InlineBatch, row: int, t0: float):
-        """Resolve one rider of an inline batch against the shared device
-        buffer (jax caches the host copy, so concurrent riders pay one
-        readback between them); the first resolver does the per-batch
-        accounting."""
-        arr = np.asarray(batch.out)
-        if arr.ndim == 5 and arr.shape[1] == 1:
-            arr = arr[:, 0]
-        out = arr[row]
+    def _resolve_row(self, batch: _DispatchedBatch, row: int, t0: float):
+        """Resolve ONE rider's per-slot row of a dispatched batch.  The
+        host copy is memoized on the batch row (dup/skip fetches re-read
+        it, never the device) and each row resolves under its OWN lock —
+        one session's readback never serializes another's.  The first
+        resolver (any row) does the per-batch accounting."""
+        out = batch.host[row]
+        if out is None:
+            with batch.rlocks[row]:
+                out = batch.host[row]
+                if out is None:
+                    try:
+                        arr = np.asarray(batch.rows[row])  # this row ONLY
+                    except Exception:
+                        # a failed readback must FREE the in-flight slot
+                        # right away (the old dispatcher drain did): left
+                        # unresolved, this batch would throttle dispatch
+                        # for the full 60s age-out while every session's
+                        # window sheds.  No EWMA feed — a failure is not
+                        # a capacity sample.  The error surfaces to THIS
+                        # caller; other riders hit their own rows' errors.
+                        with self._stats_lock:
+                            batch.resolved = True
+                        if self._throttled:
+                            with self._has_work:
+                                self._has_work.notify()
+                        raise
+                    # host-side squeeze (free): a sliced row is
+                    # [fbs=1,H,W,3], a solo batch's unsliced output is
+                    # [k=1,fbs=1,H,W,3]; the scheduler is fbs==1 only
+                    while arr.ndim > 3 and arr.shape[0] == 1:
+                        arr = arr[0]
+                    batch.host[row] = arr
+                    batch.rows[row] = None  # release the device buffer
+                    out = arr
         t1 = time.monotonic()
         first = False
-        with self._lock:
+        with self._stats_lock:
             if not batch.resolved:
                 batch.resolved = True
                 first = True
@@ -1085,6 +1264,12 @@ class BatchScheduler:
                 batch.entries,
                 feed=batch.feed,
             )
+            if self._throttled:
+                # an in-flight slot just freed and the dispatcher is
+                # parked on the backpressure cap — wake it (a racing
+                # park falls back on its wait timeout)
+                with self._has_work:
+                    self._has_work.notify()
         return out, t1
 
     def _waiting_slots(self):
@@ -1108,15 +1293,29 @@ class BatchScheduler:
     PIPELINE_DEPTH = 2
 
     def _run(self):
-        inflight: deque = deque(maxlen=self.PIPELINE_DEPTH)
+        """Window-expiry dispatcher.  Dispatch is all it does now: every
+        rider's future gets its per-slot readback marker at dispatch time
+        and the riders resolve their OWN rows on their fetch threads — the
+        dispatcher never blocks on a device->host copy, so batch N+1
+        dispatches while batch N's readbacks drain on the fetchers."""
         while True:
             with self._has_work:
                 while not self._stop:
                     waiting = self._waiting_slots()
                     if not waiting:
-                        if inflight:
-                            break  # drain the readback below
                         self._has_work.wait(timeout=0.5)
+                        continue
+                    if (
+                        self._batches_in_flight(time.monotonic())
+                        >= self.PIPELINE_DEPTH
+                    ):
+                        # backpressure: a rider's first row-resolve frees a
+                        # slot and notifies (it checks _throttled); the
+                        # timeout is a safety net for abandoned batches
+                        # (they age out at 60s) and the set/check race
+                        self._throttled = True
+                        self._has_work.wait(timeout=0.05)
+                        self._throttled = False
                         continue
                     live = self.active.count(True)
                     if (
@@ -1145,48 +1344,8 @@ class BatchScheduler:
                     if got is not None:
                         entries.append((s, got[0]))
                 if entries:
-                    try:
-                        out, t_disp, occ, feed = self._step_batch_locked(
-                            entries
-                        )
-                        inflight.append((out, entries, t_disp, occ, feed))
-                        self._dispatcher_inflight = len(inflight)
-                    except Exception as e:
-                        self._fail_entries(entries, e)
-                        self._recover_states_locked(e)
-                more_waiting = bool(self._waiting_slots())
-            # readback (device->host) outside the lock: control traffic
-            # and the next dispatch proceed while this drains
-            if inflight and (
-                len(inflight) >= self.PIPELINE_DEPTH or not more_waiting
-            ):
-                out, entries, t_disp, occ, feed = inflight.popleft()
-                try:
-                    arr = np.asarray(out)
-                except Exception as e:
-                    with self._lock:
-                        self._dispatcher_inflight = len(inflight)
-                    for _, p in entries:
-                        if not p.future.cancelled():
-                            p.future.set_exception(e)
-                    continue
-                if arr.ndim == 5 and arr.shape[1] == 1:  # [k, fbs=1, H, W, 3]
-                    arr = arr[:, 0]
-                self._note_step(
-                    time.monotonic() - t_disp, occ, entries, feed=feed
-                )
-                # k-shaped output: entries[i] rode batch row i (padding
-                # rows, if any, sit past len(entries) and are discarded)
-                for i, (_s, p) in enumerate(entries):
-                    if not p.future.cancelled():
-                        p.future.set_result(arr[i])
-                with self._lock:
-                    self._dispatcher_inflight = len(inflight)
+                    self._dispatch_entries_locked(entries, None)
         # drain on stop
-        while inflight:
-            _, entries, _, _, _ = inflight.popleft()
-            for _, p in entries:
-                p.future.cancel()
         for q in self._queues:
             while True:
                 got = q.pop()

@@ -146,6 +146,16 @@ def batchsched_enabled() -> bool:
     return get_bool("BATCHSCHED", True)
 
 
+def perf_log_path(default: str) -> str:
+    """PERF_LOG_PATH with the bench-banking semantics: unset -> the
+    caller's default (the repo log); an EMPTY value -> ``""`` (banking
+    disabled — the watcher's own append-and-commit is the sole writer).
+    Plain :func:`get_str` would collapse empty to the default and
+    silently re-enable self-banking."""
+    v = os.getenv("PERF_LOG_PATH")
+    return default if v is None else v
+
+
 def pipeline_depth() -> int:
     """Frames kept in flight on the device per track (PIPELINE_DEPTH).
 

@@ -1,0 +1,41 @@
+"""Shared PERF_LOG.jsonl banking for the bench scripts.
+
+Four bench scripts (host_plane, trace_overhead, batch_scheduler,
+device_path) grew byte-identical ``_bank`` helpers; any change to the
+banking contract had to be replicated in each.  This is the one
+implementation they all import.
+
+Semantics (relied on by scripts/tpu_watch.sh):
+* ``PERF_LOG_PATH`` unset -> append to the repo's ``PERF_LOG.jsonl``;
+* ``PERF_LOG_PATH`` set EMPTY (or to os.devnull) -> banking DISABLED —
+  the watcher items set ``PERF_LOG_PATH=`` so its own labeled
+  append-and-commit is the only writer;
+* an OSError never raises: the contract line must still print, the
+  failure is recorded on the entry as ``bank_error``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import env
+
+#: repo root (this file lives at <repo>/ai_rtc_agent_tpu/utils/)
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def bank(entry: dict, repo_root: str | None = None) -> None:
+    """Append one contract line to the banked trajectory (see module
+    docstring for the PERF_LOG_PATH semantics)."""
+    default = os.path.join(repo_root or _REPO, "PERF_LOG.jsonl")
+    path = env.perf_log_path(default)
+    if not path or path == os.devnull:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        entry["bank_error"] = str(e)

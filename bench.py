@@ -50,6 +50,18 @@ def _refuse_backend(expected: str, actual: str):
     )
 
 
+def env_unet_cache() -> int:
+    """DeepCache interval from the UNET_CACHE env (``N`` or
+    ``deepcache:N``), 0 when unset/off — the contract-line label must be
+    right even on the failure/replay path where no config is ever built
+    (registry.default_stream_config honors the same env)."""
+    import os
+
+    env_cache = (os.getenv("UNET_CACHE") or "").strip()
+    tail = env_cache.split(":", 1)[-1]
+    return int(tail) if tail.isdigit() and int(tail) >= 2 else 0
+
+
 def build_engine(config: str, fbs: int = 1, unet_cache: int = 0):
     import jax
 
@@ -165,6 +177,12 @@ def run_bench(config: str, frames: int, pipeline_depth: int = 4, fbs: int = 1,
     )
     r["stage_ms"] = _stage_breakdown(eng, frame)
     r["mfu"] = _estimate_mfu(eng, frame, r["fps"], fbs)
+    if cfg.unet_cache_interval >= 2:
+        # label from the BUILT config, not the flag: default_stream_config
+        # honors the UNET_CACHE env var, and a cached-cadence number must
+        # never bank (or replay/fence) as the dense baseline even when
+        # the cadence arrived via env instead of --unet-cache
+        r["unet_cache"] = cfg.unet_cache_interval
     return r
 
 
@@ -273,6 +291,8 @@ def run_bench_multipeer(frames: int, peers: int = 4, pipeline_depth: int = 4,
     r["peers"] = peers
     if active != peers:
         r["active"] = active
+    if cfg.unet_cache_interval >= 2:
+        r["unet_cache"] = cfg.unet_cache_interval  # built config, not flag
     return r
 
 
@@ -650,6 +670,10 @@ def main():
         result["pipeline_depth"] = args.pipeline_depth
     if args.unet_cache >= 2:
         result["unet_cache"] = args.unet_cache
+    elif env_unet_cache():
+        # the cadence can also arrive via the UNET_CACHE env — label it
+        # up front (the measurement path re-stamps from the BUILT config)
+        result["unet_cache"] = env_unet_cache()
     if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
         result["quant"] = "w8"
     if args.config == "multipeer":
@@ -746,7 +770,7 @@ def main():
             latency_p50_ms=round(r["latency_p50_ms"], 1),
             latency_p90_ms=round(r["latency_p90_ms"], 1),
         )
-        for extra in ("peers", "active", "stage_ms", "mfu"):
+        for extra in ("peers", "active", "stage_ms", "mfu", "unet_cache"):
             if r.get(extra) is not None:
                 result[extra] = r[extra]
     except BaseException as e:  # noqa: BLE001 — contract line on ANY failure

@@ -58,6 +58,25 @@ def run() -> dict:
         timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
         height=24, width=24,
     )
+    # variant labels from what ACTUALLY runs (ISSUE 9 satellite): a
+    # QUANT_WEIGHTS=w8 env quantizes via cast_params below, and UNET_CACHE
+    # reaches the config through default_stream_config — either must stamp
+    # the contract line so the number never replays as (or fences against)
+    # the dense baseline, exactly like bench.py's quant/unet_cache fields.
+    # The quant label comes from the CAST RESULT, not the env: with the
+    # default QUANT_MIN_SIZE (16384) the tiny model's kernels all stay
+    # dense, and an env-only label would bank dense numbers as the w8
+    # trajectory (set QUANT_MIN_SIZE=256 to actually quantize tiny-test —
+    # the watcher items do)
+    variant_fields = {}
+    if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
+        from ai_rtc_agent_tpu.models.quant import quantized_bytes_saved
+
+        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
+        if quantized_bytes_saved(bundle.params) > 0:
+            variant_fields["quant"] = "w8"
+    if cfg.unet_cache_interval >= 2:
+        variant_fields["unet_cache"] = cfg.unet_cache_interval
 
     # --- today's path: ONE shared engine, sessions serialize through it
     engine = StreamEngine(
@@ -154,6 +173,8 @@ def run() -> dict:
     overhead_pct = 100.0 * (inv_ratio - 1.0)
     sched.close()
 
+    import jax
+
     return {
         "check": "batch_scheduler_bench",
         "sessions": SESSIONS,
@@ -173,30 +194,21 @@ def run() -> dict:
         "value": round(amortization, 2),
         "unit": "x",
         "vs_baseline": round(amortization, 2),
-        "backend": "cpu",
+        # the REAL backend: the cpu env default is a setdefault, so the
+        # watcher's JAX_PLATFORMS=tpu items must not mislabel (and the
+        # watch_filter banks only backend=="tpu" lines)
+        "backend": jax.default_backend(),
         "live": True,
         "label": f"batchsched_{SESSIONS}s_{FRAMES}f",
         "recorded_at": datetime.now(timezone.utc).isoformat(),
         # shared hardware identity (utils/hwfp.py) — full probe: jax is
         # already initialized by the measurement itself
         "fingerprint": fingerprint(),
+        **variant_fields,
     }
 
 
-def _bank(entry: dict) -> None:
-    path = os.getenv("PERF_LOG_PATH")
-    if path is None:
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "PERF_LOG.jsonl",
-        )
-    if not path or path == os.devnull:
-        return
-    try:
-        with open(path, "a") as f:
-            f.write(json.dumps(entry) + "\n")
-    except OSError as e:
-        entry["bank_error"] = str(e)
+from ai_rtc_agent_tpu.utils.perfbank import bank as _bank  # noqa: E402
 
 
 def main():

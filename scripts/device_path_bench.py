@@ -1,0 +1,299 @@
+"""Device-resident frame path benches (ISSUE 9 / PERF.md §Device path).
+
+Two fenced legs on the hermetic tiny model, both banked into
+PERF_LOG.jsonl (one contract line each) and held by perf_compare.py:
+
+* ``pipelined_overlap_speedup_d4`` — submit/fetch pipelining through the
+  StreamEngine at depth 4 vs the fully synchronous depth-1 loop: the
+  dispatch-staging + per-frame async readback overlap the engine's speed
+  story rests on, as a measured throughput ratio (higher is better; on a
+  pure-CPU box there is no dispatch RTT to hide, so the honest value sits
+  near 1 — what the fence catches is a regression that SERIALIZES the
+  path, e.g. the H2D copy moving back under the submit lock).
+
+* ``batchsched_fetch_isolation_ratio_4s`` — per-slot readback isolation
+  through the BatchScheduler: mean ``fetch``-stage latency (from the SLO
+  plane's StageHistogram — the same histogram /metrics exports) with 4
+  concurrent sessions vs 1.  Before the per-slot readback plane, any
+  session's fetch host-copied the ENTIRE stacked batch, so the first
+  resolver's fetch scaled with occupancy; after it, each fetch resolves
+  only its own row and the ratio sits at or below 1 (lower is better).
+
+Both lines carry the ``quant``/``unet_cache`` variant fields (from the
+live config/env, exactly like bench.py) so a quantized or cached-cadence
+number can never fence against the dense trajectory.
+
+``--leg overlap|isolation`` restricts the run to ONE contract line (the
+watcher queue items use this: its banker commits the last stdout line, so
+each item must emit exactly one).  Default: both legs, two lines, each
+self-banked.
+
+Env knobs: DEVPATH_BENCH_FRAMES (default 24 per rep),
+DEVPATH_BENCH_PAIRS (default 8 alternated leg pairs per metric).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+
+FRAMES = int(os.getenv("DEVPATH_BENCH_FRAMES") or 24)
+PAIRS = int(os.getenv("DEVPATH_BENCH_PAIRS") or 8)
+SESSIONS = 4
+
+
+class _TracedFrame:
+    """Minimal duck-typed frame carrying a FrameTrace so the scheduler's
+    fetch stamps its span (the SLO plane's feed).  No ``pts`` attribute —
+    the output stays a bare ndarray."""
+
+    def __init__(self, arr, trace):
+        self._arr = arr
+        self.trace = trace
+
+    def to_ndarray(self, format="rgb24"):  # noqa: A002 — frame contract
+        return self._arr
+
+
+def _paired(leg_a, leg_b, reps: int):
+    """Alternating paired reps; the MEDIAN of per-pair ratios survives
+    this box's sub-second throttle swings (the batch_scheduler_bench
+    estimator discipline).  -> (min_a, min_b, median a/b)."""
+    ratios = []
+    a_vals, b_vals = [], []
+    for i in range(reps):
+        if i % 2 == 0:
+            a, b = leg_a(), leg_b()
+        else:
+            b, a = leg_b(), leg_a()
+        a_vals.append(a)
+        b_vals.append(b)
+        ratios.append(a / b if b > 0 else 0.0)
+    ratios.sort()
+    return min(a_vals), min(b_vals), ratios[len(ratios) // 2]
+
+
+def _variant_fields(cfg, params) -> dict:
+    """quant/unet_cache labels from what actually ran (bench.py parity):
+    absent = dense, so the perf_compare config predicate keeps variant
+    trajectories apart.  quant is stamped from the PARAMS (int8 kernels
+    present), never the env alone — with the default QUANT_MIN_SIZE the
+    tiny model quantizes zero kernels and an env-only label would bank
+    dense numbers as the w8 trajectory."""
+    from ai_rtc_agent_tpu.models.quant import quantized_bytes_saved
+
+    out = {}
+    if quantized_bytes_saved(params) > 0:
+        out["quant"] = "w8"
+    if cfg.unet_cache_interval >= 2:
+        out["unet_cache"] = cfg.unet_cache_interval
+    return out
+
+
+def _setup():
+    import numpy as np
+
+    from ai_rtc_agent_tpu.models import registry
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config(
+        "tiny-test", t_index_list=(0,), num_inference_steps=1,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        height=24, width=24,
+    )
+    if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
+        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
+    rng = np.random.default_rng(11)
+    frame = rng.integers(0, 256, (cfg.height, cfg.width, 3), dtype=np.uint8)
+    base = {
+        "check": "device_path_bench",
+        "config": "tiny24-turbo1",
+        "frames": FRAMES,
+        "backend": "cpu",
+        "live": True,
+        "recorded_at": datetime.now(timezone.utc).isoformat(),
+        "fingerprint": fingerprint(),
+        **_variant_fields(cfg, bundle.params),
+    }
+    import jax
+
+    base["backend"] = jax.default_backend()
+    return bundle, cfg, frame, base
+
+
+def _overlap_leg(bundle, cfg, frame, base) -> dict:
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+
+    eng = StreamEngine(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
+    )
+    eng.prepare("devpath bench", seed=0)
+    eng(frame)  # compile
+
+    def depth1_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            eng.fetch(eng.submit(frame))
+        return (time.perf_counter() - t0) / FRAMES
+
+    # ONE pool for every rep: spawning/joining 4 threads inside the timed
+    # window would bill pure harness overhead to the depth-4 leg
+    pool = ThreadPoolExecutor(max_workers=4)
+
+    def depth4_rep() -> float:
+        pending: deque = deque()
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            pending.append(pool.submit(eng.fetch, eng.submit(frame)))
+            if len(pending) >= 4:
+                pending.popleft().result()
+        while pending:
+            pending.popleft().result()
+        return (time.perf_counter() - t0) / FRAMES
+
+    depth1_rep(), depth4_rep()  # warm both shapes + grow the pool
+    d1_s, d4_s, speedup = _paired(depth1_rep, depth4_rep, PAIRS)
+    pool.shutdown(wait=True)
+    return {
+        **base,
+        "metric": "pipelined_overlap_speedup_d4",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+        "pipeline_depth": 4,
+        "depth1_ms_per_frame": round(1e3 * d1_s, 3),
+        "depth4_ms_per_frame": round(1e3 * d4_s, 3),
+    }
+
+
+def _isolation_leg(bundle, cfg, frame, base) -> dict:
+    from ai_rtc_agent_tpu.obs.slo import SloPlane
+    from ai_rtc_agent_tpu.obs.trace import FrameTrace
+    from ai_rtc_agent_tpu.stream.scheduler import BatchScheduler
+
+    sched = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=SESSIONS, window_ms=2.0, prewarm=True,
+    )
+    sessions = [
+        sched.claim(f"iso-{i}", prompt="devpath bench", seed=i)
+        for i in range(SESSIONS)
+    ]
+
+    def drive(session, sid: str, plane: SloPlane, n: int):
+        """Depth-2 pipelined per-session drive; every sealed timeline
+        feeds the SLO plane so the fetch-stage histogram carries the
+        per-slot resolve latency."""
+        pending: deque = deque()
+        for i in range(n):
+            tr = FrameTrace(i, session_id=sid)
+            pending.append((session.submit(_TracedFrame(frame, tr)), tr))
+            if len(pending) >= 2:
+                h, t = pending.popleft()
+                session.fetch(h)
+                plane.observe(sid, t)
+        while pending:
+            h, t = pending.popleft()
+            session.fetch(h)
+            plane.observe(sid, t)
+
+    def fetch_mean_ms(plane: SloPlane) -> float:
+        h = plane.global_hist["fetch"]
+        return (h.sum_ms / h.count) if h.count else 0.0
+
+    def solo_rep() -> float:
+        plane = SloPlane()
+        drive(sessions[0], "solo", plane, FRAMES)
+        return fetch_mean_ms(plane)
+
+    def four_rep() -> float:
+        plane = SloPlane()
+        threads = [
+            threading.Thread(target=drive, args=(s, f"s{j}", plane, FRAMES))
+            for j, s in enumerate(sessions)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return fetch_mean_ms(plane)
+
+    solo_rep(), four_rep()  # warm
+    four_ms, solo_ms, ratio = _paired(four_rep, solo_rep, PAIRS)
+    line = {
+        **base,
+        "metric": "batchsched_fetch_isolation_ratio_4s",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(ratio, 3),
+        "sessions": SESSIONS,
+        "fetch_mean_ms_1s": round(solo_ms, 3),
+        "fetch_mean_ms_4s": round(four_ms, 3),
+    }
+    for s in sessions:
+        s.release()
+    sched.close()
+    return line
+
+
+def run(legs=("overlap", "isolation")) -> list:
+    bundle, cfg, frame, base = _setup()
+    lines = []
+    if "overlap" in legs:
+        lines.append(_overlap_leg(bundle, cfg, frame, base))
+    if "isolation" in legs:
+        lines.append(_isolation_leg(bundle, cfg, frame, base))
+    return lines
+
+
+from ai_rtc_agent_tpu.utils.perfbank import bank as _bank  # noqa: E402
+
+
+def main():
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--leg", choices=("overlap", "isolation"), default=None,
+                    help="run one leg only (one contract line — what the "
+                         "watcher queue items need; default: both)")
+    args = ap.parse_args()
+    legs = (args.leg,) if args.leg else ("overlap", "isolation")
+
+    sigterm_to_exception("device_path_bench timeout")
+    lines = [{
+        "check": "device_path_bench",
+        "metric": (
+            "batchsched_fetch_isolation_ratio_4s"
+            if legs == ("isolation",)
+            else "pipelined_overlap_speedup_d4"
+        ),
+        "value": 0.0,
+        "unit": "x",
+        "vs_baseline": 0.0,
+    }]
+    try:
+        lines = run(legs)
+        for entry in lines:
+            _bank(entry)
+    except BaseException as e:  # the contract lines must survive any exit
+        lines[0]["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        for entry in lines:
+            print(json.dumps(entry))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -195,20 +195,7 @@ def run() -> dict:
     }
 
 
-def _bank(entry: dict) -> None:
-    path = os.getenv("PERF_LOG_PATH")
-    if path is None:
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "PERF_LOG.jsonl",
-        )
-    if not path or path == os.devnull:
-        return
-    try:
-        with open(path, "a") as f:
-            f.write(json.dumps(entry) + "\n")
-    except OSError as e:
-        entry["bank_error"] = str(e)
+from ai_rtc_agent_tpu.utils.perfbank import bank as _bank  # noqa: E402
 
 
 def main():

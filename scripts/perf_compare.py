@@ -60,6 +60,18 @@ _LOWER_BETTER_SUBSTRINGS = (
     "_ratio", "_ms", "_us", "latency", "overhead", "share",
 )
 
+# Known leg names with their own default fences (ISSUE 9): consulted when
+# no --tolerance-metric override names the metric, so the device-path
+# legs ship with direction-aware teeth without every caller re-typing
+# them.  pipelined_overlap_speedup_d4 is throughput-shaped (higher
+# better, the substring heuristic already agrees); the fetch-isolation
+# ratio is cost-shaped ("_ratio" -> lower better) and wobbles more on a
+# contended box, hence the wider fence.
+DEFAULT_METRIC_TOLERANCES = {
+    "pipelined_overlap_speedup_d4": 0.25,
+    "batchsched_fetch_isolation_ratio_4s": 0.5,
+}
+
 
 def lower_is_better(metric: str, force_lower=(), force_higher=()) -> bool:
     if metric in force_lower:
@@ -226,7 +238,10 @@ def main(argv=None) -> int:
             if args.strict:
                 regressions += 1
             continue
-        tol = overrides.get(fresh["metric"], args.tolerance)
+        tol = overrides.get(
+            fresh["metric"],
+            DEFAULT_METRIC_TOLERANCES.get(fresh["metric"], args.tolerance),
+        )
         r = check(fresh, banked_entry, tol,
                   force_lower=args.lower_better,
                   force_higher=args.higher_better)

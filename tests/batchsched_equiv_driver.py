@@ -7,6 +7,13 @@ uint8 by 1 — on a real single-device runtime (what serving runs) the
 scheduler is bit-identical to dedicated engines, and THIS process asserts
 exactly that.  Prints ``EQUIV_OK <n>`` (n = frame comparisons, all exact)
 or raises on the first mismatch.
+
+ISSUE 9 variant legs: the SAME scheduler-vs-dedicated comparison under
+``QUANT_WEIGHTS=w8`` (int8 kernels + fused dequant) and the DeepCache
+cadence (``unet_cache_interval``), each across bucket sizes k=4/2/1.
+Same variant on both sides -> identical graphs -> the documented parity
+tolerance is EXACT (0) on this single-device runtime; the per-leg counts
+print as ``EQUIV_W8_OK <n>`` / ``EQUIV_DC_OK <n>``.
 """
 
 import os
@@ -27,6 +34,85 @@ from ai_rtc_agent_tpu.stream.engine import (  # noqa: E402
 from ai_rtc_agent_tpu.stream.scheduler import BatchScheduler  # noqa: E402
 
 
+def dedicated_engines(n, bundle, cfg, params=None):
+    """n dedicated engines SHARING one set of jitted step callables.
+
+    Every StreamEngine jits its own make_step_fn closure, so n identical
+    engines pay n identical tiny-model compiles — the single biggest
+    wall-time cost of this driver (tier-1 budget, ROADMAP standing
+    constraint).  The step fn is pure in (params, state, frame), so
+    engines over the same models/config are interchangeable at the
+    executable level; sharing keeps the COMPARISON exact while paying
+    each graph's compile once."""
+    params = bundle.params if params is None else params
+    engines = [
+        StreamEngine(
+            bundle.stream_models, params, cfg, bundle.encode_prompt
+        )
+        for _ in range(n)
+    ]
+    for eng in engines[1:]:
+        eng._step = engines[0]._step
+        if engines[0]._step_cached is not None:
+            eng._step_cached = engines[0]._step_cached
+    return engines
+
+
+def drive_variant(label: str, bundle, cfg, params) -> int:
+    """k=4 -> k=2 -> k=1 scheduler-vs-dedicated drive under one serving
+    variant.  Three sessions claim up-front (every install resets the
+    global DeepCache cadence, so the LAST claim leaves the tick at 0 —
+    exactly the dedicated engines' fresh-prepare state), then release one
+    by one: releases never touch the cadence, so both sides stay
+    tick-aligned through every bucket transition."""
+    rng = np.random.default_rng(hash(label) % (2**32))
+
+    def frames(n):
+        return [rng.integers(0, 256, (64, 64, 3), np.uint8) for _ in range(n)]
+
+    # HUGE window: dispatch must happen ONLY when every live session has
+    # a frame waiting (the inline full-batch path) — with a small window
+    # a throttle hiccup between two submits lets the dispatcher fire a
+    # PARTIAL batch, which advances the global DeepCache tick twice in
+    # one comparison round and desyncs the cadence from the dedicated
+    # engines (dense/w8 are cadence-free, so only the DC leg could flake)
+    sched = BatchScheduler(
+        bundle.stream_models, params, cfg, bundle.encode_prompt,
+        max_sessions=4, window_ms=10_000.0, prewarm=False,
+    )
+    prompts = ["a red cat", "a blue dog", "green hills"]
+    sessions = [
+        sched.claim(f"{label}-{i}", prompt=p, seed=40 + i)
+        for i, p in enumerate(prompts)
+    ]
+    engines = dedicated_engines(3, bundle, cfg, params)
+    for eng, (i, p) in zip(engines, enumerate(prompts)):
+        eng.prepare(p, seed=40 + i)
+    compared = 0
+
+    def rounds(n, sess, engs):
+        nonlocal compared
+        for _ in range(n):
+            fs = frames(len(sess))
+            handles = [s.submit(f) for s, f in zip(sess, fs)]
+            outs = [s.fetch(h) for s, h in zip(sess, handles)]
+            for out, eng, f in zip(outs, engs, fs):
+                np.testing.assert_array_equal(out, eng(f))
+                compared += 1
+
+    # 3 rounds per occupancy: with interval-3 DeepCache that is one full
+    # capture + two cached steps at every bucket size — both graphs of
+    # the pair execute and stay pinned at each k
+    rounds(3, sessions, engines)            # k=4 (3 live rows, padded)
+    sessions[2].release()
+    rounds(3, sessions[:2], engines[:2])    # k=2
+    sessions[1].release()
+    rounds(3, sessions[:1], engines[:1])    # k=1 (solo-ultra inline path)
+    sessions[0].release()
+    sched.close()
+    return compared
+
+
 def main():
     bundle = registry.load_model_bundle("tiny-test")
     # 8 sub-timesteps with a single stage so update_t_index_list([5]) is a
@@ -40,12 +126,7 @@ def main():
         bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
         max_sessions=4, window_ms=2.0, prewarm=False,
     )
-    engines = [
-        StreamEngine(
-            bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
-        )
-        for _ in range(3)
-    ]
+    engines = dedicated_engines(3, bundle, cfg)
     rng = np.random.default_rng(0)
     compared = 0
 
@@ -127,6 +208,30 @@ def main():
     assert snap["batchsched_steps_total"] > 0
     assert snap["batchsched_occupancy_hist"]
     sched.close()
+
+    # --- ISSUE 9 variant legs: same drive, quantized + cached-cadence ---
+    os.environ["QUANT_WEIGHTS"] = "w8"
+    os.environ["QUANT_MIN_SIZE"] = "256"  # tiny-model kernels are small
+    try:
+        qparams = registry.cast_params(bundle.params, cfg.dtype)
+    finally:
+        del os.environ["QUANT_WEIGHTS"], os.environ["QUANT_MIN_SIZE"]
+    from ai_rtc_agent_tpu.models.quant import quantized_bytes_saved
+
+    assert quantized_bytes_saved(qparams) > 0, "quantization was a no-op"
+    n_w8 = drive_variant("w8", bundle, cfg, qparams)
+    compared += n_w8
+    print(f"EQUIV_W8_OK {n_w8}")
+
+    dc_cfg = registry.default_stream_config(
+        "tiny-test", t_index_list=(2,), num_inference_steps=8,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        unet_cache_interval=3,
+    )
+    n_dc = drive_variant("dc3", bundle, dc_cfg, bundle.params)
+    compared += n_dc
+    print(f"EQUIV_DC_OK {n_dc}")
+
     print(f"EQUIV_OK {compared}")
 
 

@@ -91,3 +91,44 @@ def test_entries_skips_corrupt_meta(tmp_path, caplog):
         "engines--good", lambda x: x + 1, (jnp.ones((2,)),), build=False
     )
     assert reload is not None
+
+
+def test_aot_call_donates_state(tmp_path):
+    """ISSUE 9 donation audit: jax.export records the donation aliasing in
+    the StableHLO but Exported.call re-enters jit WITHOUT donate_argnums —
+    before the _donating_call wrapper, every AOT-adopted engine kept a
+    hidden defensive copy of its whole state pytree alive per step.  Both
+    the fresh-build and the deserialize paths must delete the donated
+    input buffers."""
+    cache = EngineCache(cache_dir=str(tmp_path))
+
+    def step(params, state, x):
+        return {"a": state["a"] * params["w"] + x}, state["a"][:2]
+
+    params = {"w": jnp.full((4,), 2.0)}
+    x = jnp.ones((4,))
+
+    # build path
+    state = {"a": jnp.arange(4.0)}
+    call = cache.load_or_build(
+        "engines--donate", step, (params, state, x), donate_argnums=(1,)
+    )
+    ns, out = call(params, state, x)
+    assert state["a"].is_deleted(), "build-path call kept a defensive copy"
+    np.testing.assert_allclose(np.asarray(ns["a"]), [1.0, 3.0, 5.0, 7.0])
+
+    # deserialize path (fresh cache object -> cache HIT)
+    call2 = EngineCache(cache_dir=str(tmp_path)).load_or_build(
+        "engines--donate", step,
+        (params, {"a": jnp.arange(4.0)}, x), donate_argnums=(1,),
+    )
+    state2 = {"a": jnp.arange(4.0)}
+    ns2, _ = call2(params, state2, x)
+    assert state2["a"].is_deleted(), "cache-hit call kept a defensive copy"
+    np.testing.assert_allclose(np.asarray(ns2["a"]), np.asarray(ns["a"]))
+
+    # no donation requested -> args stay alive (no over-aggressive wrap)
+    plain = cache.load_or_build("engines--nodonate", lambda a: a + 1, (x,))
+    y = jnp.ones((4,))
+    plain(y)
+    assert not y.is_deleted()

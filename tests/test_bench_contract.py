@@ -683,3 +683,195 @@ def test_perf_compare_skips_replays_and_failed_runs(tmp_path):
     ])
     r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
     assert r.returncode == 1 and "FRESH-RUN-FAILED" in r.stdout
+
+
+@pytest.mark.slow
+def test_device_path_bench_contract(tmp_path):
+    """Device-path microbench smoke (ISSUE 9): emits exactly one contract
+    line per leg (overlap + readback isolation), BANKS both, and holds the
+    loose fences — a regression that makes per-slot fetch resolve time
+    scale with batch occupancy again (the whole-batch host copy) reads as
+    a ~4x isolation ratio; what the fence tolerates is CI-box noise.
+    `slow` tier like the batch-scheduler smoke (two tiny-model compiles +
+    the bucket prewarm)."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "PERF_LOG_PATH": str(log),
+            "DEVPATH_BENCH_FRAMES": "8",
+            "DEVPATH_BENCH_PAIRS": "4",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/device_path_bench.py"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 2, r.stdout
+    by_metric = {}
+    for ln in lines:
+        d = json.loads(ln)
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in d, d
+        assert "error" not in d, d
+        by_metric[d["metric"]] = d
+    assert set(by_metric) == {
+        "pipelined_overlap_speedup_d4", "batchsched_fetch_isolation_ratio_4s",
+    }
+    iso = by_metric["batchsched_fetch_isolation_ratio_4s"]
+    # isolation: the mean per-slot fetch must NOT scale ~4x with occupancy
+    # (whole-batch readback); headroom for a contended 1-core CI box
+    assert 0 < iso["value"] <= 2.0, iso
+    assert iso["sessions"] == 4
+    assert iso["fetch_mean_ms_1s"] > 0 and iso["fetch_mean_ms_4s"] > 0
+    ov = by_metric["pipelined_overlap_speedup_d4"]
+    # overlap: pure-CPU has no RTT to hide — the fence catches the path
+    # actively SERIALIZING (thread-pool fetches blocked behind a lock)
+    assert ov["value"] >= 0.4, ov
+    assert ov["fingerprint"]["jax_backend"] == "cpu"
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert {b["metric"] for b in banked} == set(by_metric)
+
+
+def _perf_compare_main():
+    """scripts/perf_compare.py as an importable module (one load): the
+    new-leg tests below call its main() in-process — same code path as
+    the CLI, minus ~1s of interpreter+import per invocation (tier-1
+    budget; the subprocess surface itself is pinned by the older
+    perf_compare tests above)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_compare_inproc", os.path.join(REPO, "scripts", "perf_compare.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_perf_compare_knows_device_path_legs(tmp_path, capsys):
+    """ISSUE 9 satellite: the new leg names ship with built-in
+    direction-aware tolerances — the isolation ratio is lower-is-better
+    with a 0.5 fence, the overlap speedup higher-is-better with 0.25 —
+    without any --tolerance-metric flags."""
+    main = _perf_compare_main()
+
+    def _perf_compare(args):
+        class R:
+            pass
+
+        r = R()
+        r.returncode = main(args)
+        r.stdout = capsys.readouterr().out
+        r.stderr = ""
+        return r
+
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "batchsched_fetch_isolation_ratio_4s", "value": 1.0,
+         "unit": "x", "backend": "cpu", "live": True, "sessions": 4},
+        {"metric": "pipelined_overlap_speedup_d4", "value": 1.0,
+         "unit": "x", "backend": "cpu", "live": True, "pipeline_depth": 4},
+    ])
+    # within the built-in fences: ratio may rise to 1.5, speedup may drop
+    # to 0.75
+    _write_jsonl(fresh, [
+        {"metric": "batchsched_fetch_isolation_ratio_4s", "value": 1.45,
+         "unit": "x", "backend": "cpu", "sessions": 4},
+        {"metric": "pipelined_overlap_speedup_d4", "value": 0.8,
+         "unit": "x", "backend": "cpu", "pipeline_depth": 4},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    # beyond them: the ratio RISING past 1.5 fails (direction-aware —
+    # lower is better), and the speedup cratering fails
+    _write_jsonl(fresh, [
+        {"metric": "batchsched_fetch_isolation_ratio_4s", "value": 1.8,
+         "unit": "x", "backend": "cpu", "sessions": 4},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+    _write_jsonl(fresh, [
+        {"metric": "pipelined_overlap_speedup_d4", "value": 0.6,
+         "unit": "x", "backend": "cpu", "pipeline_depth": 4},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+    # an explicit --tolerance-metric still overrides the built-in default
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked),
+                       "--tolerance-metric",
+                       "pipelined_overlap_speedup_d4=0.5"])
+    assert r.returncode == 0, r.stdout
+
+
+def test_variant_fields_fence_separately(tmp_path, capsys):
+    """ISSUE 9 satellite: a quantized / cached-cadence contract line must
+    never fence against (or replay as) the dense baseline — the
+    quant/unet_cache fields are part of the same-config predicate."""
+    main = _perf_compare_main()
+
+    def _perf_compare(args):
+        class R:
+            pass
+
+        r = R()
+        r.returncode = main(args)
+        r.stdout = capsys.readouterr().out
+        r.stderr = ""
+        return r
+
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "batchsched_amortization_4s", "value": 1.7, "unit": "x",
+         "backend": "cpu", "live": True, "sessions": 4},
+    ])
+    # a w8-quantized fresh line: NO trajectory against the dense entry
+    _write_jsonl(fresh, [
+        {"metric": "batchsched_amortization_4s", "value": 0.2, "unit": "x",
+         "backend": "cpu", "sessions": 4, "quant": "w8"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0 and "NO-TRAJECTORY" in r.stdout, r.stdout
+    # same for a DeepCache cadence line
+    _write_jsonl(fresh, [
+        {"metric": "batchsched_amortization_4s", "value": 0.2, "unit": "x",
+         "backend": "cpu", "sessions": 4, "unet_cache": 3},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0 and "NO-TRAJECTORY" in r.stdout, r.stdout
+    # dense-vs-dense still fences
+    _write_jsonl(fresh, [
+        {"metric": "batchsched_amortization_4s", "value": 0.2, "unit": "x",
+         "backend": "cpu", "sessions": 4},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+
+
+def test_unet_cache_env_labels_contract_line(monkeypatch):
+    """ISSUE 9 satellite: the DeepCache cadence can arrive via the
+    UNET_CACHE env (registry honors it) — the contract line must carry
+    the unet_cache field even on the no-measurement failure path, so a
+    cached-cadence record can never replay as the dense baseline.  The
+    spelling parser is pinned in-process; ONE subprocess run pins the
+    end-to-end labeling (tier-1 budget)."""
+    import bench
+
+    for spelling, want in (
+        ("3", 3), ("deepcache:5", 5), ("0", 0), ("", 0), ("junk", 0),
+    ):
+        monkeypatch.setenv("UNET_CACHE", spelling)
+        assert bench.env_unet_cache() == want, spelling
+    monkeypatch.delenv("UNET_CACHE")
+    r = _run_bench(
+        {"JAX_PLATFORMS": "bogus-platform", "PERF_LOG_PATH": os.devnull,
+         "UNET_CACHE": "deepcache:3"},
+    )
+    assert r.returncode == 0, r.stderr[-400:]
+    assert _contract_line(r.stdout)["unet_cache"] == 3

@@ -10,10 +10,17 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_profile_step_tiny_writes_trace(tmp_path):
+    """`slow` tier since PR 9: a 31s subprocess smoke of an OPERATOR tool
+    (fresh jax import + tiny-model compile + jax.profiler trace) — tier-1
+    wall-time goes to serving invariants first (ROADMAP standing
+    constraint; the tier-1 budget finished 22s under the timeout)."""
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)
     env["JAX_PLATFORMS"] = "cpu"

@@ -368,3 +368,85 @@ def test_similarity_filter_black_frame_not_similar_to_content():
     assert eng._maybe_skip(black) is False  # black vs content: process it
     eng._last_out = np.zeros_like(content)  # pretend it was served
     assert eng._maybe_skip(black.copy()) is True  # black vs black: skip
+
+
+# -- ISSUE 9: device-resident frame path -------------------------------------
+# One module-scoped engine serves all three tests (tier-1 budget: each
+# build pays the tiny-model compile; prepare() between tests is cheap)
+
+
+@pytest.fixture(scope="module")
+def devpath_engine():
+    eng, cfg = _engine()
+    eng.prepare("device path", seed=1)
+    eng(_frames(1)[0])  # compile once here, not inside a patched test
+    return eng
+
+
+def test_submit_stages_h2d_outside_submit_lock(devpath_engine, monkeypatch):
+    """The H2D staging (stage_frame) must run BEFORE the submit lock is
+    taken: a large-frame device_put under the lock serializes concurrent
+    sessions' dispatches on a copy.  The fake device_put asserts the lock
+    is free at transfer time — if staging ever moves back inside the lock
+    this trips single-threaded, no timing involved."""
+    eng = devpath_engine
+    real_put = jax.device_put
+    seen = {"n": 0, "locked": []}
+
+    def fake_put(x, *a, **k):
+        seen["n"] += 1
+        seen["locked"].append(eng._submit_lock.locked())
+        return real_put(x, *a, **k)
+
+    monkeypatch.setattr(jax, "device_put", fake_put)
+    out = eng.fetch(eng.submit(_frames(1)[0]))
+    assert out.shape == (64, 64, 3)
+    assert seen["n"] >= 1
+    assert not any(seen["locked"]), (
+        "device_put ran while the submit lock was held"
+    )
+
+
+def test_concurrent_submits_overlap_h2d_staging(devpath_engine, monkeypatch):
+    """Regression for the serialized-transfer bug with a deliberately slow
+    fake device_put: BOTH threads must be inside the transfer at once
+    (each blocks until the other arrives).  With staging under the submit
+    lock, thread B cannot enter device_put until A's whole step finishes
+    — A would hold the barrier forever and it breaks."""
+    import threading as _threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    eng = devpath_engine
+    real_put = jax.device_put
+    barrier = _threading.Barrier(2, timeout=15)
+    results = {"broken": 0}
+
+    def slow_put(x, *a, **k):
+        try:
+            barrier.wait()  # "slow": returns only when BOTH transfers run
+        except _threading.BrokenBarrierError:
+            results["broken"] += 1
+        return real_put(x, *a, **k)
+
+    monkeypatch.setattr(jax, "device_put", slow_put)
+    fs = _frames(2, seed=9)
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        f1 = pool.submit(lambda: eng.fetch(eng.submit(fs[0])))
+        f2 = pool.submit(lambda: eng.fetch(eng.submit(fs[1])))
+        o1, o2 = f1.result(timeout=60), f2.result(timeout=60)
+    assert o1.shape == o2.shape == (64, 64, 3)
+    assert results["broken"] == 0, (
+        "concurrent submits serialized their H2D staging"
+    )
+
+
+def test_step_donates_state_no_defensive_copy(devpath_engine):
+    """The donation audit (ISSUE 9): the jitted step really consumes the
+    state pytree in place — the pre-step buffers are deleted, not kept
+    alive by a hidden defensive copy (the HBM-residency property the
+    whole ring-buffer design assumes)."""
+    eng = devpath_engine
+    before = jax.tree.leaves(eng.state)
+    eng(_frames(1)[0])
+    deleted = [leaf.is_deleted() for leaf in before]
+    assert all(deleted), f"{sum(deleted)}/{len(deleted)} leaves donated"
