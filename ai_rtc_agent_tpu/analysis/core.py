@@ -214,6 +214,7 @@ def run_checkers(project: Project, checkers=None) -> list:
     from . import (
         async_blocking,
         bounded_queues,
+        device_transfers,
         encoder_reconfig,
         env_registry,
         metric_cardinality,
@@ -227,6 +228,7 @@ def run_checkers(project: Project, checkers=None) -> list:
     registry = {
         "async-blocking": async_blocking.check,
         "bounded-queue": bounded_queues.check,
+        "device-transfer": device_transfers.check,
         "encoder-reconfig": encoder_reconfig.check,
         "metric-cardinality": metric_cardinality.check,
         "pooled-view": pooled_views.check,
@@ -249,6 +251,7 @@ def run_checkers(project: Project, checkers=None) -> list:
 ALL_CHECKERS = (
     "async-blocking",
     "bounded-queue",
+    "device-transfer",
     "encoder-reconfig",
     "metric-cardinality",
     "pooled-view",

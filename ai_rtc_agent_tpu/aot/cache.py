@@ -28,6 +28,7 @@ import jax
 from jax import export as jax_export
 
 from .. import __version__
+from ..obs import devtel
 from ..utils import env
 
 logger = logging.getLogger(__name__)
@@ -110,19 +111,28 @@ class EngineCache:
         if os.path.exists(blob_path):
             try:
                 with open(blob_path, "rb") as f:
-                    exp = jax_export.deserialize(f.read())
+                    blob = f.read()
+                exp = jax_export.deserialize(blob)
                 logger.info("engine cache HIT %s (%s)", key, digest)
+                # device telemetry (obs/devtel.py): hit counter + the
+                # on-disk inventory gauges refresh at this (rare) touch
+                devtel.note_aot("hit", cache=self)
                 return _donating_call(exp, donate_argnums)
             except Exception as e:  # corrupted/incompatible
                 logger.warning("engine cache entry unreadable (%s)", e)
+        devtel.note_aot("miss", cache=self)
         if not build:
             return None
 
         logger.info("engine cache MISS %s — compiling (first run is slow)", key)
         t0 = time.time()
-        jitted = jax.jit(fn, donate_argnums=donate_argnums)
-        exp = jax_export.export(jitted)(*specs)
-        blob = exp.serialize()
+        # the compile watchdog attributes the build's XLA compile to the
+        # engine key; in the no-monitoring fallback the measured build
+        # time below doubles as the compile record (note_aot "build")
+        with devtel.compile_scope(key):
+            jitted = jax.jit(fn, donate_argnums=donate_argnums)
+            exp = jax_export.export(jitted)(*specs)
+            blob = exp.serialize()
         os.makedirs(d, exist_ok=True)
         tmp = blob_path + ".tmp"
         with open(tmp, "wb") as f:
@@ -143,7 +153,31 @@ class EngineCache:
                 indent=2,
             )
         logger.info("engine built in %.1fs -> %s", time.time() - t0, blob_path)
+        devtel.note_aot(
+            "build", seconds=time.time() - t0, cache=self, context=key,
+        )
         return _donating_call(exp, donate_argnums)
+
+    def stats(self) -> tuple:
+        """(entry count, total bytes) of serialized blobs on disk — the
+        ``aot_cache_entries``/``aot_cache_bytes`` gauges.  Called by the
+        devtel plane at cache touches (hit/miss/build), never per
+        scrape, so /metrics stays disk-free."""
+        entries = 0
+        total = 0
+        if os.path.isdir(self.cache_dir):
+            for key in os.listdir(self.cache_dir):
+                kd = os.path.join(self.cache_dir, key)
+                if not os.path.isdir(kd):
+                    continue
+                for f in os.listdir(kd):
+                    if f.endswith(".jaxexport"):
+                        entries += 1
+                        try:
+                            total += os.path.getsize(os.path.join(kd, f))
+                        except OSError:
+                            pass  # racing delete — the gauge self-heals
+        return entries, total
 
     def entries(self):
         """Metadata of every cached engine.  One corrupt/truncated meta

@@ -8,6 +8,10 @@
   ``GET /debug/flight``.
 * obs/export.py — Chrome trace-event JSON (Perfetto) / JSONL renderings,
   plus the opt-in ``jax.profiler`` bridge.
+* obs/slo.py — always-on per-stage latency budgets + burn-rate breaches.
+* obs/devtel.py — device telemetry: the serve-time compile watchdog
+  (retrace breaches on the alert path), AOT cache + H2D/D2H transfer
+  accounting, device-memory snapshots.
 
 Full tour: docs/observability.md.
 """

@@ -27,12 +27,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import devtel
 from ..stream.engine import (
     StreamConfig,
     StreamEngine,
     StreamModels,
     _coeff_state,
     make_step_fn,
+    stage_frame,
 )
 
 logger = logging.getLogger(__name__)
@@ -421,11 +423,17 @@ class MultiPeerEngine:
             )
             idx_s = jax.ShapeDtypeStruct((k,), jnp.int32)
             for variant in variants:
-                compiled = (
-                    self._bucket_step(k, variant)
-                    .lower(params_s, states_s, frames_s, idx_s)
-                    .compile()
-                )
+                # devtel attribution (the scheduler's prewarm contract):
+                # the body IS a compile, so the no-monitoring fallback
+                # self-times it
+                with devtel.compile_scope(
+                    f"peers-{k}:{variant}", fallback_record=True
+                ):
+                    compiled = (
+                        self._bucket_step(k, variant)
+                        .lower(params_s, states_s, frames_s, idx_s)
+                        .compile()
+                    )
                 self._bucket_steps[(k, variant)] = compiled
                 logger.info(
                     "prewarmed bucket step %d/%d (%s)",
@@ -451,7 +459,9 @@ class MultiPeerEngine:
             # pad with a repeat of the last active slot: identical compute,
             # duplicate scatter writes land identical values
             idx = (active_idx + [active_idx[-1]] * k)[:k]
-            frames_k = jax.device_put(np.ascontiguousarray(frames[idx]))
+            # through the ONE blessed H2D path (stage_frame): same async
+            # staging, plus the devtel transfer meter sees every byte
+            frames_k = stage_frame(np.ascontiguousarray(frames[idx]))
             variant = "full"
             if self._cache_interval:
                 # same global cadence as the full-batch path: captures
@@ -477,7 +487,7 @@ class MultiPeerEngine:
             if self.mesh is not None and self.mesh.shape.get("dp", 1) > 1:
                 frames = jax.device_put(frames, NamedSharding(self.mesh, P("dp")))
             else:
-                frames = jax.device_put(frames)
+                frames = stage_frame(frames)
         fn = self._step
         if self._cache_interval:
             if self._tick % self._cache_interval != 0:
@@ -492,6 +502,8 @@ class MultiPeerEngine:
 
     def fetch(self, pending) -> np.ndarray:
         out = np.asarray(pending)
+        if out is not pending:  # a real device->host resolve
+            devtel.note_d2h(out.nbytes)
         if out.ndim == 5 and out.shape[1] == 1:  # [P, fbs=1, H, W, 3]
             out = out[:, 0]
         return out

@@ -607,6 +607,10 @@ class OverloadControlPlane:
         # kind, **data) fed ladder rung moves — overload escalation is
         # exactly what a post-mortem needs on its event timeline
         self.on_event = None
+        # ladder-cadence hook: callable() fired once per tick — the
+        # device-telemetry plane (obs/devtel.py) samples device memory
+        # on it (rate-limited on its side; failures never break a tick)
+        self.on_tick = None
         # delivered-frame freshness reservoir (bounded; appended per frame,
         # percentiles computed per snapshot over <=512 floats — cost is
         # constant, independent of session count or queue depth)
@@ -790,6 +794,12 @@ class OverloadControlPlane:
             ladder.tick(pressure)
         for na in list(self.netadapt.values()):
             na.tick()
+        cb = self.on_tick
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.exception("overload on_tick hook failed")
 
     def stop(self):
         self.lag.stop()

@@ -829,6 +829,13 @@ async def health_detail(request):
             snap = slo_plane.session_snapshot(k)
             if snap is not None:
                 sessions[k]["slo"] = snap
+    devtel_plane = app.get("devtel")
+    if devtel_plane is not None:
+        # a serve-time retrace freezes EVERY live session — each session
+        # dict carries the breach state next to its supervisor/SLO view
+        dv = devtel_plane.session_view()
+        for k in sessions:
+            sessions[k]["devtel"] = dv
     body = {
         "status": worst_state(s["state"] for s in sessions.values()),
         "sessions": sessions,
@@ -838,6 +845,8 @@ async def health_detail(request):
             "pressure": round(ov.admission.pressure(), 4),
             "frozen": ov.admission.frozen,
         }
+    if devtel_plane is not None:
+        body["devtel"] = devtel_plane.health()
     return web.json_response(body)
 
 
@@ -1012,6 +1021,12 @@ async def metrics(request):
     slo_plane = request.app.get("slo")
     if slo_plane is not None:
         out.update(slo_plane.snapshot())
+    # device telemetry (obs/devtel.py): compile watchdog counters, AOT
+    # hit/miss/inventory, H2D/D2H bytes, device memory — cached int
+    # reads (the memory sample refreshes on the ladder tick, never here)
+    devtel_plane = request.app.get("devtel")
+    if devtel_plane is not None:
+        out.update(devtel_plane.snapshot())
     fmt = request.query.get("format", "json")
     if fmt == "prom":
         # genuine Prometheus text exposition (obs/promexport.py): the
@@ -1112,6 +1127,21 @@ async def cors_middleware(request, handler):
 async def on_startup(app):
     if app["udp_ports"]:
         patch_loop_datagram(app["udp_ports"])
+
+    # device telemetry (obs/devtel.py): activated BEFORE any model build
+    # so every warmup compile (pipeline probe, AOT adoption, bucket
+    # prewarm) is recorded in the warmup phase; DEVTEL_ENABLE=0 means no
+    # plane, no listener, no hot-path residue.  The breach fan-out is
+    # wired further down once the flight recorder exists; the phase
+    # flips to "serving" at the END of startup — from there on, a
+    # compile is a serve-time retrace breach.
+    devtel_plane = None
+    if env.devtel_enabled():
+        from ..obs import devtel as _devtel
+        from ..obs.devtel import DevTelPlane
+
+        devtel_plane = _devtel.activate(DevTelPlane())
+    app["devtel"] = devtel_plane
 
     # config overrides shared by both serving modes (no silent flag drops)
     overrides = {}
@@ -1221,6 +1251,9 @@ async def on_startup(app):
         "whep_pcs": {},
     }
     app["stats"] = FrameStats()
+    if devtel_plane is not None:
+        # breaches land as retrace_breaches_total in the shared gauges
+        devtel_plane.stats = app["stats"]
     # media-plane providers share the agent's gauges so /metrics carries
     # decode/encode/glass-to-glass stages next to submit->fetch latency
     if hasattr(app["provider"], "attach_stats"):
@@ -1284,6 +1317,36 @@ async def on_startup(app):
         app["stream_event_handler"].on_emit = _webhook_emitted
     else:
         app["flight"] = None
+    if devtel_plane is not None:
+        # serve-time retrace breach -> the existing alert path: an event
+        # in EVERY live session's black box (the compile froze all of
+        # them), a StreamDegraded-style webhook (state=RETRACE_BREACH),
+        # and the FrameStats counter wired above (retrace_breaches_total
+        # at /metrics, incl. ?format=prom)
+        loop = asyncio.get_event_loop()
+        handler = app["stream_event_handler"]
+
+        def _retrace_breach(info):
+            flight = app.get("flight")
+            if flight is not None:
+                for rec in list(flight.sessions.values()):
+                    rec.event("retrace", **info)
+            reason = (
+                f"serve-time retrace: {info['context']} compiled "
+                f"{info['duration_ms']}ms after prewarm completed"
+            )
+
+            def fire():
+                handler.handle_session_state(
+                    "device-telemetry", "", "RETRACE_BREACH", reason
+                )
+
+            try:  # the compile listener fires on worker threads
+                loop.call_soon_threadsafe(fire)
+            except RuntimeError:
+                pass  # loop already closed (teardown race)
+
+        devtel_plane.on_breach = _retrace_breach
     # overload control plane: admission, lag watchdog, shedding ladders
     # (OVERLOAD_CONTROL=0 restores the pre-overload-plane agent)
     if env.get_bool("OVERLOAD_CONTROL", True):
@@ -1310,9 +1373,26 @@ async def on_startup(app):
         # for scheduler sessions: owns_step_signal)
         admission = app["overload"].admission
         sched.on_step = lambda dt_s, occ: admission.note_step_latency(dt_s)
+    if devtel_plane is not None:
+        if app["overload"] is not None:
+            # device-memory snapshot rides the ladder tick (rate-limited
+            # by DEVTEL_MEM_INTERVAL_S on the plane's side); with the
+            # overload plane off, snapshot() samples lazily instead
+            app["overload"].on_tick = devtel_plane.sample_memory
+        # startup is done: pipeline built, AOT adopted, buckets
+        # prewarmed — any compile from here on is a serve-time retrace.
+        # (With BATCHSCHED=0 or BATCHSCHED_PREWARM=0 the lazily compiled
+        # first step WILL be reported: that config genuinely does
+        # compile at serve time, and the watchdog's job is to say so.)
+        devtel_plane.serving()
 
 
 async def on_shutdown(app):
+    devtel_plane = app.get("devtel")
+    if devtel_plane is not None:
+        from ..obs import devtel as _devtel
+
+        _devtel.deactivate(devtel_plane)
     slo_plane = app.get("slo")
     if slo_plane is not None:
         slo_plane.stop()

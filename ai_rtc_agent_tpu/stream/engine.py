@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import devtel
 from ..ops import image as I
 from ..ops import lcm as L
 from ..ops import rcfg as R
@@ -482,8 +483,14 @@ def stage_frame(frame_u8):
     (reference NVDEC zero-copy analog, README.md:11-15).  Called BEFORE
     any dispatch lock is taken — a large-frame H2D copy must never
     serialize concurrent sessions' dispatches on what looks like
-    microseconds of host work."""
+    microseconds of host work.
+
+    Being the ONE H2D path (machine-checked: analysis/
+    device_transfers.py) also makes it the one H2D *meter*: every staged
+    frame lands in the device-telemetry transfer counters
+    (obs/devtel.py; one global read + None test when the plane is off)."""
     if isinstance(frame_u8, np.ndarray):
+        devtel.note_h2d(frame_u8.nbytes)
         return jax.device_put(frame_u8)
     return frame_u8
 
@@ -981,7 +988,11 @@ class StreamEngine:
                 if self._tick % self._cache_interval != 0:
                     fn = self._step_cached
                 self._tick += 1
-            self.state, out = fn(self.params, self.state, staged)
+            # compile-watchdog attribution: a lazy first-step compile on
+            # the shared-engine path (BATCHSCHED=0, no prewarm) is
+            # recorded against the engine step, not "unattributed"
+            with devtel.compile_scope("engine-step"):
+                self.state, out = fn(self.params, self.state, staged)
             try:  # overlap device->host copy with subsequent compute
                 out.copy_to_host_async()
             except (AttributeError, RuntimeError):
@@ -997,7 +1008,16 @@ class StreamEngine:
             out, squeeze = pending
         if out is None:  # skip before any real frame was submitted
             return self._last_out
-        out = np.asarray(out)
+        arr = np.asarray(out)
+        if arr is not out:
+            # a real device->host resolve (np input passes through
+            # identically — the fault path's poisoned frames are host
+            # arrays).  Dup chains re-read the same buffer; jax serves
+            # the cached host copy, so this slightly overcounts
+            # transfers on static scenes — the scheduler's memoized
+            # per-row path (the default) is exact.
+            devtel.note_d2h(arr.nbytes)
+        out = arr
         if out.shape[0] == 1 and squeeze:
             out = out[0]
         self._last_out = out

@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import devtel
 from ..obs.trace import get_trace, safe_list
 from ..parallel.multipeer import CapacityError, make_bucket_step
 from ..resilience.overload import DeadlineQueue, ShedFrame
@@ -678,7 +679,10 @@ class BatchScheduler:
     def _build_state(self, prompt, guidance, delta, seed, t_index_list=None):
         from .engine import _coeff_state
 
-        with self._heavy_lock:
+        # devtel: a session claim at serve time runs host-side eager ops
+        # whose tiny per-op compiles are expected costs, not retrace
+        # breaches (the watchdog still records + attributes them)
+        with self._heavy_lock, devtel.expected_scope("sched-state-build"):
             self._template.prepare(
                 prompt, guidance_scale=guidance, delta=delta, seed=seed
             )
@@ -697,10 +701,13 @@ class BatchScheduler:
             self._install_locked(slot, state)
 
     def _install_locked(self, slot: int, state):
-        self.states = jax.tree.map(
-            lambda stacked, fresh: stacked.at[slot].set(fresh),
-            self.states, state,
-        )
+        # devtel: the slot-install .at[].set programs eager-compile on
+        # first use — expected control-plane cost, same as _build_state
+        with devtel.expected_scope("sched-slot-install"):
+            self.states = jax.tree.map(
+                lambda stacked, fresh: stacked.at[slot].set(fresh),
+                self.states, state,
+            )
         if self._cache_interval:
             # the fresh slot's unet_cache row is zeros — make the NEXT
             # global step a capture (multipeer install() contract) AND
@@ -710,14 +717,14 @@ class BatchScheduler:
             self._uncaptured.add(slot)
 
     def _encode(self, prompt: str):
-        with self._heavy_lock:
+        with self._heavy_lock, devtel.expected_scope("sched-prompt-encode"):
             res = self._template.encode_prompt(prompt)
             return res if len(res) == 3 else (*res, {})
 
     def _apply_prompt(self, slot: int, encoded):
         cond, uncond, extras = encoded
         dt = self.cfg.jdtype
-        with self._lock:
+        with self._lock, devtel.expected_scope("sched-control-write"):
             self.states["cond"] = (
                 self.states["cond"].at[slot].set(jnp.asarray(cond, dt))
             )
@@ -748,7 +755,7 @@ class BatchScheduler:
                 "(compiled batch size)"
             )
         coeffs = _coeff_state(self.cfg, self._template.schedule, t_index_list)
-        with self._lock:
+        with self._lock, devtel.expected_scope("sched-control-write"):
             for k, v in coeffs.items():
                 self.states["coeffs"][k] = (
                     self.states["coeffs"][k].at[slot].set(v)
@@ -758,7 +765,7 @@ class BatchScheduler:
                 self._uncaptured.add(slot)
 
     def _apply_guidance(self, slot: int, guidance, delta):
-        with self._lock:
+        with self._lock, devtel.expected_scope("sched-control-write"):
             if guidance is not None:
                 self.states["guidance"] = (
                     self.states["guidance"]
@@ -951,11 +958,17 @@ class BatchScheduler:
                 if self._aot_adopted and (k, v) in self._bucket_steps:
                     continue
                 params_s, states_s, frames_s, idx_s = self._bucket_specs(k)
-                compiled = (
-                    self._bucket_step(k, v)
-                    .lower(params_s, states_s, frames_s, idx_s)
-                    .compile()
-                )
+                # devtel: attribute the eager compile to its bucket; the
+                # body IS a compile by construction, so in the
+                # no-monitoring fallback it self-times (fallback_record)
+                with devtel.compile_scope(
+                    f"sbucket-{k}:{v}", fallback_record=True
+                ):
+                    compiled = (
+                        self._bucket_step(k, v)
+                        .lower(params_s, states_s, frames_s, idx_s)
+                        .compile()
+                    )
                 self._bucket_steps[(k, v)] = compiled
                 self._warmed_buckets.add((k, v))
                 logger.info(
@@ -1076,12 +1089,17 @@ class BatchScheduler:
             if variant == "capture":
                 self._uncaptured.difference_update(idx)
         feed = (k, variant) in self._warmed_buckets
-        self.states, out = self._bucket_step(k, variant)(
-            self.params,
-            self.states,
-            frames_k,
-            self._idx_for(pad),
-        )
+        # compile-watchdog attribution: a bucket step that compiles HERE
+        # (prewarm disabled, or an evicted/missed geometry) is recorded
+        # against its (k, variant) — in the serving phase that is the
+        # serve-time retrace breach this plane exists to catch
+        with devtel.compile_scope(f"sbucket-{k}:{variant}"):
+            self.states, out = self._bucket_step(k, variant)(
+                self.params,
+                self.states,
+                frames_k,
+                self._idx_for(pad),
+            )
         self._warmed_buckets.add((k, variant))
         # per-slot readback plane: slice each rider's row ON DEVICE and
         # start its D2H copy now — a fetch resolves only its own buffer,
@@ -1239,6 +1257,11 @@ class BatchScheduler:
                     # [k=1,fbs=1,H,W,3]; the scheduler is fbs==1 only
                     while arr.ndim > 3 and arr.shape[0] == 1:
                         arr = arr[0]
+                    # D2H accounting (obs/devtel.py): exactly one note
+                    # per row — the memoized host copy means dup/skip
+                    # fetches never re-transfer, so this meter is the
+                    # fetch-isolation story as a live counter
+                    devtel.note_d2h(arr.nbytes)
                     batch.host[row] = arr
                     batch.rows[row] = None  # release the device buffer
                     out = arr

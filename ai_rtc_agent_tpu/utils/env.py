@@ -137,6 +137,14 @@ def slo_enabled() -> bool:
     return get_bool("SLO_ENABLE", True)
 
 
+def devtel_enabled() -> bool:
+    """Device telemetry plane (obs/devtel.py) — the compile watchdog +
+    AOT/transfer accounting.  DEVTEL_ENABLE=0 removes it: the jax
+    monitoring listener is never registered and the note_* hooks on the
+    staging/readback hot paths reduce to one module-global read."""
+    return get_bool("DEVTEL_ENABLE", True)
+
+
 def batchsched_enabled() -> bool:
     """Continuous cross-session batch scheduler (stream/scheduler.py) —
     the default single-device serving path.  BATCHSCHED=0 restores the

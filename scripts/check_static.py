@@ -105,9 +105,10 @@ def main(argv=None) -> int:
     if files is not None:
         # a partial scan set cannot prove registry completeness (unread
         # knobs / metric collisions live across files) — per-file rules only
-        checkers = ("async-blocking", "bounded-queue", "encoder-reconfig",
-                    "metric-cardinality", "pooled-view", "span-pairing",
-                    "trace-purity", "retry-4xx", "restart-defaults")
+        checkers = ("async-blocking", "bounded-queue", "device-transfer",
+                    "encoder-reconfig", "metric-cardinality", "pooled-view",
+                    "span-pairing", "trace-purity", "retry-4xx",
+                    "restart-defaults")
 
     project, parse_errors = load_project(root, files=files)
     findings = list(parse_errors) + run_checkers(project, checkers)

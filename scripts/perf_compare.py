@@ -70,6 +70,10 @@ _LOWER_BETTER_SUBSTRINGS = (
 DEFAULT_METRIC_TOLERANCES = {
     "pipelined_overlap_speedup_d4": 0.25,
     "batchsched_fetch_isolation_ratio_4s": 0.5,
+    # devtel off-mode residue (ISSUE 10): two no-op hook calls against a
+    # ~30µs host kernel — the fence catches allocation/locking landing
+    # back on the DEVTEL_ENABLE=0 path, sized for CI throttle noise
+    "devtel_off_overhead_ratio": 0.35,
 }
 
 

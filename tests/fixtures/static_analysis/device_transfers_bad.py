@@ -1,0 +1,57 @@
+"""Known-bad fixture for the device-transfer checker.
+
+``BadScheduler._drain_batch`` reproduces the shape of PR 9's pre-fix
+dispatcher: the ENTIRE stacked bucket output drained to host with one
+``np.asarray`` and sliced per session afterwards — every fetch billed
+all the others.  Every line marked # BAD must be flagged; the ok_*
+spellings stay clean."""
+
+import jax
+import numpy as np
+
+
+class BadScheduler:
+    def _drain_batch(self, entries, k, variant, frames, idx):
+        # the pre-fix whole-batch drain (old scheduler.py:1062): step,
+        # then ONE host copy of the stacked [S, ...] output
+        self.states, out = self._bucket_step(k, variant)(
+            self.params, self.states, frames, idx
+        )
+        host = np.asarray(out)  # BAD batch-drain
+        for i, (s, p) in enumerate(entries):
+            p.future.set_result(host[i])
+
+    def _drain_subscript(self, frames, idx):
+        out = self._step(self.params, self.states, frames)
+        return np.asarray(out[0])  # BAD batch-drain (subscript of tainted)
+
+    def _drain_via_alias(self, frames):
+        fn = self._step_cached
+        self.states, out = fn(self.params, self.states, frames)
+        return np.array(out)  # BAD batch-drain (aliased step callable)
+
+    def _stage(self, frame):
+        return jax.device_put(frame)  # BAD stray-h2d (bare staging form)
+
+    def _pull(self, out):
+        out.copy_to_host_async()  # BAD stray-async-d2h
+        return jax.device_get(out)  # BAD stray-d2h
+
+    # -- clean spellings ------------------------------------------------------
+
+    def ok_host_asarray(self, frame_u8):
+        # host pixels (the similarity-filter idiom): never tainted
+        return np.asarray(frame_u8)[..., ::16, ::16, :]
+
+    def ok_sharded_placement(self, params, shardings):
+        # explicit placement is mesh layout, not frame staging
+        return jax.device_put(params, shardings)
+
+    def ok_retaint_cleared(self, frames):
+        out = self._step(self.params, self.states, frames)
+        out = frames  # reassignment clears the taint
+        return np.asarray(out)
+
+    def ok_blessed_helper(self, frame, stage_frame):
+        # routing through the blessed helper is the whole point
+        return stage_frame(frame)

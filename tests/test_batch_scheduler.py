@@ -176,9 +176,15 @@ def test_amortized_admission_feed_and_aot_roundtrip(
     adopts WITHOUT building, and aot_status/EngineCache.has report the
     prebuilt set (the build CLI's pre-warm surface)."""
     feeds = []
+    # every phase below relies on a+b coalescing into ONE k=2 batch; a
+    # wide window makes that deterministic on a throttled box (a 2 ms
+    # window let the dispatcher fire session a's frame solo before b's
+    # submit ever ran — observed once at 865 s of suite load).  The
+    # happy path never waits the window out: b's submit completes the
+    # batch and dispatches inline.
     s = BatchScheduler(
         bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
-        model_id="tiny-test", max_sessions=2, window_ms=2.0,
+        model_id="tiny-test", max_sessions=2, window_ms=500.0,
         prewarm=False, aot_build_on_miss=False, cache_dir=str(tmp_path),
     )
     s.on_step = lambda dt, occ: feeds.append((dt, occ))
@@ -235,7 +241,7 @@ def test_amortized_admission_feed_and_aot_roundtrip(
 
     s2 = BatchScheduler(
         bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
-        model_id="tiny-test", max_sessions=2, window_ms=2.0,
+        model_id="tiny-test", max_sessions=2, window_ms=500.0,
         prewarm=False, aot_build_on_miss=False, cache_dir=str(tmp_path),
     )
     try:

@@ -2,6 +2,8 @@
 
 import os
 
+import pytest
+
 from ai_rtc_agent_tpu.assets.build_engines import build
 
 
@@ -50,6 +52,10 @@ def test_no_adoption_without_prebuilt_engine(tmp_path, monkeypatch):
     assert not eng.use_aot_cache("tiny-test", build_on_miss=False)
 
 
+@pytest.mark.slow  # a second full build with the ControlNet graph
+# (~11s); the tiny build + serving-adoption tests keep the CLI covered
+# in tier-1, and the variant keying itself is pinned by stream_engine_key
+# unit tests
 def test_build_controlnet_engine_variant(tmp_path):
     """ControlNet engine variant gets its own cache key (reference compiles a
     separate UNet+ControlNet engine, lib/wrapper.py:870-877)."""

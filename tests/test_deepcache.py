@@ -271,6 +271,9 @@ def test_multipeer_global_cadence():
     assert mp._tick == 0
 
 
+@pytest.mark.slow  # 4 bucket-variant compiles (~15s); the global-cadence
+# multipeer test + the scheduler's EQUIV_DC_OK legs keep the DeepCache
+# composition covered in tier-1
 def test_multipeer_buckets_compose_with_deepcache(monkeypatch):
     """VERDICT r3 item 7: below-capacity occupancy must keep the bucket
     FLOPs saving WITH DeepCache — per-bucket (size, variant) pairs, and the

@@ -47,7 +47,16 @@ def test_unet_keymap_full_geometry(fam):
 
 
 @pytest.mark.parametrize(
-    "cfg_name", ["sd15", "sd21", "sdxl_g"]
+    "cfg_name",
+    [
+        "sd15",
+        # the big text towers cost ~14s EACH of pure host tree-building
+        # on this box; sd15 stays as the tier-1 representative (same map
+        # code, same conventions), the rest ride the slow tier like the
+        # full-geometry UNet variant above (tier-1 budget, ISSUE 10)
+        pytest.param("sd21", marks=pytest.mark.slow),
+        pytest.param("sdxl_g", marks=pytest.mark.slow),
+    ],
 )
 def test_clip_keymap_full_geometry(cfg_name):
     cfg = getattr(C.CLIPTextConfig, cfg_name)()

@@ -221,6 +221,9 @@ def test_prewarm_buckets_compiles_and_survives_aot(bundle, tmp_path):
     assert out.shape == (4, 64, 64, 3)
 
 
+@pytest.mark.slow  # builds + serializes + re-adopts the capture/cached
+# pair (~14s); test_multipeer_aot_cache_roundtrip keeps the multipeer AOT
+# surface in tier-1 and the scheduler AOT tests pin the pair discipline
 def test_multipeer_deepcache_aot_pair_adopts_and_reloads(tmp_path, monkeypatch):
     """VERDICT r3 item 7 follow-through: the multipeer DeepCache pair is
     exportable — both variants serialize per peer count and a FRESH engine

@@ -151,6 +151,69 @@ def test_encoder_reconfig_exempts_codec_tier_and_tooling(tmp_path):
     assert [f.path for f in fs] == ["ai_rtc_agent_tpu/plane.py"]
 
 
+def test_device_transfer_catches_drains_and_stray_puts():
+    """ISSUE 10 satellite: the fixture reproduces PR 9's pre-fix
+    whole-batch np.asarray drain (the every-fetch-bills-all-sessions
+    copy), plus the stray bare device_put / device_get /
+    copy_to_host_async shapes — all flagged; host-data asarray, sharded
+    placement and taint-cleared reassignment stay clean."""
+    fs = run_on(["device_transfers_bad.py"], ("device-transfer",))
+    scopes = {f.scope for f in fs}
+    msgs = " | ".join(f.message for f in fs)
+    assert "BadScheduler._drain_batch" in scopes  # the PR 9 bug shape
+    assert "whole-batch host drain" in msgs
+    assert "BadScheduler._drain_subscript" in scopes  # asarray(out[0])
+    assert "BadScheduler._drain_via_alias" in scopes  # fn = self._step_cached
+    assert "BadScheduler._stage" in scopes  # bare device_put
+    assert "BadScheduler._pull" in scopes  # copy_to_host_async + device_get
+    assert "stray H2D" in msgs and "stray D2H" in msgs
+    src = (FIXTURES / "device_transfers_bad.py").read_text().splitlines()
+    flagged = {src[f.line - 1].strip() for f in fs}
+    assert len(fs) == 6, "\n".join(f.render() for f in fs)
+    assert all("# BAD" in s for s in flagged), flagged
+    assert not any(s.startswith("BadScheduler.ok_") for s in scopes), scopes
+
+
+def test_device_transfer_blesses_helpers_and_exempts_tiers(tmp_path):
+    """stage_frame/the readback scopes own their transfers; the
+    export/placement tiers and operator tooling are carved out — only a
+    stray site in serving code is flagged."""
+    root = tmp_path
+    (root / "ai_rtc_agent_tpu" / "stream").mkdir(parents=True)
+    (root / "ai_rtc_agent_tpu" / "aot").mkdir(parents=True)
+    (root / "scripts").mkdir()
+    engine_body = (
+        "import jax\n"
+        "def stage_frame(f):\n"
+        "    return jax.device_put(f)\n"
+    )
+    sched_body = (
+        "import numpy as np\n"
+        "class BatchScheduler:\n"
+        "    def _step_batch_locked(self, entries):\n"
+        "        out = self._bucket_step(1, 'full')(entries)\n"
+        "        out.copy_to_host_async()\n"
+        "        return out\n"
+        "    def _resolve_row(self, batch, row):\n"
+        "        out = self._step(batch)\n"
+        "        return np.asarray(out)\n"
+    )
+    stray = "import jax\ndef f(x):\n    return jax.device_put(x)\n"
+    (root / "ai_rtc_agent_tpu" / "stream" / "engine.py").write_text(engine_body)
+    (root / "ai_rtc_agent_tpu" / "stream" / "scheduler.py").write_text(sched_body)
+    (root / "ai_rtc_agent_tpu" / "aot" / "cache.py").write_text(stray)
+    (root / "scripts" / "tool.py").write_text(stray)
+    (root / "ai_rtc_agent_tpu" / "plane.py").write_text(stray)
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("device-transfer",))
+    # blessed scopes in the real engine/scheduler paths are clean, the
+    # export tier and tooling exempt — only the serving-code stray fires
+    assert sorted({f.path for f in fs}) == ["ai_rtc_agent_tpu/plane.py"], [
+        f.render() for f in fs
+    ]
+
+
 def test_span_pairing_catches_unbalanced_and_respects_closures():
     """ISSUE 5 satellite: every ``trace.begin`` must reach a matching
     ``end`` on all paths (obs/trace.py timelines stay well-formed) —

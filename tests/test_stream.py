@@ -99,6 +99,7 @@ def test_turbo_1_step():
     assert not np.array_equal(o1, o2)
 
 
+@pytest.mark.slow  # n_stages separate UNet compiles for a shape assert
 def test_sequential_mode_matches_shapes():
     eng, cfg = _engine(use_denoising_batch=False)
     eng.prepare("p", seed=0)
@@ -209,6 +210,8 @@ def test_similar_image_filter_with_pipelined_depth():
     assert all(o.dtype == np.uint8 for o in outs)
 
 
+@pytest.mark.slow  # two full engine builds + a tp=2 virtual mesh (~12s);
+# the deepcache sharded-compose legs keep tp-mesh coverage in tier-1
 def test_tp_sharded_stream_engine_matches_single():
     """Tensor-parallel single-stream serving (--tp N): the tp=2-sharded
     engine computes the same stream as the single-device one (SURVEY
@@ -232,6 +235,9 @@ def test_tp_sharded_stream_engine_matches_single():
         assert np.abs(o1.astype(int) - o2.astype(int)).max() <= 2
 
 
+@pytest.mark.slow  # two full engine builds + an sp=2 virtual mesh (~14s);
+# test_parallel's ring-attention parity + the deepcache sp-mesh compose
+# leg keep the sequence-parallel path covered in tier-1
 def test_sp_sharded_stream_engine_matches_single(monkeypatch):
     """Sequence-parallel single-stream serving (--sp N + ATTN_IMPL=ring):
     the sp=2 engine routes UNet attention through ring attention
