@@ -1,0 +1,34 @@
+"""Fleet control plane: capacity-aware routing across agent processes.
+
+After PRs 4-10 every fleet primitive exists exactly one process deep:
+``GET /capacity`` with counted admission reservations, the worker sidecar
+publishing remaining capacity, supervisor + SLO + devtel state at
+``/health``, and StreamDegraded/RETRACE_BREACH webhooks.  This package is
+the tier that joins N such processes into one serving surface:
+
+* :mod:`~ai_rtc_agent_tpu.fleet.registry` — membership + health: agent
+  records fed by worker publishes and by polling each agent's
+  ``/health`` + ``/capacity`` on the overload-tick cadence, with a
+  HEALTHY/DEGRADED/DRAINING/DEAD state machine driven by poll results
+  and ingested webhooks.
+* :mod:`~ai_rtc_agent_tpu.fleet.router` — the aiohttp front door: places
+  ``/offer``/``/whip``/``/whep`` onto the least-loaded healthy agent
+  (the agent's own counted admission reservation stays the source of
+  truth), honors per-agent ``Retry-After`` hints, drains agents for
+  recycling via the admission-freeze rung, re-points a dead agent's
+  clients through the existing webhook path, and serves a fleet-rollup
+  ``/metrics`` (JSON + Prometheus exposition) aggregated across agents.
+
+Architecture + runbook: docs/fleet.md.
+"""
+
+from .registry import AGENT_STATES, AgentRecord, FleetPoller, FleetRegistry
+from .router import build_router_app
+
+__all__ = [
+    "AGENT_STATES",
+    "AgentRecord",
+    "FleetPoller",
+    "FleetRegistry",
+    "build_router_app",
+]

@@ -1,0 +1,459 @@
+"""Fleet membership + health: the agent registry behind the router.
+
+Each serving agent process is one :class:`AgentRecord`.  Records enter
+the registry when a worker sidecar publishes its connection info
+(``POST /fleet/register`` is a valid ``WORKER_PUBLISH_URL`` target —
+server/worker.py needs no fleet-specific code), and stay current through
+two feeds:
+
+* the **poll loop** (:class:`FleetPoller`): every ``FLEET_POLL_S`` —
+  the overload-tick cadence — each live agent's ``GET /capacity`` and
+  ``GET /health`` are fetched over aiohttp (never blocking the loop);
+  the capacity body is the agent's OWN counted admission view
+  (resilience/overload.py reservations included), so the router never
+  second-guesses it, and the health body's worst-session status drives
+  HEALTHY <-> DEGRADED.
+* **webhook ingestion** (router ``POST /fleet/events``): a
+  StreamDegraded / RETRACE_BREACH volley marks the owning agent
+  DEGRADED immediately — the poll remains authoritative and clears the
+  mark on the next healthy read; the webhook only accelerates reaction.
+
+State machine per agent::
+
+    HEALTHY <-> DEGRADED --(polls keep failing)--> DEAD
+       |            |
+       +-- drain ---+--> DRAINING --(live sessions reach 0)--> recyclable
+
+DEAD is terminal until the worker re-registers (a recycled replacement
+publishing the same worker_id revives the record fresh).  DRAINING rides
+the agent's admission-freeze rung (``POST /drain`` on the agent): the
+agent itself stops admitting, live sessions finish naturally, and the
+registry flips ``recyclable`` when its session count reaches zero.
+
+Between capacity polls the router counts its own placements against the
+advertised headroom (``placed``) so a burst cannot route N sessions into
+one box on a stale read; the counter resets on every poll because the
+agent's reservation ledger (admission_gate pending + live ladders) has
+already absorbed the placements by then.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..utils import env
+
+logger = logging.getLogger(__name__)
+
+# closed enum: every state a fleet rollup gauge may be keyed by
+AGENT_STATES = ("HEALTHY", "DEGRADED", "DRAINING", "DEAD")
+
+# session states whose webhook marks the owning agent DEGRADED (the
+# StreamDegraded family + the device-telemetry/SLO breach volleys)
+BREACH_STATES = ("DEGRADED", "FAILED", "RETRACE_BREACH", "SLO_BREACH",
+                 "AGENT_DEAD")
+
+
+class AgentRecord:
+    """One serving agent process as the fleet sees it."""
+
+    __slots__ = (
+        "agent_id", "base_url", "state", "capacity", "saturated",
+        "retry_after_s", "live_sessions", "draining", "recyclable",
+        "fail_count", "placed", "not_before", "last_ok",
+    )
+
+    def __init__(self, agent_id: str, base_url: str):
+        self.agent_id = agent_id
+        self.base_url = base_url.rstrip("/")
+        self.state = "HEALTHY"
+        self.capacity = -1  # agent-advertised remaining sessions; -1 = unbounded
+        self.saturated = False
+        self.retry_after_s = 0.0
+        self.live_sessions = 0
+        self.draining = False
+        self.recyclable = False
+        self.fail_count = 0
+        self.placed = 0  # optimistic placements since the last capacity poll
+        self.not_before = 0.0  # Retry-After honor window (monotonic deadline)
+        self.last_ok: float | None = None
+
+    def effective_capacity(self) -> int | None:
+        """Advertised headroom minus placements not yet visible in a
+        poll; None = unbounded."""
+        if self.capacity < 0:
+            return None
+        return max(0, self.capacity - self.placed)
+
+    def available(self, now: float) -> bool:
+        """Can the router place a session here right now?"""
+        if self.state == "DEAD" or self.draining:
+            return False
+        if now < self.not_before:
+            # a 503's Retry-After (or a saturated /capacity hint) is the
+            # agent saying "not before then" — re-offering sooner is the
+            # hot-loop this window exists to kill
+            return False
+        if self.saturated:
+            return False
+        ec = self.effective_capacity()
+        return ec is None or ec > 0
+
+    def backoff(self, retry_after_s: float, now: float):
+        self.not_before = max(self.not_before, now + max(0.0, retry_after_s))
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "base_url": self.base_url,
+            "capacity": self.capacity,
+            "saturated": self.saturated,
+            "live_sessions": self.live_sessions,
+            "draining": self.draining,
+            "recyclable": self.recyclable,
+            "fail_count": self.fail_count,
+        }
+
+
+class FleetRegistry:
+    """Membership + placement policy; all mutation on the event loop.
+
+    ``stats`` is a FrameStats: fleet counters land as ``fleet_*_total``
+    in the rollup.  ``on_dead(record)`` fires exactly once per death —
+    the router re-points that agent's clients from it.  ``on_event``
+    (``callable(kind, agent_id, **data)``) observes transitions for
+    logs/debugging; failures never break the control plane.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_agents: int | None = None,
+        dead_after: int | None = None,
+        clock=time.monotonic,
+        stats=None,
+        on_dead=None,
+    ):
+        self.max_agents = (
+            env.get_int("FLEET_MAX_AGENTS", 64)
+            if max_agents is None else max_agents
+        )
+        self.dead_after = max(
+            1,
+            env.get_int("FLEET_DEAD_AFTER", 3)
+            if dead_after is None else dead_after,
+        )
+        self._clock = clock
+        self.stats = stats
+        self.on_dead = on_dead
+        self.agents: dict[str, AgentRecord] = {}
+
+    def now(self) -> float:
+        return self._clock()
+
+    # -- membership (worker publishes) ---------------------------------------
+
+    def register(self, info: dict) -> AgentRecord | None:
+        """Ingest one worker publish (server/worker.py ``info`` dict).
+        Returns the record, or None when the registry is full (bounded
+        membership — a rogue publisher cannot grow it without limit).
+        A publish for a known id refreshes it; publishing over a DEAD
+        record is the recycle path and revives it fresh."""
+        agent_id = str(info.get("worker_id") or "")
+        port = str(info.get("public_port") or "")
+        if not agent_id or not port:
+            raise ValueError("publish needs worker_id and public_port")
+        host = str(info.get("public_ip") or "127.0.0.1")
+        base_url = f"http://{host}:{port}"
+        rec = self.agents.get(agent_id)
+        if rec is None:
+            if len(self.agents) >= self.max_agents:
+                # corpses must not lock out replacements: orchestrators
+                # recycle crashed agents under NEW ids (fresh pod/host
+                # names), so a churning fleet would otherwise fill the
+                # registry with DEAD records and 503 every newcomer
+                dead = [
+                    aid for aid, r in self.agents.items()
+                    if r.state == "DEAD"
+                ]
+                if dead:
+                    self.agents.pop(dead[0])  # oldest corpse goes first
+            if len(self.agents) >= self.max_agents:
+                self._count("fleet_registers_refused")
+                return None
+            rec = AgentRecord(agent_id, base_url)
+            self.agents[agent_id] = rec
+        elif rec.state == "DEAD" or rec.base_url != base_url.rstrip("/"):
+            # replacement (same id re-published, possibly at a new
+            # address): forget the corpse's history entirely
+            self.agents[agent_id] = rec = AgentRecord(agent_id, base_url)
+        if "capacity" in info:
+            try:
+                rec.capacity = int(info["capacity"])
+            except (TypeError, ValueError):
+                pass
+            rec.saturated = bool(info.get("saturated", False))
+        self._count("fleet_registers")
+        return rec
+
+    def remove(self, agent_id: str) -> bool:
+        return self.agents.pop(agent_id, None) is not None
+
+    # -- health feeds ---------------------------------------------------------
+
+    def note_poll(self, rec: AgentRecord, capacity: dict | None,
+                  health: dict | None):
+        """One successful poll round-trip for ``rec``."""
+        rec.fail_count = 0
+        rec.last_ok = self._clock()
+        if capacity is not None:
+            try:
+                rec.capacity = int(capacity.get("capacity", -1))
+            except (TypeError, ValueError):
+                rec.capacity = -1
+            rec.saturated = bool(capacity.get("saturated", False))
+            try:
+                rec.retry_after_s = float(capacity.get("retry_after_s", 0.0))
+            except (TypeError, ValueError):
+                rec.retry_after_s = 0.0
+            # the agent's ledger has absorbed our placements by now —
+            # its advertised number supersedes the optimistic decrement
+            rec.placed = 0
+        status = "HEALTHY"
+        if health is not None:
+            sessions = health.get("sessions")
+            if isinstance(sessions, dict):
+                rec.live_sessions = len(sessions)
+            status = str(health.get("status", "HEALTHY"))
+        if rec.state == "DEAD":
+            return  # dead stays dead until the worker re-registers
+        if rec.draining:
+            rec.state = "DRAINING"
+            if rec.live_sessions == 0 and not rec.recyclable:
+                rec.recyclable = True
+                logger.info("agent %s drained to zero — recyclable",
+                            rec.agent_id)
+        elif status == "HEALTHY":
+            rec.state = "HEALTHY"
+        else:
+            rec.state = "DEGRADED"
+
+    def note_poll_fail(self, rec: AgentRecord):
+        """One failed poll (or failed proxy attempt — a connection
+        refused on placement is the same evidence)."""
+        rec.fail_count += 1
+        self._count("fleet_polls_failed")
+        if rec.fail_count >= self.dead_after and rec.state != "DEAD":
+            self.mark_dead(rec)
+
+    def mark_dead(self, rec: AgentRecord):
+        rec.state = "DEAD"
+        rec.recyclable = False
+        self._count("fleet_agents_died")
+        logger.warning("agent %s declared DEAD after %d failures",
+                       rec.agent_id, rec.fail_count)
+        if self.on_dead is not None:
+            try:
+                self.on_dead(rec)
+            except Exception:
+                logger.exception("fleet on_dead handler failed")
+
+    def ingest_event(self, event: dict, agent_id: str | None):
+        """One webhook volley from an agent (StreamDegraded family).
+        ``agent_id`` is the owner resolved from the router's session
+        table (None when unattributable, e.g. a RETRACE_BREACH's
+        synthetic stream id) — the event still counts in the rollup."""
+        self._count("fleet_events_ingested")
+        state = str(event.get("state", ""))
+        if event.get("event") == "StreamDegraded" and state in BREACH_STATES:
+            self._count("fleet_breaches")
+            rec = self.agents.get(agent_id) if agent_id else None
+            if rec is not None and rec.state == "HEALTHY":
+                # accelerate: the next poll confirms or clears this
+                rec.state = "DEGRADED"
+
+    # -- placement ------------------------------------------------------------
+
+    def pick(self, exclude=()) -> AgentRecord | None:
+        """The least-loaded agent a new session should land on, or None.
+        HEALTHY agents strictly first; DEGRADED ones only when no
+        healthy agent can take the session (degraded still serves —
+        refuse the fleet over it only when nothing better exists).
+        Least-loaded = most effective free capacity (unbounded sorts
+        first), ties broken by fewest live sessions."""
+        now = self._clock()
+        candidates = [
+            r for r in self.agents.values()
+            if r.agent_id not in exclude and r.available(now)
+        ]
+        for tier in ("HEALTHY", "DEGRADED"):
+            tier_recs = [r for r in candidates if r.state == tier]
+            if not tier_recs:
+                continue
+
+            def load_key(r: AgentRecord):
+                ec = r.effective_capacity()
+                free = float("inf") if ec is None else float(ec)
+                return (-free, r.live_sessions + r.placed)
+
+            return min(tier_recs, key=load_key)
+        return None
+
+    def note_placed(self, rec: AgentRecord):
+        rec.placed += 1
+        self._count("fleet_placements")
+
+    def retry_after_hint(self, default_s: float) -> float:
+        """One coherent Retry-After for a fleet-wide refusal: the
+        SOONEST any non-dead agent might admit again (its backoff window
+        remainder, else its advertised hint), floored at 1s so clients
+        never hammer."""
+        now = self._clock()
+        hints = []
+        for r in self.agents.values():
+            if r.state == "DEAD" or r.draining:
+                continue
+            if now < r.not_before:
+                hints.append(r.not_before - now)
+            elif r.retry_after_s > 0:
+                hints.append(r.retry_after_s)
+            else:
+                hints.append(default_s)
+        return max(1.0, min(hints) if hints else default_s)
+
+    # -- observability --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Fleet-rollup gauges: aggregated across agents, NEVER keyed by
+        agent identity (metric-cardinality discipline — per-agent detail
+        lives at /fleet/health, which is JSON-only)."""
+        by_state = dict.fromkeys(AGENT_STATES, 0)
+        cap_free = 0
+        unbounded = 0
+        sessions = 0
+        recyclable = 0
+        for r in self.agents.values():
+            by_state[r.state] = by_state.get(r.state, 0) + 1
+            sessions += r.live_sessions
+            if r.recyclable:
+                recyclable += 1
+            if r.state in ("HEALTHY", "DEGRADED"):
+                ec = r.effective_capacity()
+                if ec is None:
+                    unbounded += 1
+                elif not r.saturated:
+                    cap_free += ec
+        return {
+            "fleet_agents": len(self.agents),
+            "fleet_agents_healthy": by_state["HEALTHY"],
+            "fleet_agents_degraded": by_state["DEGRADED"],
+            "fleet_agents_draining": by_state["DRAINING"],
+            "fleet_agents_dead": by_state["DEAD"],
+            "fleet_agents_recyclable": recyclable,
+            "fleet_capacity_free": cap_free,
+            "fleet_capacity_unbounded_agents": unbounded,
+            "fleet_sessions": sessions,
+        }
+
+    def _count(self, name: str, n: int = 1):
+        if self.stats is not None:
+            # tpurtc: allow[metrics-registry] -- closed set: every name this registry counts is a literal at its call sites (fleet_registers, fleet_registers_refused, fleet_polls_failed, fleet_agents_died, fleet_events_ingested, fleet_breaches, fleet_placements)
+            self.stats.count(name, n)
+
+
+class FleetPoller:
+    """Polls every live agent's /capacity + /health on the overload-tick
+    cadence; all HTTP over one shared aiohttp session (the async-blocking
+    checker's rule: nothing in this subsystem may block the loop)."""
+
+    def __init__(
+        self,
+        registry: FleetRegistry,
+        *,
+        interval_s: float | None = None,
+        timeout_s: float | None = None,
+    ):
+        self.registry = registry
+        self.interval_s = (
+            env.get_float("FLEET_POLL_S", 0.25)
+            if interval_s is None else interval_s
+        )
+        self.timeout_s = (
+            env.get_float("FLEET_POLL_TIMEOUT_S", 2.0)
+            if timeout_s is None else timeout_s
+        )
+        self._task = None
+        self._session = None
+
+    async def start(self):
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+        )
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self):
+        try:
+            while True:
+                await asyncio.sleep(self.interval_s)
+                try:
+                    await self.poll_once()
+                except Exception:
+                    # the poll task dying is the one failure the fleet
+                    # cannot see (stale capacity, no death detection) —
+                    # a bad round must never end the loop
+                    logger.exception("fleet poll round failed")
+        except asyncio.CancelledError:
+            pass
+
+    async def poll_once(self):
+        """One poll round over the whole membership (public so tests —
+        and the drain handler — can drive it without waiting a tick)."""
+        recs = [
+            r for r in self.registry.agents.values() if r.state != "DEAD"
+        ]
+        if recs:
+            await asyncio.gather(*[self._poll_agent(r) for r in recs])
+
+    async def _poll_agent(self, rec: AgentRecord):
+        import aiohttp
+
+        try:
+            cap, health = await asyncio.gather(
+                self._get_json(rec.base_url + "/capacity"),
+                self._get_json(rec.base_url + "/health"),
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("poll of %s failed: %s", rec.agent_id, e)
+            self.registry.note_poll_fail(rec)
+            return
+        if cap is None and health is None:
+            # 200s that carry no parseable agent surface (a reverse proxy
+            # serving an error page, garbage JSON) are NOT health — an
+            # agent that never answers usefully must still reach DEAD
+            self.registry.note_poll_fail(rec)
+            return
+        self.registry.note_poll(rec, cap, health)
+
+    async def _get_json(self, url: str):
+        async with self._session.get(url) as resp:
+            if resp.status != 200:
+                return None
+            try:
+                body = await resp.json()
+            except ValueError:
+                return None
+            # note_poll assumes dict surfaces; a 200 carrying a JSON
+            # array/string must read as "no data", not kill the poller
+            return body if isinstance(body, dict) else None
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

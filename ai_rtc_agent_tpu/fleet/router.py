@@ -1,0 +1,495 @@
+"""The fleet front door: capacity-aware placement, drain, replacement.
+
+An aiohttp application that makes N agent processes look like one:
+
+  POST /offer | /whip | /whep    place onto the least-loaded healthy
+                                 agent and proxy the signaling exchange
+  DELETE /whip/{s} | /whep/{s}   routed back to the owning agent via the
+                                 bounded session table
+  POST /fleet/register           worker-sidecar publish target (a valid
+                                 WORKER_PUBLISH_URL — server/worker.py
+                                 needs no fleet-specific code)
+  POST /fleet/events             webhook ingest (agents' WEBHOOK_URL):
+                                 StreamDegraded/RETRACE_BREACH mark the
+                                 owning agent DEGRADED ahead of the poll
+  POST /fleet/drain?agent=ID     flip an agent to DRAINING through its
+                                 admission-freeze rung (&action=cancel
+                                 reverts); /fleet/health shows
+                                 ``recyclable`` once it reaches zero
+  GET  /fleet/health             per-agent membership view (JSON only)
+  GET  /metrics                  fleet rollup, aggregated across agents
+                                 (?format=prom = Prometheus exposition)
+
+Placement discipline (docs/fleet.md):
+
+* the agent's own counted admission reservation is the source of truth —
+  the router forwards and lets the agent's gate decide; the registry's
+  optimistic ``placed`` counter only covers the window between capacity
+  polls so a burst cannot pile onto one stale-looking box.
+* an agent 503 is honored: its ``Retry-After`` opens a backoff window in
+  which that agent is never re-offered; the request is re-placed on the
+  next-best agent at most ``FLEET_PLACE_ATTEMPTS`` distinct agents deep.
+* a fleet-wide refusal is ONE coherent 503 + Retry-After (the soonest
+  any agent might admit), never a fan-out of client retries.
+
+Crash replacement: when the registry declares an agent DEAD, every
+session the router placed there gets a ``StreamDegraded`` webhook with
+``state=AGENT_DEAD`` through the existing events path
+(server/events.py) — clients re-offer through the router, land on a
+replacement, and the agent-side PLI re-sync machinery re-primes the
+stream exactly as it does after any keyframe loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+
+from aiohttp import web
+
+from ..server.events import StreamEventHandler
+from ..utils import env
+from ..utils.profiling import FrameStats
+from .registry import FleetPoller, FleetRegistry
+
+logger = logging.getLogger(__name__)
+
+# response headers worth carrying back through the proxy verbatim
+# (X-Stream-Id included: a client can only act on an AGENT_DEAD webhook
+# if it knows which stream id was ITS session)
+_PASS_HEADERS = ("Content-Type", "Location", "Retry-After", "X-Stream-Id")
+
+
+def _parse_retry_after(value: str | None) -> float | None:
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None  # HTTP-date form — the fleet's own agents never send it
+
+
+def _session_from_location(location: str | None) -> str | None:
+    """WHIP/WHEP answers carry ``Location: /whip/<session>``."""
+    if not location:
+        return None
+    tail = location.rstrip("/").rsplit("/", 1)[-1]
+    return tail or None
+
+
+class _SessionTable:
+    """Bounded stream-id -> placement map (insertion-ordered dict with
+    oldest-first eviction): DELETE routing and crash replacement both
+    need to know which agent owns a session, and the table must not
+    grow without limit under session churn."""
+
+    def __init__(self, bound: int):
+        self.bound = max(1, bound)
+        self._m: dict[str, dict] = {}
+        self.evicted = 0
+
+    def remember(self, stream_id: str, agent_id: str, room_id: str,
+                 kind: str):
+        self._m.pop(stream_id, None)
+        while len(self._m) >= self.bound:
+            self._m.pop(next(iter(self._m)))
+            self.evicted += 1
+        self._m[stream_id] = {
+            "agent": agent_id, "room_id": room_id, "kind": kind
+        }
+
+    def owner(self, stream_id: str) -> str | None:
+        entry = self._m.get(stream_id)
+        return entry["agent"] if entry else None
+
+    def forget(self, stream_id: str):
+        self._m.pop(stream_id, None)
+
+    def pop_agent_sessions(self, agent_id: str) -> list[tuple[str, dict]]:
+        dead = [(sid, e) for sid, e in self._m.items()
+                if e["agent"] == agent_id]
+        for sid, _ in dead:
+            self._m.pop(sid, None)
+        return dead
+
+    def __len__(self):
+        return len(self._m)
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
+
+async def _place_and_proxy(request: web.Request, path: str,
+                           kind: str) -> web.Response:
+    import aiohttp
+
+    app = request.app
+    reg: FleetRegistry = app["fleet"]
+    stats: FrameStats = app["stats"]
+    body = await request.read()
+    headers = {}
+    ct = request.headers.get("Content-Type")
+    if ct:
+        headers["Content-Type"] = ct
+    room_id = ""
+    if kind == "offer":
+        try:  # best-effort: the webhook re-point wants the room id
+            room_id = str(json.loads(body.decode()).get("room_id", ""))
+        except (ValueError, AttributeError, UnicodeDecodeError):
+            room_id = ""
+
+    tried: set = set()
+    hint: float | None = None
+    for _ in range(app["place_attempts"]):
+        rec = reg.pick(exclude=tried)
+        if rec is None:
+            break
+        tried.add(rec.agent_id)
+        try:
+            async with app["http"].post(
+                rec.base_url + path, data=body, headers=headers
+            ) as resp:
+                payload = await resp.read()
+                if resp.status == 503:
+                    # the agent's counted admission gate refused — honor
+                    # ITS hint before this agent is ever offered again,
+                    # then re-place on the next-best agent
+                    ra = _parse_retry_after(resp.headers.get("Retry-After"))
+                    if ra is None:
+                        ra = rec.retry_after_s or app["retry_after_s"]
+                    rec.saturated = True
+                    rec.backoff(ra, reg.now())
+                    hint = ra if hint is None else min(hint, ra)
+                    stats.count("fleet_placement_retries")
+                    continue
+                if 200 <= resp.status < 300:
+                    reg.note_placed(rec)
+                    sid = resp.headers.get("X-Stream-Id") or (
+                        _session_from_location(resp.headers.get("Location"))
+                    )
+                    if sid:
+                        app["session_table"].remember(
+                            sid, rec.agent_id, room_id, kind
+                        )
+                out_headers = {
+                    k: resp.headers[k]
+                    for k in _PASS_HEADERS if k in resp.headers
+                }
+                return web.Response(
+                    status=resp.status, body=payload, headers=out_headers
+                )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            # connection refused / reset mid-exchange: the same evidence
+            # a failed poll gives — count it toward DEAD and move on
+            logger.warning("proxy to %s failed: %s", rec.agent_id, e)
+            reg.note_poll_fail(rec)
+            continue
+    stats.count("fleet_rejects")
+    retry = hint if hint is not None else reg.retry_after_hint(
+        app["retry_after_s"]
+    )
+    return web.Response(
+        status=503,
+        text="fleet saturated",
+        headers={"Retry-After": str(max(1, int(round(retry))))},
+    )
+
+
+async def offer(request):
+    return await _place_and_proxy(request, "/offer", "offer")
+
+
+async def whip(request):
+    if request.method == "DELETE":
+        return await _routed_delete(request, "/whip")
+    return await _place_and_proxy(request, "/whip", "whip")
+
+
+async def whep(request):
+    if request.method == "DELETE":
+        return await _routed_delete(request, "/whep")
+    return await _place_and_proxy(request, "/whep", "whep")
+
+
+async def _routed_delete(request: web.Request, path: str) -> web.Response:
+    import aiohttp
+
+    app = request.app
+    session = request.match_info.get("session")
+    if not session:
+        return web.Response(
+            status=400, text="session-scoped DELETE only at the router"
+        )
+    table: _SessionTable = app["session_table"]
+    agent_id = table.owner(session)
+    rec = app["fleet"].agents.get(agent_id) if agent_id else None
+    if rec is None:
+        return web.Response(status=404, text="unknown session")
+    try:
+        async with app["http"].delete(
+            f"{rec.base_url}{path}/{session}"
+        ) as resp:
+            payload = await resp.read()
+            if resp.status < 500:
+                # 2xx: torn down; 404: the agent no longer knows it —
+                # either way the mapping is dead.  A transient agent
+                # 5xx must NOT drop it, or the client's retry DELETE
+                # 404s here and the session leaks from crash re-point
+                # coverage too.
+                table.forget(session)
+            return web.Response(status=resp.status, body=payload)
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        return web.Response(status=502, text=f"agent unreachable: {e}")
+
+
+async def fleet_register(request):
+    """The worker sidecar's publish target: a 2xx here IS the publish
+    succeeding (server/worker.py treats 4xx as terminal, 5xx/timeouts as
+    retryable — a full registry answers 503 accordingly)."""
+    try:
+        info = await request.json()
+    except (ValueError, LookupError):
+        return web.Response(status=400, text="invalid JSON body")
+    if not isinstance(info, dict):
+        return web.Response(status=400, text="publish must be an object")
+    try:
+        rec = request.app["fleet"].register(info)
+    except ValueError as e:
+        return web.Response(status=400, text=str(e))
+    if rec is None:
+        return web.Response(
+            status=503, text="registry full",
+            headers={"Retry-After": str(int(request.app["retry_after_s"]))},
+        )
+    return web.json_response(
+        {"agent_id": rec.agent_id, "agents": len(request.app["fleet"].agents)}
+    )
+
+
+async def fleet_events(request):
+    """Webhook ingest: agents point WEBHOOK_URL here.  The bearer token
+    is checked when the router has one configured (same AUTH_TOKEN the
+    agents sign with); session ownership resolves through the session
+    table — an unattributable event still counts in the rollup."""
+    handler: StreamEventHandler = request.app["fleet_events"]
+    if handler.token:
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {handler.token}":
+            return web.Response(status=401, text="bad token")
+    try:
+        event = await request.json()
+    except (ValueError, LookupError):
+        return web.Response(status=400, text="invalid JSON body")
+    if not isinstance(event, dict):
+        return web.Response(status=400, text="event must be an object")
+    stream_id = str(event.get("stream_id", ""))
+    agent_id = request.app["session_table"].owner(stream_id)
+    request.app["fleet"].ingest_event(event, agent_id)
+    if event.get("event") == "StreamEnded":
+        # the session is gone on the agent — keeping the mapping would
+        # send spurious AGENT_DEAD re-points to long-idle clients and
+        # crowd live sessions out of the bounded table under churn
+        request.app["session_table"].forget(stream_id)
+    return web.Response(text="OK")
+
+
+async def fleet_drain(request):
+    """POST /fleet/drain?agent=ID[&action=start|cancel]: stop routing to
+    the agent AND flip its own admission-freeze rung (the agent stops
+    admitting locally — sessions arriving around the router are refused
+    too), then let live sessions finish; /fleet/health flips
+    ``recyclable`` at zero.  ``cancel`` reverts both sides."""
+    import aiohttp
+
+    app = request.app
+    agent_id = request.query.get("agent")
+    if not agent_id:
+        return web.Response(status=400, text="agent= query required")
+    rec = app["fleet"].agents.get(agent_id)
+    if rec is None:
+        return web.Response(status=404, text=f"unknown agent {agent_id!r}")
+    action = request.query.get("action", "start")
+    if action not in ("start", "cancel"):
+        return web.Response(status=400, text="action must be start|cancel")
+    starting = action == "start"
+    if starting and not rec.draining:
+        app["stats"].count("fleet_drains")
+    rec.draining = starting
+    if starting:
+        rec.state = "DRAINING" if rec.state != "DEAD" else rec.state
+        # recyclable only on POLLED evidence: live_sessions defaults to 0
+        # before the first successful /health read, and recycling a box
+        # on that default would hard-drop every session it is serving
+        rec.recyclable = rec.recyclable or (
+            rec.last_ok is not None and rec.live_sessions == 0
+        )
+    else:
+        rec.recyclable = False
+        if rec.state == "DRAINING":
+            rec.state = "HEALTHY"  # next poll re-evaluates
+    agent_ack = False
+    try:
+        async with app["http"].post(
+            rec.base_url + "/drain",
+            json={"action": "freeze" if starting else "unfreeze"},
+        ) as resp:
+            agent_ack = resp.status == 200
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        logger.warning("drain call to %s failed: %s", agent_id, e)
+    return web.json_response({
+        "agent": agent_id,
+        "draining": rec.draining,
+        "recyclable": rec.recyclable,
+        "live_sessions": rec.live_sessions,
+        "agent_ack": agent_ack,
+    })
+
+
+async def fleet_health(request):
+    """Per-agent membership view (JSON only — agent identity never
+    becomes a /metrics label)."""
+    reg: FleetRegistry = request.app["fleet"]
+    agents = {aid: rec.snapshot() for aid, rec in reg.agents.items()}
+    worst = "HEALTHY"
+    order = {"HEALTHY": 0, "DEGRADED": 1, "DRAINING": 2, "DEAD": 3}
+    for rec in agents.values():
+        if order.get(rec["state"], 0) > order[worst]:
+            worst = rec["state"]
+    return web.json_response({
+        "status": worst,
+        "agents": agents,
+        "sessions_tracked": len(request.app["session_table"]),
+    })
+
+
+async def health(_):
+    return web.Response(content_type="application/json", text="OK")
+
+
+async def metrics(request):
+    """Fleet rollup: counters from the router's FrameStats plus the
+    registry's aggregate gauges.  Aggregated across agents by
+    construction — nothing here is keyed by agent or session identity
+    (?format=prom renders the same flat dict through obs/promexport)."""
+    app = request.app
+    out = app["stats"].snapshot()
+    out.update(app["fleet"].snapshot())
+    out["fleet_sessions_tracked"] = len(app["session_table"])
+    out["fleet_session_table_evicted"] = app["session_table"].evicted
+    fmt = request.query.get("format", "json")
+    if fmt == "prom":
+        from ..obs.promexport import CONTENT_TYPE, render
+
+        return web.Response(
+            body=render(out).encode("utf-8"),
+            headers={"Content-Type": CONTENT_TYPE},
+        )
+    if fmt != "json":
+        return web.Response(status=400, text=f"unknown format {fmt!r}")
+    return web.json_response(out)
+
+
+# ---------------------------------------------------------------------------
+# app assembly
+# ---------------------------------------------------------------------------
+
+def _on_agent_dead(app):
+    """Crash replacement: re-point every client the router placed on the
+    dead agent through the existing webhook path — the StreamDegraded
+    event (state=AGENT_DEAD) tells the client to re-offer; placement
+    lands it on a replacement and the PLI re-sync machinery re-primes."""
+
+    def on_dead(rec):
+        handler: StreamEventHandler = app["fleet_events"]
+        stats: FrameStats = app["stats"]
+        for sid, entry in app["session_table"].pop_agent_sessions(
+            rec.agent_id
+        ):
+            stats.count("fleet_sessions_repointed")
+            handler.handle_session_state(
+                sid, entry.get("room_id", ""), "AGENT_DEAD",
+                f"agent {rec.agent_id} is unreachable — re-offer through "
+                f"the router to land on a replacement",
+            )
+
+    return on_dead
+
+
+async def _on_startup(app):
+    import aiohttp
+
+    app["http"] = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=app["proxy_timeout_s"])
+    )
+    if app["poll"]:
+        app["poller"] = FleetPoller(app["fleet"])
+        await app["poller"].start()
+
+
+async def _on_cleanup(app):
+    poller = app.get("poller")
+    if poller is not None:
+        await poller.stop()
+    http = app.get("http")
+    if http is not None:
+        await http.close()
+
+
+def build_router_app(
+    *,
+    registry: FleetRegistry | None = None,
+    events_handler: StreamEventHandler | None = None,
+    poll: bool = True,
+) -> web.Application:
+    app = web.Application()
+    app["stats"] = FrameStats()
+    app["poll"] = poll
+    app["retry_after_s"] = env.get_float("FLEET_RETRY_AFTER_S", 2.0)
+    app["place_attempts"] = max(1, env.get_int("FLEET_PLACE_ATTEMPTS", 3))
+    app["proxy_timeout_s"] = env.get_float("FLEET_PROXY_TIMEOUT_S", 30.0)
+    app["session_table"] = _SessionTable(
+        env.get_int("FLEET_SESSION_TABLE", 4096)
+    )
+    app["fleet"] = registry if registry is not None else FleetRegistry(
+        stats=app["stats"]
+    )
+    if app["fleet"].stats is None:
+        app["fleet"].stats = app["stats"]
+    app["fleet_events"] = events_handler or StreamEventHandler()
+    app["fleet"].on_dead = _on_agent_dead(app)
+
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+
+    app.router.add_post("/offer", offer)
+    app.router.add_post("/whip", whip)
+    app.router.add_delete("/whip/{session}", whip)
+    app.router.add_post("/whep", whep)
+    app.router.add_delete("/whep/{session}", whep)
+    app.router.add_post("/fleet/register", fleet_register)
+    app.router.add_post("/fleet/events", fleet_events)
+    app.router.add_post("/fleet/drain", fleet_drain)
+    app.router.add_get("/fleet/health", fleet_health)
+    app.router.add_get("/", health)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="Run the fleet router")
+    parser.add_argument("--port", default=8800, type=int,
+                        help="HTTP front-door port")
+    parser.add_argument(
+        "--log-level", default="INFO",
+        choices=["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"],
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper())
+    web.run_app(build_router_app(), host="0.0.0.0", port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,17 @@ _HELP = {
     ),
     "slo_stage_budget_ms": "per-stage latency budget (SLO_<STAGE>_BUDGET_MS)",
     "slo_stage_over_budget_total": "observations past the stage budget",
+    # fleet rollup (fleet/router.py): aggregated across agents by
+    # construction — per-agent detail is /fleet/health, JSON only
+    "fleet_sessions": "live sessions across the fleet (summed per-agent /health)",
+    "fleet_capacity_free": (
+        "remaining admission capacity summed over bounded, unsaturated agents"
+    ),
+    "fleet_placements_total": "sessions placed by the fleet router",
+    "fleet_drains_total": "agent drains initiated via POST /fleet/drain",
+    "fleet_sessions_repointed_total": (
+        "clients re-pointed off DEAD agents through AGENT_DEAD webhooks"
+    ),
 }
 
 

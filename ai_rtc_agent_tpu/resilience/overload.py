@@ -616,6 +616,10 @@ class OverloadControlPlane:
         # constant, independent of session count or queue depth)
         self._fresh: collections.deque = collections.deque(maxlen=512)
         self._task = None
+        # drain-for-recycle (fleet tier, ISSUE 11): one counted freeze
+        # hold owned by the drain surface — admission refuses, live
+        # sessions finish untouched, /capacity says saturated+draining
+        self._draining = False
 
     # -- session / queue registry --------------------------------------------
 
@@ -758,12 +762,44 @@ class OverloadControlPlane:
     def capacity(self, free_slots: int | None = None) -> dict:
         """/capacity body: admission view of remaining headroom, with
         pending reservations counted as live so a burst of in-flight
-        offers is not double-sold to orchestrators."""
+        offers is not double-sold to orchestrators.  ``draining`` tells
+        the fleet router this box is being recycled on purpose — the
+        freeze hold already zeroes capacity and flips ``saturated``."""
         self._expire_pending()
-        return self.admission.capacity(
+        out = self.admission.capacity(
             live_sessions=len(self.ladders) + len(self._pending),
             free_slots=free_slots,
         )
+        out["draining"] = self._draining
+        return out
+
+    # -- drain-for-recycle (fleet control plane, POST /drain) -----------------
+
+    def begin_drain(self) -> bool:
+        """Stop admitting via the admission-freeze rung so live sessions
+        can finish and an orchestrator can recycle the process.  Counted
+        (one hold per plane, idempotent) so a drain composes with
+        ladders at the frozen rung.  -> True when state changed."""
+        if self._draining:
+            return False
+        self._draining = True
+        self.admission.hold_freeze()
+        logger.warning("admission drain engaged (freeze hold)")
+        return True
+
+    def end_drain(self) -> bool:
+        """Cancel a drain: release the freeze hold; admission resumes
+        under the normal pressure signals.  -> True when state changed."""
+        if not self._draining:
+            return False
+        self._draining = False
+        self.admission.release_freeze()
+        logger.warning("admission drain released")
+        return True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # -- cadence ---------------------------------------------------------------
 
@@ -803,6 +839,7 @@ class OverloadControlPlane:
 
     def stop(self):
         self.lag.stop()
+        self.end_drain()  # release the drain's freeze hold on teardown
         if self._task is not None:
             self._task.cancel()
             self._task = None
@@ -822,6 +859,7 @@ class OverloadControlPlane:
             ),
             "overload_loop_lag_ms": round(1e3 * self.admission.lag_ewma.value, 3),
             "overload_admission_frozen": int(self.admission.frozen),
+            "overload_draining": int(self._draining),
             "overload_sessions": len(self.ladders),
             "overload_admission_pending": len(self._pending),
             "overload_rung_max": max(

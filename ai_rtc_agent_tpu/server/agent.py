@@ -494,6 +494,11 @@ async def offer(request):
         text=json.dumps(
             {"sdp": pc.localDescription.sdp, "type": pc.localDescription.type}
         ),
+        # the session's server-side identity: the fleet router maps the
+        # session to this agent with it (WHIP/WHEP get the same from
+        # their Location headers) so DELETEs route back and a crash can
+        # re-point exactly the affected clients
+        headers={"X-Stream-Id": stream_id},
     )
 
 
@@ -844,6 +849,7 @@ async def health_detail(request):
         body["overload"] = {
             "pressure": round(ov.admission.pressure(), 4),
             "frozen": ov.admission.frozen,
+            "draining": ov.draining,
         }
     if devtel_plane is not None:
         body["devtel"] = devtel_plane.health()
@@ -876,6 +882,35 @@ async def capacity(request):
     # plane-level view: counts live ladders PLUS in-flight admission
     # reservations, so a burst of half-set-up offers is not double-sold
     return web.json_response(ov.capacity(free_slots=free))
+
+
+async def drain(request):
+    """Drain-for-recycle (fleet tier, docs/fleet.md): ``{"action":
+    "freeze"}`` engages the overload plane's admission-freeze rung — new
+    sessions 503, live sessions finish untouched, /capacity advertises
+    ``draining`` so the fleet router stops routing here; ``unfreeze``
+    reverts.  409 without the overload plane: there is no freeze rung to
+    drain with (OVERLOAD_CONTROL=0)."""
+    ov = request.app.get("overload")
+    if ov is None:
+        return web.Response(
+            status=409,
+            text="overload control disabled — no admission-freeze rung "
+                 "to drain with",
+        )
+    try:
+        body = await request.json()
+    except (ValueError, LookupError):
+        return web.Response(status=400, text="invalid JSON body")
+    action = body.get("action") if isinstance(body, dict) else None
+    if action not in ("freeze", "unfreeze"):
+        return web.Response(status=400, text="action must be freeze|unfreeze")
+    changed = ov.begin_drain() if action == "freeze" else ov.end_drain()
+    return web.json_response({
+        "draining": ov.draining,
+        "changed": changed,
+        "live_sessions": len(request.app.get("supervisors", {})),
+    })
 
 
 async def debug_flight(request):
@@ -1462,6 +1497,7 @@ def build_app(
     app.router.add_get("/", health)
     app.router.add_get("/health", health_detail)
     app.router.add_get("/capacity", capacity)
+    app.router.add_post("/drain", drain)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/debug/trace", debug_trace)

@@ -63,9 +63,16 @@ class StreamRecoveredEvent(WebhookEvent):
 
 
 class StreamEventHandler:
-    def __init__(self, session_factory=None):
-        self.webhook_url = env.get_str("WEBHOOK_URL")
-        self.token = env.get_str("AUTH_TOKEN")
+    def __init__(self, session_factory=None, webhook_url=None, token=None):
+        # explicit ctor values override the env config: the fleet router
+        # (fleet/router.py) runs its own handler pointed at the CLIENT
+        # notification endpoint (AGENT_DEAD re-points ride the same
+        # StreamDegraded schema) while agents keep posting theirs at the
+        # router's ingest — two webhook planes, one event vocabulary
+        self.webhook_url = (
+            env.get_str("WEBHOOK_URL") if webhook_url is None else webhook_url
+        )
+        self.token = env.get_str("AUTH_TOKEN") if token is None else token
         self._session_factory = session_factory
         self._tasks: set = set()
         # flight-recorder hook (obs/recorder.py): callable(event_name,

@@ -14,7 +14,9 @@ Run next to the agent (the reference starts both from runpod/start.sh):
     python -m ai_rtc_agent_tpu.server.worker --agent-port 8888
 
 Env: WORKER_ID, PUBLIC_IP, PUBLIC_PORT, WORKER_PUBLISH_URL, AUTH_TOKEN,
-AGENT_TIMEOUT (keep-alive seconds, default 600 like the reference).
+AGENT_TIMEOUT (keep-alive seconds, default 600 like the reference),
+WORKER_REPUBLISH_S (capacity re-check cadence during the lease; a change
+is republished so the fleet router never routes on a stale number).
 """
 
 from __future__ import annotations
@@ -144,9 +146,26 @@ def fetch_capacity(url: str) -> dict | None:
         return None
 
 
-def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
+def handler(
+    agent_port: int,
+    publish=default_publish,
+    sleep=time.sleep,
+    clock=time.monotonic,
+) -> int:
     """One worker job: await agent, publish identity + capacity, hold the
-    lease.
+    lease — republishing whenever the advertised capacity CHANGES.
+
+    The original shape fetched /capacity exactly once and then slept the
+    whole AGENT_TIMEOUT: a box that filled up kept advertising its
+    stale, empty-looking capacity for up to 600s, and the fleet router
+    kept routing at it.  Now the lease hold is a loop on a bounded
+    ``WORKER_REPUBLISH_S`` cadence: re-fetch /capacity, and when the
+    (capacity, saturated) pair moved, publish the update — through the
+    same :func:`default_publish`, so transient failures ride the shared
+    RetryPolicy and a permanent 4xx stays terminal per attempt (the
+    lease itself is never abandoned over a failed republish; the next
+    change tries again).  ``WORKER_REPUBLISH_S<=0`` restores the single
+    sleep.
 
     Returns 0 on success, 1 if the agent never became healthy, 2 if the
     connection info could not be published (a worker nobody can reach is
@@ -154,13 +173,14 @@ def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
     burning the whole lease invisible)."""
     if not check_server(f"http://127.0.0.1:{agent_port}/", HEALTH_BUDGET_S):
         return 1
+    cap_url = f"http://127.0.0.1:{agent_port}/capacity"
     info = {
         "worker_id": env.get_str("WORKER_ID", os.uname().nodename),
         "public_ip": env.get_str("PUBLIC_IP", ""),
         "public_port": env.get_str("PUBLIC_PORT", str(agent_port)),
         "status": "ready",
     }
-    cap = fetch_capacity(f"http://127.0.0.1:{agent_port}/capacity")
+    cap = fetch_capacity(cap_url)
     if cap is not None and "capacity" in cap:
         # remaining capacity, not a boolean: -1 = no structural bound
         info["capacity"] = cap.get("capacity")
@@ -169,8 +189,30 @@ def handler(agent_port: int, publish=default_publish, sleep=time.sleep) -> int:
     if ok is False:  # None (no return value) counts as success
         return 2
     keep_alive = env.get_int("AGENT_TIMEOUT", 600)
+    republish_s = env.get_float("WORKER_REPUBLISH_S", 5.0)
     logger.info("holding worker lease for %ds", keep_alive)
-    sleep(keep_alive)
+    if republish_s <= 0:
+        sleep(keep_alive)
+        return 0
+    t_end = clock() + keep_alive
+    last = (info.get("capacity"), info.get("saturated"))
+    while True:
+        remaining = t_end - clock()
+        if remaining <= 0:
+            break
+        sleep(min(republish_s, remaining))
+        if clock() >= t_end:
+            break
+        cap = fetch_capacity(cap_url)
+        if cap is None or "capacity" not in cap:
+            continue  # agent drowning or endpoint-less: keep the lease
+        cur = (cap.get("capacity"), bool(cap.get("saturated", False)))
+        if cur == last:
+            continue
+        update = dict(info)
+        update["capacity"], update["saturated"] = cur
+        if publish(update) is not False:
+            last = cur  # a failed republish retries on the next change
     return 0
 
 

@@ -1,0 +1,181 @@
+"""Fleet router placement overhead: /offer p50 through the router vs
+direct-to-agent.
+
+The fleet tier (ai_rtc_agent_tpu/fleet/) puts one HTTP hop + a placement
+decision in front of every session-creating request.  That hop is paid
+once per SESSION (signaling only — media never crosses the router), so
+the budget is generous, but it must stay boring: a regression that makes
+placement scan agents pathologically or copy bodies repeatedly shows up
+here long before it shows up at fleet scale.
+
+Two legs against ONE real agent app (fake pipeline, loopback provider,
+offers without media tracks so no session machinery accumulates):
+
+  direct:  POST /offer straight at the agent
+  routed:  the same POST through the fleet router (registry of 1, live
+           poll loop running — the steady-state serving shape)
+
+Reports the added p50 milliseconds (paired, alternating legs — this
+box's throttle variance demands it) as ``fleet_router_offer_overhead_ms``
+(lower is better; perf_compare ships a tolerance for it).
+
+Prints ONE JSON line (bank-and-commit contract) and appends it to
+PERF_LOG.jsonl (PERF_LOG_PATH overrides; empty value disables).
+
+Env knobs: FLEET_BENCH_OFFERS (default 60 per leg).
+
+Pure-host bench: jax is never imported (fingerprint says "unprobed") —
+the router is host machinery, and paying a backend init here would cost
+more than the measurement.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# host-only planes: the device/obs tiers are not under test and devtel
+# would drag in jax
+os.environ.setdefault("DEVTEL_ENABLE", "0")
+os.environ.setdefault("SLO_ENABLE", "0")
+os.environ.setdefault("FLIGHT_RECORDER", "0")
+os.environ.setdefault("BATCHSCHED", "0")
+
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+
+OFFERS = int(os.getenv("FLEET_BENCH_OFFERS") or 60)
+
+
+async def measure() -> dict:
+    import aiohttp
+    from aiohttp import web
+
+    from ai_rtc_agent_tpu.fleet.registry import FleetRegistry
+    from ai_rtc_agent_tpu.fleet.router import build_router_app
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.signaling import (
+        LoopbackProvider,
+        make_loopback_offer,
+    )
+
+    class _Pipe:
+        def __call__(self, frame):
+            return frame
+
+        def update_prompt(self, p):
+            pass
+
+        def update_t_index_list(self, t):
+            pass
+
+    async def _serve(app):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner, site._server.sockets[0].getsockname()[1]
+
+    agent_app = build_app(pipeline=_Pipe(), provider=LoopbackProvider())
+    agent_runner, agent_port = await _serve(agent_app)
+    registry = FleetRegistry()
+    registry.register({
+        "worker_id": "bench-agent", "public_ip": "127.0.0.1",
+        "public_port": str(agent_port), "status": "ready",
+    })
+    router_app = build_router_app(registry=registry, poll=True)
+    router_runner, router_port = await _serve(router_app)
+
+    # media-less offer: signaling cost only, no per-session machinery
+    # accumulating across reps
+    payload = {
+        "room_id": "bench",
+        "offer": {
+            "sdp": make_loopback_offer(video=False, datachannel=False),
+            "type": "offer",
+        },
+    }
+    direct_url = f"http://127.0.0.1:{agent_port}/offer"
+    routed_url = f"http://127.0.0.1:{router_port}/offer"
+
+    async with aiohttp.ClientSession() as client:
+
+        async def one(url) -> float:
+            t0 = time.perf_counter()
+            async with client.post(url, json=payload) as resp:
+                await resp.read()
+                assert resp.status == 200, resp.status
+            return time.perf_counter() - t0
+
+        # warmup both paths (connection pools, router poll state)
+        for url in (direct_url, routed_url):
+            for _ in range(5):
+                await one(url)
+        direct, routed = [], []
+        for i in range(OFFERS):
+            # alternate leg order per pair: adjacent measurements see the
+            # same box state, so the p50 DELTA survives throttle swings
+            if i % 2 == 0:
+                direct.append(await one(direct_url))
+                routed.append(await one(routed_url))
+            else:
+                routed.append(await one(routed_url))
+                direct.append(await one(direct_url))
+
+    await router_runner.cleanup()
+    await agent_runner.cleanup()
+
+    direct.sort()
+    routed.sort()
+    p50_direct = direct[len(direct) // 2]
+    p50_routed = routed[len(routed) // 2]
+    overhead_ms = 1e3 * (p50_routed - p50_direct)
+    return {
+        "check": "fleet_bench",
+        "offers": OFFERS,
+        "direct_p50_ms": round(1e3 * p50_direct, 3),
+        "routed_p50_ms": round(1e3 * p50_routed, 3),
+        # the contract quartet; floored just above zero — a negative
+        # delta is measurement noise, and perf_compare treats value 0.0
+        # as a failed run
+        "metric": "fleet_router_offer_overhead_ms",
+        "value": round(max(overhead_ms, 0.01), 3),
+        "unit": "ms",
+        "vs_baseline": round(max(overhead_ms, 0.01), 3),
+        "backend": "host",  # no jax in this process, by design
+        "live": True,
+        "label": f"fleet_router_{OFFERS}o",
+        "recorded_at": datetime.now(timezone.utc).isoformat(),
+        "fingerprint": fingerprint(probe_jax=False),
+    }
+
+
+from ai_rtc_agent_tpu.utils.perfbank import bank as _bank  # noqa: E402
+
+
+def main():
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("fleet_bench timeout")
+    entry = {
+        "check": "fleet_bench",
+        "metric": "fleet_router_offer_overhead_ms",
+        "value": 0.0,
+        "unit": "ms",
+        "vs_baseline": 0.0,
+    }
+    try:
+        entry = asyncio.run(measure())
+        _bank(entry)
+    except BaseException as e:  # the contract line must survive any exit
+        entry["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(entry))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

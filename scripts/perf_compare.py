@@ -74,6 +74,11 @@ DEFAULT_METRIC_TOLERANCES = {
     # ~30µs host kernel — the fence catches allocation/locking landing
     # back on the DEVTEL_ENABLE=0 path, sized for CI throttle noise
     "devtel_off_overhead_ratio": 0.35,
+    # fleet router hop (ISSUE 11): added /offer p50 vs direct-to-agent —
+    # a ~1ms absolute number on a contended box, so the fence is wide;
+    # what it catches is the hop going pathological (per-request agent
+    # scans, body re-copies), which reads as multiples, not percents
+    "fleet_router_offer_overhead_ms": 1.0,
 }
 
 

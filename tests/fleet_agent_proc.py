@@ -1,0 +1,102 @@
+"""Real agent process for the hermetic fleet acceptance test.
+
+Runs the REAL serving agent app (server/agent.py: admission gate,
+overload plane, /capacity, /health, /drain, webhooks) on a loopback
+port, with only the model swapped for a fake pipeline and media for the
+loopback provider — the fleet tier under test never touches pixels or
+devices, so this is exactly the surface it routes against.
+
+Adds a test-only drive surface the parent test uses to move media:
+
+  POST /_test/pump  {"frames": N}   push N frames into every connected
+                                    session's inbound track and pull N
+                                    processed frames out; returns
+                                    {"sessions": {pc_id: delivered}}
+  POST /_test/close                 close every peer connection (clients
+                                    hanging up — ends the sessions)
+
+Prints one JSON line {"port": <bound port>} on stdout once serving.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+from aiohttp import web
+
+from ai_rtc_agent_tpu.server.agent import build_app
+from ai_rtc_agent_tpu.server.signaling import LoopbackProvider
+
+
+class FakePipeline:
+    """Invert colors; carries the control-plane surface sessions use."""
+
+    def __call__(self, frame):
+        arr = frame if isinstance(frame, np.ndarray) else frame.to_ndarray()
+        return 255 - arr
+
+    def update_prompt(self, p):
+        pass
+
+    def update_t_index_list(self, t):
+        pass
+
+
+async def _pump(request):
+    try:
+        body = await request.json()
+    except ValueError:
+        return web.Response(status=400, text="invalid JSON")
+    n = int(body.get("frames", 10))
+    out = {}
+    for pc in list(request.app["pcs"]):
+        if (
+            pc.connectionState != "connected"
+            or pc.in_track is None
+            or not pc.out_tracks
+        ):
+            continue
+        delivered = 0
+        for i in range(n):
+            frame = np.full((8, 8, 3), (i * 7) % 256, dtype=np.uint8)
+            await pc.in_track.push(frame)
+            got = await asyncio.wait_for(pc.out_tracks[0].recv(), timeout=10)
+            if got is not None:
+                delivered += 1
+        out[pc.pc_id] = delivered
+    return web.json_response({"sessions": out})
+
+
+async def _close_all(request):
+    pcs = list(request.app["pcs"])
+    for pc in pcs:
+        await pc.close()
+    return web.json_response({"closed": len(pcs)})
+
+
+async def main(port: int) -> None:
+    app = build_app(pipeline=FakePipeline(), provider=LoopbackProvider())
+    app.router.add_post("/_test/pump", _pump)
+    app.router.add_post("/_test/close", _close_all)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    print(json.dumps({"port": bound}), flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args()
+    try:
+        asyncio.run(main(args.port))
+    except KeyboardInterrupt:
+        sys.exit(0)

@@ -924,3 +924,77 @@ def test_unet_cache_env_labels_contract_line(monkeypatch):
     )
     assert r.returncode == 0, r.stderr[-400:]
     assert _contract_line(r.stdout)["unet_cache"] == 3
+
+
+# -- scripts/fleet_bench.py: the fleet router hop (ISSUE 11) -----------------
+
+def test_fleet_bench_contract(tmp_path):
+    """Fleet-router placement-overhead microbench smoke (ISSUE 11): pure
+    host (never imports jax), emits exactly one contract line, BANKS it,
+    and the added /offer p50 stays in single-digit-milliseconds territory
+    even on a contended CI box.  The committed PERF_LOG line carries the
+    real number (~1.3ms on this box); what this fence catches is the hop
+    going pathological (tens of ms = per-request scans or body churn)."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update({
+        "PERF_LOG_PATH": str(log),
+        "FLEET_BENCH_OFFERS": "20",
+    })
+    r = subprocess.run(
+        [sys.executable, "scripts/fleet_bench.py"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "fleet_router_offer_overhead_ms"
+    assert d["offers"] == 20
+    # pure-host bench: the fingerprint must say jax never entered
+    assert d["fingerprint"]["jax_backend"] == "unprobed"
+    assert 0 < d["value"] < 50.0, d
+    assert d["routed_p50_ms"] > 0 and d["direct_p50_ms"] > 0
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "fleet_router_offer_overhead_ms"
+
+
+def test_perf_compare_knows_fleet_leg(tmp_path, capsys):
+    """ISSUE 11 satellite: the fleet router hop ships with a built-in
+    lower-is-better fence (1.0 = up to 2x the banked ms) — a fresh run
+    past it fails with no --tolerance-metric flags."""
+    main = _perf_compare_main()
+
+    def _perf_compare(args):
+        class R:
+            pass
+
+        r = R()
+        r.returncode = main(args)
+        r.stdout = capsys.readouterr().out
+        r.stderr = ""
+        return r
+
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "fleet_router_offer_overhead_ms", "value": 1.3,
+         "unit": "ms", "backend": "host", "live": True,
+         "label": "fleet_router_60o"},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "fleet_router_offer_overhead_ms", "value": 2.5,
+         "unit": "ms", "backend": "host", "label": "fleet_router_60o"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    _write_jsonl(fresh, [
+        {"metric": "fleet_router_offer_overhead_ms", "value": 2.7,
+         "unit": "ms", "backend": "host", "label": "fleet_router_60o"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout

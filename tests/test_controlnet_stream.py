@@ -47,6 +47,10 @@ def test_canny_soft_shape_and_range():
     np.testing.assert_array_equal(np.asarray(edge[..., 0]), np.asarray(edge[..., 1]))
 
 
+@pytest.mark.slow  # builds TWO engines (~17s); the zero-conv plumbing
+# stays tier-1 via test_apply_controlnet_residual_shapes_match_unet_skips
+# and test_nonzero_controlnet_changes_output_and_scale_swaps (ISSUE 11
+# shave)
 def test_untrained_controlnet_is_noop():
     """Zero convs make an untrained ControlNet an exact no-op on the UNet."""
     rng = np.random.default_rng(1)

@@ -179,6 +179,9 @@ def test_control_plane_updates_force_recapture(monkeypatch):
     assert eng._tick == 0
 
 
+@pytest.mark.slow  # fbs x deepcache composition compile (~10s); the
+# cadence itself stays tier-1 via test_engine_cadence_and_flops and the
+# fbs step shape via test_stream's frame-batching tests (ISSUE 11 shave)
 def test_cadence_with_frame_batching():
     """fbs>1: the cache rides the batched step (slots = n_stages*fbs) —
     shapes line up and the cadence alternates per step (not per frame)."""
@@ -313,6 +316,10 @@ def test_multipeer_buckets_compose_with_deepcache(monkeypatch):
         )
 
 
+@pytest.mark.slow  # two sharded-mesh x deepcache composition compiles
+# (~28s); each side keeps a lighter tier-1 sibling — cadence via
+# test_engine_cadence_and_flops, tp/sp serving via test_parallel /
+# test_stream (ISSUE 11 shave)
 @pytest.mark.parametrize("kind,mesh_kw", [("tp", {"tp": 2}), ("sp", {"sp": 2})])
 def test_cache_composes_with_sharded_serving(kind, mesh_kw):
     """UNET_CACHE under --tp/--sp: both cadence variants compile and run

@@ -1,0 +1,276 @@
+"""Hermetic 3-process fleet acceptance (ISSUE 11).
+
+Three REAL agent processes (tests/fleet_agent_proc.py — the full serving
+agent with admission/overload/capacity/drain surfaces, fake pipeline,
+loopback media) on loopback ports behind an in-process fleet router:
+
+1. placement by capacity — three offers spread one per agent
+   (least-loaded against each agent's own /capacity feed);
+2. drain-to-zero — one agent drains via the admission-freeze rung while
+   the OTHERS keep delivering every pumped frame, and flips
+   ``recyclable`` once its sessions close;
+3. crash replacement — a SIGKILLed agent is declared DEAD by the poll
+   loop, its client is re-pointed through the webhook path
+   (StreamDegraded state=AGENT_DEAD), and the re-offer lands and
+   streams on a surviving agent.
+
+One test function: the 3 process spawns (~a second each, concurrent)
+are paid once for all three acceptance legs.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_rtc_agent_tpu.fleet.registry import FleetRegistry
+from ai_rtc_agent_tpu.fleet.router import build_router_app
+from ai_rtc_agent_tpu.server.events import StreamEventHandler
+from ai_rtc_agent_tpu.server.signaling import make_loopback_offer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROC = os.path.join(REPO, "tests", "fleet_agent_proc.py")
+
+AGENT_ENV = {
+    # small + deterministic: 2 sessions per agent, no device planes, no
+    # warmup drops (pushed == delivered must hold exactly)
+    "OVERLOAD_MAX_SESSIONS": "2",
+    "WARMUP_FRAMES": "0",
+    "DROP_FRAMES": "0",
+    "PIPELINE_DEPTH": "1",
+    "DEVTEL_ENABLE": "0",
+    "SLO_ENABLE": "0",
+    "FLIGHT_RECORDER": "0",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+def _spawn_agents(n):
+    procs = []
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update(AGENT_ENV)
+    for _ in range(n):
+        procs.append(subprocess.Popen(
+            [sys.executable, PROC, "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO, env=env,
+        ))
+    ports = []
+    deadline = time.monotonic() + 60
+    for p in procs:
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"agent proc died at startup: {p.stderr.read()[-2000:]}"
+            )
+        ports.append(int(json.loads(line)["port"]))
+        assert time.monotonic() < deadline, "agent spawn exceeded budget"
+    return procs, ports
+
+
+def _kill(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        p.stdout.close()
+        p.stderr.close()
+
+
+_OFFER = {
+    "room_id": "fleet-room",
+    "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+}
+
+
+async def _wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        r = await predicate()
+        if r:
+            return r
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        await asyncio.sleep(0.1)
+
+
+def test_three_process_fleet(monkeypatch):
+    monkeypatch.setenv("FLEET_POLL_S", "0.15")
+    monkeypatch.setenv("FLEET_POLL_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("FLEET_DEAD_AFTER", "2")
+    procs, ports = _spawn_agents(3)
+    names = [f"agent{i}" for i in range(3)]
+    by_name = dict(zip(names, zip(procs, ports)))
+    posted = []
+
+    class FakeResp:
+        status = 200
+
+    class FakeSession:
+        async def post(self, url, headers=None, json=None):
+            posted.append(json)
+            return FakeResp()
+
+    async def go():
+        import aiohttp
+
+        events = StreamEventHandler(
+            session_factory=FakeSession,
+            webhook_url="http://client-notify.example/hook", token="t",
+        )
+        reg = FleetRegistry(dead_after=2)
+        app = build_router_app(registry=reg, events_handler=events,
+                               poll=True)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=15)
+        )
+
+        async def agent_get(port, path):
+            async with http.get(f"http://127.0.0.1:{port}{path}") as r:
+                return await r.json()
+
+        async def agent_post(port, path, body):
+            async with http.post(
+                f"http://127.0.0.1:{port}{path}", json=body
+            ) as r:
+                return await r.json()
+
+        try:
+            for name, (_p, port) in by_name.items():
+                r = await client.post("/fleet/register", json={
+                    "worker_id": name, "public_ip": "127.0.0.1",
+                    "public_port": str(port), "status": "ready",
+                    "capacity": 2,
+                })
+                assert r.status == 200
+
+            # let one poll round refresh from the agents' real /capacity
+            async def first_poll():
+                return all(
+                    rec.last_ok is not None for rec in reg.agents.values()
+                )
+
+            await _wait_for(first_poll, 10, "first poll round")
+
+            # -- leg 1: placement by capacity spreads one per agent -----
+            sids = []
+            for _ in range(3):
+                r = await client.post("/offer", json=_OFFER)
+                assert r.status == 200, await r.text()
+                sids.append(r.headers["X-Stream-Id"])
+            owners = {sid: app["session_table"].owner(sid) for sid in sids}
+            assert sorted(owners.values()) == sorted(names), owners
+            for name in names:
+                h = await agent_get(by_name[name][1], "/health")
+                assert len(h["sessions"]) == 1, (name, h)
+
+            # every session streams: pushed == delivered, no drops
+            for name in names:
+                pumped = await agent_post(
+                    by_name[name][1], "/_test/pump", {"frames": 15}
+                )
+                assert list(pumped["sessions"].values()) == [15], pumped
+
+            # -- leg 2: drain one agent to zero without touching others -
+            drain_name = owners[sids[1]]
+            keep = [n for n in names if n != drain_name]
+            r = await client.post(f"/fleet/drain?agent={drain_name}")
+            body = await r.json()
+            assert body["draining"] and body["agent_ack"], body
+            cap = await agent_get(by_name[drain_name][1], "/capacity")
+            assert cap["draining"] and cap["saturated"]
+            # a new session never lands on the draining agent
+            r = await client.post("/offer", json=_OFFER)
+            assert r.status == 200
+            extra_owner = app["session_table"].owner(
+                r.headers["X-Stream-Id"]
+            )
+            assert extra_owner in keep
+            # the OTHERS keep delivering every frame mid-drain
+            for name in keep:
+                pumped = await agent_post(
+                    by_name[name][1], "/_test/pump", {"frames": 20}
+                )
+                total = sum(pumped["sessions"].values())
+                expect = 20 * len(pumped["sessions"])
+                assert total == expect, (name, pumped)
+            # clients hang up on the draining agent -> recyclable
+            await agent_post(by_name[drain_name][1], "/_test/close", {})
+
+            async def drained():
+                h = await (await client.get("/fleet/health")).json()
+                a = h["agents"][drain_name]
+                return a["state"] == "DRAINING" and a["recyclable"]
+
+            await _wait_for(drained, 15, "drain to zero")
+
+            # -- leg 3: crash replacement ------------------------------
+            crash_name = extra_owner  # owns sessions; NOT the drained box
+            crash_sids = [
+                sid for sid, e in list(app["session_table"]._m.items())
+                if e["agent"] == crash_name
+            ]
+            assert crash_sids
+            by_name[crash_name][0].kill()
+
+            async def dead():
+                h = await (await client.get("/fleet/health")).json()
+                return h["agents"][crash_name]["state"] == "DEAD"
+
+            await _wait_for(dead, 20, "death detection")
+
+            async def repointed():
+                evs = [
+                    ev for ev in posted if ev.get("state") == "AGENT_DEAD"
+                ]
+                got = {ev["stream_id"] for ev in evs}
+                return evs if got == set(crash_sids) else None
+
+            events_seen = await _wait_for(repointed, 10, "AGENT_DEAD webhooks")
+            assert all(
+                ev["event"] == "StreamDegraded" for ev in events_seen
+            )
+
+            # the re-pointed client re-offers through the router and
+            # lands on the ONE agent still taking sessions...
+            survivor = [n for n in keep if n != crash_name][0]
+            r = await client.post("/offer", json=_OFFER)
+            assert r.status == 200, await r.text()
+            new_sid = r.headers["X-Stream-Id"]
+            assert app["session_table"].owner(new_sid) == survivor
+            # ...and the replacement session streams end to end (the
+            # agent-side PLI/keyframe machinery re-primes on connect)
+            pumped = await agent_post(
+                by_name[survivor][1], "/_test/pump", {"frames": 10}
+            )
+            assert sum(pumped["sessions"].values()) == (
+                10 * len(pumped["sessions"])
+            )
+
+            # rollup reflects the whole story
+            m = await (await client.get("/metrics")).json()
+            assert m["fleet_agents_dead"] == 1
+            assert m["fleet_agents_draining"] == 1
+            assert m["fleet_agents_died_total"] == 1
+            assert m["fleet_sessions_repointed_total"] == len(crash_sids)
+            assert m["fleet_placements_total"] == 5
+        finally:
+            await http.close()
+            await client.close()
+
+    try:
+        asyncio.run(go())
+    finally:
+        _kill(procs)

@@ -289,6 +289,9 @@ def test_multipeer_native_rtp_two_udp_clients(monkeypatch):
     run(go())
 
 
+@pytest.mark.slow  # multipeer x controlnet composition compile (~14s);
+# multipeer serving and the controlnet residual path each keep lighter
+# tier-1 siblings in this file / test_controlnet_stream (ISSUE 11 shave)
 def test_multipeer_with_controlnet(rng):
     """--multipeer + --controlnet combine (round-2 review fix: the flag was
     silently dropped): the batched engine carries the conditioned branch and

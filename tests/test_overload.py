@@ -730,8 +730,18 @@ def test_worker_publishes_capacity(monkeypatch):
     t = _threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     published = []
+    # the republish lease loop (ISSUE 11) is wall-clock driven: a fake
+    # sleep MUST advance a fake clock or the loop spins the real lease
+    now = {"t": 0.0}
+
+    def fake_sleep(s):
+        now["t"] += s
+
     try:
-        rc = worker.handler(port, publish=published.append, sleep=lambda s: None)
+        rc = worker.handler(
+            port, publish=published.append, sleep=fake_sleep,
+            clock=lambda: now["t"],
+        )
     finally:
         srv.shutdown()
     assert rc == 0

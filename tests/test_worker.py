@@ -22,11 +22,20 @@ def _serve_health(port, status=200, n_requests=10):
     return srv
 
 
-def test_handler_publishes_and_holds():
+def test_handler_publishes_and_holds(monkeypatch):
+    monkeypatch.setenv("AGENT_TIMEOUT", "10")
+    monkeypatch.setenv("WORKER_REPUBLISH_S", "5")
     srv = _serve_health(18931)
     published = []
+    t = {"now": 0.0}
+
+    def fake_sleep(s):
+        published.append(("slept", s))
+        t["now"] += s
+
     rc = worker.handler(
-        18931, publish=published.append, sleep=lambda s: published.append(("slept", s))
+        18931, publish=published.append, sleep=fake_sleep,
+        clock=lambda: t["now"],
     )
     srv.shutdown()
     assert rc == 0
@@ -34,6 +43,91 @@ def test_handler_publishes_and_holds():
     assert info["status"] == "ready"
     assert info["public_port"] == "18931"
     assert published[1][0] == "slept"
+    # the lease is held to its full AGENT_TIMEOUT across republish ticks
+    assert sum(s for tag, s in published[1:] if tag == "slept") == 10
+
+
+def test_handler_republish_legacy_single_sleep(monkeypatch):
+    """WORKER_REPUBLISH_S<=0 restores the original one-sleep lease."""
+    monkeypatch.setenv("AGENT_TIMEOUT", "600")
+    monkeypatch.setenv("WORKER_REPUBLISH_S", "0")
+    srv = _serve_health(18932)
+    slept = []
+    rc = worker.handler(
+        18932, publish=lambda i: None, sleep=slept.append,
+        clock=lambda: 0.0,
+    )
+    srv.shutdown()
+    assert rc == 0
+    assert slept == [600]
+
+
+def test_handler_republishes_on_capacity_change(monkeypatch):
+    """ISSUE 11 satellite: a box that fills up mid-lease must republish
+    its shrunken capacity instead of advertising the stale number for
+    the rest of AGENT_TIMEOUT; an unchanged capacity republishes
+    NOTHING (bounded cadence, no publish storm)."""
+    monkeypatch.setenv("AGENT_TIMEOUT", "20")
+    monkeypatch.setenv("WORKER_REPUBLISH_S", "5")
+    monkeypatch.setattr(worker, "check_server", lambda url, budget_s: True)
+    caps = [
+        {"capacity": 4, "saturated": False},   # initial publish
+        {"capacity": 4, "saturated": False},   # tick 1: unchanged
+        {"capacity": 0, "saturated": True},    # tick 2: box filled up
+        {"capacity": 0, "saturated": True},    # tick 3: unchanged again
+    ]
+    monkeypatch.setattr(worker, "fetch_capacity", lambda url: caps.pop(0))
+    published = []
+    t = {"now": 0.0}
+
+    def fake_sleep(s):
+        t["now"] += s
+
+    rc = worker.handler(
+        0, publish=published.append, sleep=fake_sleep,
+        clock=lambda: t["now"],
+    )
+    assert rc == 0
+    assert len(published) == 2
+    assert published[0]["capacity"] == 4
+    assert published[0]["saturated"] is False
+    assert published[1]["capacity"] == 0
+    assert published[1]["saturated"] is True
+    # identity fields ride every republish (the orchestrator keys on them)
+    assert published[1]["worker_id"] == published[0]["worker_id"]
+
+
+def test_handler_failed_republish_retries_on_next_tick(monkeypatch):
+    """A republish that fails terminally (publish -> False) must not
+    burn the change: the next tick sees the same delta and tries
+    again."""
+    monkeypatch.setenv("AGENT_TIMEOUT", "15")
+    monkeypatch.setenv("WORKER_REPUBLISH_S", "5")
+    monkeypatch.setattr(worker, "check_server", lambda url, budget_s: True)
+    caps = [
+        {"capacity": 4, "saturated": False},
+        {"capacity": 1, "saturated": False},  # change; publish fails
+        {"capacity": 1, "saturated": False},  # unchanged vs LAST PUBLISHED
+    ]
+    monkeypatch.setattr(worker, "fetch_capacity", lambda url: caps.pop(0))
+    calls = []
+    outcomes = iter([None, False, None])  # initial ok, republish fails, retry ok
+
+    def flaky_publish(info):
+        calls.append(info)
+        return next(outcomes)
+
+    t = {"now": 0.0}
+
+    def fake_sleep(s):
+        t["now"] += s
+
+    rc = worker.handler(
+        0, publish=flaky_publish, sleep=fake_sleep, clock=lambda: t["now"]
+    )
+    assert rc == 0
+    assert len(calls) == 3
+    assert calls[1]["capacity"] == 1 and calls[2]["capacity"] == 1
 
 
 def test_handler_fails_when_agent_down(monkeypatch):
