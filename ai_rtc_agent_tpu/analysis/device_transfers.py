@@ -25,18 +25,28 @@ sites only).  Four rules:
   tainted as a device step output: a name assigned (same function,
   statement order) from calling a step callable (``self._step`` /
   ``self._step_cached`` / a ``self._bucket_step(...)`` factory result /
-  a name bound to one) or from ``stage_frame(...)``.  Subscripts of
-  tainted names taint too — ``np.asarray(out)[i]`` and
+  a name bound to one), from ``stage_frame(...)``, or from
+  ``jax.make_array_from_single_device_arrays(...)`` (a mesh-sharded
+  global array — ``np.asarray`` of one is a CROSS-SHARD gather + host
+  drain, the sharded spelling of the same every-fetch-bills-everyone
+  bug; ISSUE 12's per-shard row readback exists so it never happens).
+  Subscripts of tainted names taint too — ``np.asarray(out)[i]`` and
   ``np.asarray(out[i])`` are the same whole-batch host copy.  Host-data
   ``np.asarray`` (the similarity filter, codec planes) is untouched:
   only device-tainted arguments fire.
 
-Blessed scopes (file → enclosing qualname): the helpers above.  Export
-and parameter-placement tiers are exempt wholesale — ``aot/cache.py``
-(serialize/deserialize), ``parallel/sharding.py`` / ``parallel/
-trainer.py`` / ``parallel/checkpoint.py`` (mesh layout + training, not
-the serving frame path) — as are ``scripts/``, ``examples/`` and
-``bench.py`` (operator tooling, the bounded-queue carve-out).
+Blessed scopes (file → enclosing qualname): the helpers above, plus the
+scheduler's sharded staging/readback sites by name (ISSUE 12 —
+``BatchScheduler._assemble_frames`` owns the per-shard D2D placement
+hops of the zero-copy global-batch assembly, ``BatchScheduler.
+_rows_from_sharded`` owns slicing each session's row from its OWN
+shard): named sites under the real rule, never a file-level exemption.
+Export and parameter-placement tiers are exempt wholesale —
+``aot/cache.py`` (serialize/deserialize), ``parallel/sharding.py`` /
+``parallel/trainer.py`` / ``parallel/checkpoint.py`` (mesh layout +
+training, not the serving frame path) — as are ``scripts/``,
+``examples/`` and ``bench.py`` (operator tooling, the bounded-queue
+carve-out).
 """
 
 from __future__ import annotations
@@ -64,6 +74,7 @@ _BLESSED = {
     },
     "ai_rtc_agent_tpu/stream/scheduler.py": {
         "BatchScheduler._step_batch_locked", "BatchScheduler._resolve_row",
+        "BatchScheduler._assemble_frames", "BatchScheduler._rows_from_sharded",
     },
     "ai_rtc_agent_tpu/parallel/multipeer.py": {
         "MultiPeerEngine.submit", "MultiPeerEngine.fetch",
@@ -75,6 +86,10 @@ _BLESSED = {
 _STEP_ATTRS = {"_step", "_step_cached", "_raw_capture_step"}
 # factories whose CALL returns a step callable: self._bucket_step(k, v)(...)
 _STEP_FACTORIES = {"_bucket_step"}
+# direct producers of device values: the blessed staging helper and the
+# zero-copy sharded-batch assembly (np.asarray of the latter is a
+# cross-shard gather drain)
+_PRODUCER_CALLS = {"stage_frame", "make_array_from_single_device_arrays"}
 
 _HOST_CAST = {
     "np.asarray", "numpy.asarray", "np.array", "numpy.array", "asarray",
@@ -134,7 +149,7 @@ class _Visitor(ScopedVisitor):
             return True
         if isinstance(f, ast.Call) and terminal_name(f.func) in _STEP_FACTORIES:
             return True
-        return terminal_name(f) == "stage_frame"
+        return terminal_name(f) in _PRODUCER_CALLS
 
     @staticmethod
     def _target_names(targets):

@@ -60,6 +60,21 @@ def engine_key(model_id: str, mode: str, **attrs) -> str:
     return "--".join(parts)
 
 
+def mesh_key_extra(mesh) -> dict:
+    """Engine-key extras for a serving mesh — THE single recipe every key
+    producer splices in (BatchScheduler.bucket_keys, prewarm labels, the
+    build CLI), mirroring :func:`~..stream.engine.params_variant_extra`:
+    empty for a trivial/absent mesh so every pre-existing single-device
+    key stays valid, and a ``dp-N`` component otherwise so a dp-sharded
+    executable can never collide with — or stand in for — the
+    single-device one (a sharded program is per-topology; adopting it on
+    the wrong mesh would fail at call time at best)."""
+    if mesh is None:
+        return {}
+    dp = mesh.shape.get("dp", 1)
+    return {"dp": dp} if dp > 1 else {}
+
+
 def _digest(key: str, args_spec: str, platform: str) -> str:
     h = hashlib.sha256(f"{key}|{args_spec}|{platform}|{jax.__version__}".encode())
     return h.hexdigest()[:16]

@@ -158,10 +158,15 @@ def build_scheduler_buckets(
             model_id, lora_dict=lora_dict, controlnet=controlnet
         )
         bundle.params = registry.cast_params(bundle.params, cfg.dtype)
+    # dp=1 explicitly: serialized executables are per-topology, so only
+    # the single-device geometries are buildable — a BATCHSCHED_DP env
+    # leaking into the build CLI must not flip the keys to the (never
+    # serialized) sharded variants; dp>1 serving relies on prewarm
     sched = BatchScheduler(
         bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
         model_id=model_id, max_sessions=sessions,
         prewarm=False, aot_build_on_miss=False, cache_dir=cache_dir,
+        dp=1,
     )
     try:
         status = sched.aot_status(model_id, cache_dir=cache_dir)

@@ -14,9 +14,12 @@ hot-path hook is one module-global read + None test, banked as
   ``jax.monitoring``'s ``backend_compile_duration`` event with its
   *phase* (``warmup`` while the process builds/prewars engines,
   ``serving`` once the agent finishes startup), duration, and the
-  engine/AOT key or bucket ``(k, variant)`` it belongs to (a
-  thread-local :func:`compile_scope` set by the compile sites: the AOT
-  cache build path, the scheduler's bucket steps, the engine step).  A
+  engine/AOT key or bucket ``(k, variant)`` it belongs to — sharded
+  scheduler geometries carry the mesh shape,
+  ``sbucket-<k>:<variant>:dp<N>``, so a serve-time reshard retrace
+  alerts with the right key (a thread-local :func:`compile_scope` set by
+  the compile sites: the AOT cache build path, the scheduler's bucket
+  steps, the engine step).  A
   compile in the serving phase that no :func:`expected_scope` blessed
   (host-side state builds do tiny eager-op compiles; operator actions
   like a prompt-encode are costs, not bugs) and that runs at least
@@ -487,16 +490,23 @@ class _Scope:
         return False
 
 
-def compile_scope(label: str, fallback_record: bool = False):
+def compile_scope(label: str, fallback_record: bool = False,
+                  expected: bool = False):
     """Attribute any compile fired inside the body to ``label`` (an
-    engine/AOT key or a bucket ``sbucket-<k>:<variant>``).  With
-    ``fallback_record=True`` and no monitoring listener, the body is
+    engine/AOT key or a bucket ``sbucket-<k>:<variant>`` — sharded
+    geometries carry the mesh shape as ``sbucket-<k>:<variant>:dp<N>``).
+    With ``fallback_record=True`` and no monitoring listener, the body is
     timed and reported as the compile itself — ONLY for bodies that are
-    eager compiles by construction (the prewarm ``.compile()`` loop)."""
+    eager compiles by construction (the prewarm ``.compile()`` loop).
+    ``expected=True`` additionally blesses the body's compiles (recorded
+    + attributed, never a breach): the prewarm sites, which are
+    legitimate even at serve time when an operator reshapes the mesh and
+    re-prewarms — a LAZY compile at dispatch keeps expected=False, so a
+    serve-time reshard retrace still alerts with the right key."""
     plane = _ACTIVE
     if plane is None or not plane.enabled:
         return _NULL
-    return _Scope(label, False, fallback_record)
+    return _Scope(label, expected, fallback_record)
 
 
 def expected_scope(label: str = "host-state-build"):

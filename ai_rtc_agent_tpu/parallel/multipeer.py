@@ -119,13 +119,16 @@ class MultiPeerEngine:
 
         def _vjit(vfn):
             if mesh is not None and mesh.shape.get("dp", 1) > 1:
-                state_sh = NamedSharding(mesh, P("dp"))
-                frame_sh = NamedSharding(mesh, P("dp"))
-                repl = NamedSharding(mesh, P())
+                # the session-axis rules (parallel/sharding.py) — ONE
+                # recipe shared with the dp-sharded batch scheduler, so
+                # the two serving tiers cannot drift on what shards
+                from .sharding import session_shardings
+
+                repl, row_sh = session_shardings(mesh)
                 return jax.jit(
                     vfn,
-                    in_shardings=(repl, state_sh, frame_sh),
-                    out_shardings=(state_sh, frame_sh),
+                    in_shardings=(repl, row_sh, row_sh),
+                    out_shardings=(row_sh, row_sh),
                     donate_argnums=(1,),
                 )
             return jax.jit(vfn, donate_argnums=(1,))

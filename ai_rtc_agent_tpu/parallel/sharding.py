@@ -85,3 +85,42 @@ def shard_params(mesh: Mesh, params, rules: Callable = unet_tp_rules):
     """device_put the pytree according to the rules (materializes shards)."""
     sh = param_shardings(mesh, params, rules)
     return jax.device_put(params, sh)
+
+
+# -- session-axis (dp) sharding: the serving-tier rules ----------------------
+# The batch scheduler's stacked [S, ...] session pytree and the multipeer
+# peer axis shard their LEADING axis over dp; params replicate (or follow
+# the tp rules above when a tp axis is present).  These helpers are the
+# single recipe both serving tiers derive their pjit in/out specs from, so
+# the scheduler and multipeer cannot drift on what shards vs replicates.
+
+
+def session_axis_spec(mesh: Mesh, axis: str = "dp"):
+    """PartitionSpec for a leading session/peer axis: ``activation_spec``'s
+    batch rule generalized to any-rank stacked state leaves (only the
+    leading axis shards; everything trailing replicates with it)."""
+    if mesh.shape.get(axis, 1) <= 1:
+        return P()
+    return P(axis)
+
+
+def session_shardings(mesh: Mesh, axis: str = "dp"):
+    """(replicated, session-axis) NamedSharding pair for a sharded serving
+    step: params ride the first (single sharding broadcast over the whole
+    pytree — pjit prefix semantics), the stacked states/frames/outputs ride
+    the second on their leading [S]/[k] axis."""
+    return (
+        NamedSharding(mesh, P()),
+        NamedSharding(mesh, session_axis_spec(mesh, axis)),
+    )
+
+
+def dp_devices(mesh: Mesh, axis: str = "dp"):
+    """The dp axis's device list in axis order — shard d of a leading-axis
+    sharded array lives on ``dp_devices(mesh)[d]`` (the staging side of the
+    session-axis rules: a session's H2D copy lands on its OWN shard)."""
+    import numpy as np
+
+    axes = list(mesh.axis_names)
+    arr = np.moveaxis(mesh.devices, axes.index(axis), 0)
+    return [arr[d].flat[0] for d in range(mesh.shape.get(axis, 1))]

@@ -783,15 +783,32 @@ class ResilientPipeline:
         ok, outs = self._run_bounded(
             self._inner.fetch_batch, inner_handle, src_frames
         )
-        if not ok or any(_non_finite(o) for o in outs or []):
+        if not ok or any(
+            _non_finite(o)
+            for o in outs or []
+            if not isinstance(o, ShedFrame)
+        ):
             if ok:
                 self.supervisor.on_step_error(
                     FloatingPointError("non-finite frame from engine")
                 )
             self.supervisor.note_frame_out(len(srcs), processed=False)
             return list(srcs)
-        dt = time.monotonic() - t0
-        self._note_step(dt)
-        self.supervisor.on_step_ok(dt)
-        self.supervisor.note_frame_out(len(outs), processed=True)
-        return outs
+        # per-output sheds (the scheduler's bounded window can evict some
+        # of a group under pressure): source pixels, not an engine step —
+        # passthrough delivery for those positions, and only the frames
+        # that actually stepped feed the EWMA/counters (same discipline
+        # as the single-frame path above)
+        results, live = [], 0
+        for o, src in zip(outs, list(srcs)):
+            if isinstance(o, ShedFrame):
+                results.append(self._passthrough(src))
+            else:
+                results.append(o)
+                live += 1
+        if live:
+            dt = time.monotonic() - t0
+            self._note_step(dt)
+            self.supervisor.on_step_ok(dt)
+            self.supervisor.note_frame_out(live, processed=True)
+        return results

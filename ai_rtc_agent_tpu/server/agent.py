@@ -1134,8 +1134,11 @@ class _TimedPipeline:
         inner, t_sub = handle
         outs = self._pipeline.fetch_batch(inner, src_frames)
         dt = time.monotonic() - t_sub
-        for _ in outs:
-            self._stats.record(dt)
+        # shed positions are submit-to-eviction time, not latency samples
+        # (the single-frame rule above) — record only stepped outputs
+        for o in outs:
+            if not isinstance(o, ShedFrame):
+                self._stats.record(dt)
         return outs
 
 
@@ -1235,12 +1238,28 @@ async def on_startup(app):
         from ..stream.pipeline import StreamDiffusionPipeline
 
         mesh = None
-        if app.get("tp", 0) > 1 or app.get("sp", 0) > 1:
+        # MESH_SHAPE declares the serving mesh declaratively ("dp,tp,sp"):
+        # tp/sp feed the pipeline mesh when the CLI flags are unset, dp
+        # feeds the scheduler's session axis below (BATCHSCHED_DP reads it)
+        mesh_dp, mesh_tp, mesh_sp = env.mesh_shape()
+        tp = app.get("tp", 0) or mesh_tp
+        sp = app.get("sp", 0) or mesh_sp
+        if tp > 1 or sp > 1:
             from ..parallel import mesh as M
 
-            mesh = M.make_mesh(
-                tp=max(1, app.get("tp", 0)), sp=max(1, app.get("sp", 0))
-            )
+            mesh = M.make_mesh(tp=max(1, tp), sp=max(1, sp))
+            if env.batchsched_dp() > 1:
+                # a tp/sp mesh keeps the shared-engine path, which has no
+                # session axis to shard — a declared dp would otherwise
+                # vanish into a silent ~dp-x capacity loss (dp x tp/sp
+                # compound meshes are ROADMAP follow-up work)
+                logger.warning(
+                    "MESH_SHAPE/BATCHSCHED_DP dp=%d IGNORED: tp=%d/sp=%d "
+                    "route serving through the shared-engine mesh path, "
+                    "which does not shard the session axis — drop the "
+                    "tp/sp axes to use the dp-sharded scheduler",
+                    env.batchsched_dp(), tp, sp,
+                )
         app["pipeline"] = StreamDiffusionPipeline(
             app["model_id"],
             config=_build_config(),
@@ -1248,26 +1267,26 @@ async def on_startup(app):
             mesh=mesh,
         )
         # Continuous batch scheduler (stream/scheduler.py): the DEFAULT
-        # single-device serving path — concurrent sessions coalesce into
-        # one vmapped device step instead of serializing through the
-        # shared engine.  BATCHSCHED=0 kill-switch restores the shared
-        # pipeline; tp/sp meshes and --fbs keep it (those batch axes
-        # don't compose with the session axis).  UNET_CACHE and
-        # QUANT_WEIGHTS serve THROUGH the scheduler (ISSUE 9): the
-        # DeepCache cadence runs globally over (k, variant)-keyed bucket
-        # steps and quantized params ride unchanged — parity pinned by
-        # tests/batchsched_equiv_driver.py.
+        # serving path — concurrent sessions coalesce into one vmapped
+        # device step instead of serializing through the shared engine.
+        # BATCHSCHED=0 kill-switch restores the shared pipeline; tp/sp
+        # meshes keep it (those axes shard the MODEL, not the sessions).
+        # With BATCHSCHED_DP=N (or a MESH_SHAPE dp axis) the scheduler's
+        # session axis shards over a dp mesh of N devices (ISSUE 12) and
+        # --fbs rides THROUGH the scheduler as a second batching
+        # dimension (consecutive frames per session row); UNET_CACHE and
+        # QUANT_WEIGHTS serve through it too (ISSUE 9) — parity pinned
+        # by tests/batchsched_equiv_driver.py.
         if (
             app.get("batch_scheduler") is None
             and env.batchsched_enabled()
             and mesh is None
-            and app["pipeline"].config.frame_buffer_size == 1
         ):
             from ..stream.scheduler import BatchScheduler
 
             try:
                 app["batch_scheduler"] = BatchScheduler.from_pipeline(
-                    app["pipeline"]
+                    app["pipeline"], dp=env.batchsched_dp()
                 )
             except Exception:
                 logger.exception(

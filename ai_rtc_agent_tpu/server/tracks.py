@@ -215,5 +215,10 @@ class VideoStreamTrack:
             if len(self._pending) >= max(1, self.pipeline_depth):
                 srcs0, h0 = self._pending.popleft()
                 outs = await asyncio.to_thread(self.pipeline.fetch_batch, h0, srcs0)
-                self._outbuf.extend(outs)
+                # unsupervised tier (SUPERVISOR=0): unwrap bounded-queue
+                # shed markers to their source pixels, the single-frame
+                # recv rule — a raw ShedFrame must never reach the encoder
+                self._outbuf.extend(
+                    o.frame if isinstance(o, ShedFrame) else o for o in outs
+                )
         return self._outbuf.popleft()

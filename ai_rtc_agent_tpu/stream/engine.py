@@ -474,7 +474,7 @@ def params_variant_extra(params) -> dict:
     return {"quant": "w8"} if _has_quantized_kernels(params) else {}
 
 
-def stage_frame(frame_u8):
+def stage_frame(frame_u8, device=None):
     """Start the host->HBM transfer for one frame WITHOUT blocking.
 
     The single reusable staging path shared by StreamEngine.submit and the
@@ -485,12 +485,19 @@ def stage_frame(frame_u8):
     serialize concurrent sessions' dispatches on what looks like
     microseconds of host work.
 
+    ``device``: the owning shard's device for mesh-sharded serving (the
+    dp-sharded scheduler stages each session's row onto ITS shard, so the
+    H2D copy lands where the row computes instead of on device 0 followed
+    by a cross-device reshuffle).  None keeps the single-device default.
+
     Being the ONE H2D path (machine-checked: analysis/
     device_transfers.py) also makes it the one H2D *meter*: every staged
     frame lands in the device-telemetry transfer counters
     (obs/devtel.py; one global read + None test when the plane is off)."""
     if isinstance(frame_u8, np.ndarray):
         devtel.note_h2d(frame_u8.nbytes)
+        if device is not None:
+            return jax.device_put(frame_u8, device)
         return jax.device_put(frame_u8)
     return frame_u8
 

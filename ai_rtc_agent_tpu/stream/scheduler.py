@@ -46,6 +46,33 @@ axis with other users' frames instead:
   runs as a GLOBAL tick over (k, capture|cached)-keyed bucket executables
   — the multipeer discipline: any install/prompt/t-index write resets the
   cadence so a zeroed or stale deep-feature cache is never consumed.
+* **The session axis spans the mesh** (ISSUE 12, ROADMAP open item 4):
+  with ``BATCHSCHED_DP=N`` (or a ``MESH_SHAPE`` dp axis) the stacked
+  ``[S, ...]`` pytree shards its leading axis over a dp mesh
+  (``parallel/sharding.py`` session-axis rules: params replicated, states
+  /frames/outputs on ``P("dp")``), so one bucket step drives every chip —
+  a v5e-8 serves ~8x the sessions of one chip at the same per-session
+  latency.  The whole plane follows the sharding: submit stages each
+  session's row onto ITS shard (``stage_frame(..., device=...)`` — H2D
+  lands on the owning device, never device 0 then reshuffle), dispatch
+  assembles the global frame batch from the per-shard rows zero-copy
+  (``jax.make_array_from_single_device_arrays``), the per-slot readback
+  slices each row FROM ITS SHARD (fetch isolation survives sharding: no
+  cross-device gather resolves one session's frame), bucket sizes are
+  dp multiples (padding rows land on otherwise-idle shards, so
+  below-capacity occupancy is latency-neutral), and the AOT key plane
+  carries the mesh shape (``dp-N`` via ``aot/cache.mesh_key_extra``)
+  with prewarm covering every ``(k, variant, dp)`` geometry — join/leave
+  /reshard never retraces mid-serve, watched by the devtel compile
+  watchdog under ``sbucket-<k>:<variant>:dp<N>`` scopes.
+* **``--fbs`` joins as a second batching dimension**: with
+  ``frame_buffer_size > 1`` each session's window coalesces fbs
+  CONSECUTIVE frames into one ``[fbs, H, W, 3]`` row and the bucket step
+  batches ``[k, fbs, ...]`` — sessions x consecutive frames in ONE
+  device step (the two batch axes the pre-ISSUE-12 scheduler declared
+  mutually exclusive).  Each frame's handle resolves to its own slice of
+  the session's row; the similarity filter stays fbs==1-only (a skip
+  would desync the group boundaries).
 
 Outputs are bit-identical to a dedicated engine per session (pinned by
 tests/test_batch_scheduler.py across join/leave, prompt updates and
@@ -189,7 +216,25 @@ class ScheduledSession:
 
     @property
     def frame_buffer_size(self) -> int:
-        return 1
+        # fbs>1: the track layer batches fbs consecutive frames per step
+        # (_recv_batched), exactly like the shared-pipeline path — here
+        # they land as ONE [fbs, ...] row of the session's bucket slot
+        return self._owner.fbs
+
+    def submit_batch(self, frames):
+        """fbs consecutive duck-typed frames -> one in-flight handle (the
+        per-frame handles; the LAST submit completes the slot's group, so
+        with every live session ready the dispatch runs inline here)."""
+        return [self.submit(f) for f in frames]
+
+    def fetch_batch(self, handles, src_frames=None):
+        """Resolve a submit_batch handle -> list of fbs output frames
+        (each resolves its own slice of the session's row — the memoized
+        per-row host copy is read fbs times, transferred once)."""
+        return [
+            self.fetch(h, src_frames[i] if src_frames else None)
+            for i, h in enumerate(handles)
+        ]
 
     @property
     def window_queue(self) -> DeadlineQueue:
@@ -255,8 +300,12 @@ class ScheduledSession:
         # Staged ROW-SHAPED ([1,H,W,3] — the [None] is a free host view):
         # a solo dispatch uses the buffer as-is and a batch is one
         # device-side concatenate, so the hot path never pays a per-frame
-        # reshape op (per-op dispatch is real money at small step sizes)
-        p.frame_dev = stage_frame(arr[None])
+        # reshape op (per-op dispatch is real money at small step sizes).
+        # On a dp mesh the copy lands on the SLOT'S OWN SHARD — never
+        # device 0 followed by a cross-device reshuffle at dispatch
+        p.frame_dev = stage_frame(
+            arr[None], device=self._owner._slot_device(self.slot)
+        )
         self._owner._enqueue(self.slot, p)
         if self._sim is not None:
             # dup-chain anchor — only the similarity filter ever reads it
@@ -271,11 +320,12 @@ class ScheduledSession:
         if trace is None and src_frame is not None:
             trace = get_trace(src_frame)
         t0 = time.monotonic()
+        fi = None
         if handle.readback is not None:
             # fast path: resolve THIS session's row right here (the
             # dedicated-engine flow — submit dispatched, fetch blocks on
             # its own per-slot readback, zero thread handoffs)
-            batch, row = handle.readback
+            batch, row, fi = handle.readback
             out, t1 = self._owner._resolve_row(batch, row, t0)
         else:
             try:
@@ -287,15 +337,20 @@ class ScheduledSession:
                 return ShedFrame(handle.frame)
             if (
                 isinstance(out, tuple)
-                and len(out) == 2
+                and len(out) == 3
                 and isinstance(out[0], _DispatchedBatch)
             ):
                 # this frame was waiting in the window when a dispatch
                 # (inline or dispatcher) claimed it — the marker routes us
                 # to our own per-slot row of that batch
+                fi = out[2]
                 out, t1 = self._owner._resolve_row(out[0], out[1], t0)
             else:
                 t1 = time.monotonic()
+        if fi is not None and not isinstance(out, ShedFrame):
+            # fbs>1: the memoized row is the session's [fbs, H, W, 3]
+            # group — this handle owns exactly one consecutive frame of it
+            out = out[fi]
         if isinstance(out, ShedFrame):
             return out
         self._had_output = True
@@ -362,13 +417,18 @@ class ScheduledSession:
 
     def snapshot(self) -> dict:
         q = self.window_queue
-        return {
+        out = {
             "slot": self.slot,
             "frames_submitted": self.frames_submitted,
             "frames_skipped_similar": self.frames_skipped_similar,
             "window_depth": q.depth,
             "window_shed": q.shed_overflow + q.shed_stale,
         }
+        owner = self._owner
+        if owner.dp > 1:
+            # which mesh shard this session's state row lives on (/health)
+            out["shard"] = owner._slot_shard(self.slot)
+        return out
 
 
 class BatchScheduler:
@@ -396,6 +456,8 @@ class BatchScheduler:
         prewarm: bool | None = None,
         aot_build_on_miss: bool | None = None,
         cache_dir: str | None = None,
+        mesh=None,
+        dp: int | None = None,
     ):
         from .pipeline import (
             DEFAULT_DELTA,
@@ -403,11 +465,12 @@ class BatchScheduler:
             DEFAULT_PROMPT,
         )
 
-        if cfg.frame_buffer_size != 1:
+        self.fbs = int(cfg.frame_buffer_size)
+        if self.fbs > 1 and cfg.similar_image_filter:
             raise ValueError(
-                "the batch scheduler batches SESSIONS; frame_buffer_size "
-                "must stay 1 (--fbs and the scheduler are mutually "
-                "exclusive batch axes)"
+                "the scheduler's consecutive-frame batching (fbs>1) is "
+                "incompatible with the similarity filter: a skipped frame "
+                "would desync the fbs group boundaries"
             )
         self.cfg = cfg
         self.model_id = model_id
@@ -425,10 +488,42 @@ class BatchScheduler:
             else float(window_ms)
         ) / 1e3
         self.queue_bound = (
-            env.get_int("BATCHSCHED_QUEUE_BOUND", 2)
+            env.get_int("BATCHSCHED_QUEUE_BOUND", 2 * self.fbs)
             if queue_bound is None
             else int(queue_bound)
         )
+        if self.queue_bound < self.fbs:
+            raise ValueError(
+                f"queue_bound ({self.queue_bound}) must hold at least one "
+                f"fbs group ({self.fbs}) or no frame could ever dispatch"
+            )
+        # -- session-axis mesh (dp sharding) --------------------------------
+        # the dp axis shards the stacked [S, ...] pytree's leading axis;
+        # a trivial mesh (dp<=1) keeps the single-device scheduler exactly
+        if mesh is None:
+            dp = env.batchsched_dp() if dp is None else max(1, int(dp))
+            if dp > 1:
+                from ..parallel.mesh import make_mesh
+
+                mesh = make_mesh(dp=dp)
+        self.mesh = mesh
+        self.dp = mesh.shape.get("dp", 1) if mesh is not None else 1
+        if self.dp > 1:
+            from ..parallel import sharding as SH
+
+            if self.max_sessions % self.dp != 0:
+                raise ValueError(
+                    f"max_sessions ({self.max_sessions}) must be a "
+                    f"multiple of the dp axis ({self.dp}) so the session "
+                    "axis shards evenly"
+                )
+            # params replicated (single sharding broadcast over the pytree
+            # — pjit prefix semantics), states/frames/outputs on P('dp')
+            self._repl_sh, self._row_sh = SH.session_shardings(mesh)
+            self._dp_devs = SH.dp_devices(mesh)
+        else:
+            self._repl_sh = self._row_sh = None
+            self._dp_devs = None
         self.fetch_timeout = fetch_timeout
         self.safety_checker = safety_checker
         # scheduler-level defaults for new sessions; the global /config
@@ -476,7 +571,12 @@ class BatchScheduler:
             for v in self._variants
         }
         S = self.max_sessions
-        sizes, b = [], 1
+        # bucket geometries start at dp and grow by doubling: every bucket
+        # is a dp multiple so the [k, ...] batch shards evenly — padding
+        # rows of a below-minimum occupancy land on otherwise-IDLE shards,
+        # so a solo session on a dp=8 mesh pays a k=8-shaped step whose
+        # extra rows compute in parallel elsewhere (latency-neutral)
+        sizes, b = [], self.dp
         while b < S:
             sizes.append(b)
             b *= 2
@@ -492,6 +592,11 @@ class BatchScheduler:
         self.states = jax.tree.map(
             lambda x: jnp.stack([x] * S), self._template.state
         )
+        if self.dp > 1:
+            # materialize the session-axis shards NOW: every later install
+            # (.at[slot].set of an uncommitted fresh row) preserves the
+            # sharding, so donation round-trips without resharding copies
+            self.states = jax.device_put(self.states, self._row_sh)
         self.active = [False] * S
         self._sessions: dict = {}  # slot -> ScheduledSession
         self._queues = [
@@ -579,8 +684,9 @@ class BatchScheduler:
             n > 1 for n in eng.mesh.shape.values()
         ):
             raise ValueError(
-                "the batch scheduler is single-device (the session axis "
-                "IS the batch); tp/sp meshes keep the shared-engine path"
+                "the batch scheduler owns its own session-axis (dp) mesh; "
+                "an engine built on a tp/sp mesh keeps the shared-engine "
+                "path (those axes shard the MODEL, not the sessions)"
             )
         return cls(
             eng.models,
@@ -622,7 +728,23 @@ class BatchScheduler:
         sessions keep batching while someone joins."""
         with self._lock:
             try:
-                slot = self.active.index(False)
+                if self.dp > 1:
+                    # shard-balanced placement: claim a free slot on the
+                    # LEAST-LOADED shard (ties -> lowest slot), so partial
+                    # occupancy spreads rows across chips — each session's
+                    # bucket row then computes on its OWN shard (no
+                    # per-dispatch cross-device hops) and the idle-shard
+                    # parallelism the dp-multiple buckets promise is real
+                    loads = [0] * self.dp
+                    for s, live in enumerate(self.active):
+                        if live:
+                            loads[self._slot_shard(s)] += 1
+                    slot = min(
+                        (s for s, live in enumerate(self.active) if not live),
+                        key=lambda s: (loads[self._slot_shard(s)], s),
+                    )
+                else:
+                    slot = self.active.index(False)
             except ValueError:
                 raise CapacityError(
                     f"all {self.max_sessions} scheduler session slots in use"
@@ -853,30 +975,69 @@ class BatchScheduler:
             self._idx_cache[key] = idx
         return idx
 
+    def _slot_shard(self, slot: int) -> int:
+        """slot -> shard index (slot-major: contiguous S/dp slot blocks
+        per shard) — THE single definition of row residence, shared by
+        the staging target, the bucket layout, /health and /metrics."""
+        return slot * self.dp // self.max_sessions
+
+    def _slot_device(self, slot: int):
+        """The shard device that owns this slot's state row, or None
+        off-mesh — the staging target for the session's H2D copies."""
+        if self._dp_devs is None:
+            return None
+        return self._dp_devs[self._slot_shard(slot)]
+
+    def _bucket_label(self, k: int, variant: str) -> str:
+        """Devtel compile-attribution scope for one bucket geometry — the
+        mesh shape rides the label (``sbucket-<k>:<variant>:dp<N>``) so a
+        serve-time reshard retrace alerts with the right key; dp=1 keeps
+        the original spelling."""
+        label = f"sbucket-{k}:{variant}"
+        return f"{label}:dp{self.dp}" if self.dp > 1 else label
+
     def _bucket_step(self, k: int, variant: str = "full"):
         step = self._bucket_steps.get((k, variant))
         if step is None:
-            step = jax.jit(
-                make_bucket_step(
-                    self._vsteps[variant], self.max_sessions,
-                    scatter_output=False,
-                ),
-                donate_argnums=(1,),
+            fn = make_bucket_step(
+                self._vsteps[variant], self.max_sessions,
+                scatter_output=False,
             )
+            if self.dp > 1:
+                # session-axis sharding (parallel/sharding.py rules):
+                # params replicated, stacked states + the [k, ...] frame
+                # batch and output on P('dp') — one dispatch drives every
+                # chip, and the donated states round-trip shard-in-place
+                step = jax.jit(
+                    fn,
+                    in_shardings=(
+                        self._repl_sh, self._row_sh, self._row_sh,
+                        self._repl_sh,
+                    ),
+                    out_shardings=(self._row_sh, self._row_sh),
+                    donate_argnums=(1,),
+                )
+            else:
+                step = jax.jit(fn, donate_argnums=(1,))
             self._bucket_steps[(k, variant)] = step
             logger.info(
-                "batchsched bucket step %d/%d (%s) registered (compiles "
-                "on first use unless prewarmed)", k, self.max_sessions,
-                variant,
+                "batchsched bucket step %d/%d (%s, dp=%d) registered "
+                "(compiles on first use unless prewarmed)", k,
+                self.max_sessions, variant, self.dp,
             )
         return step
 
     def _bucket_specs(self, k: int):
         spec = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+        frame_shape = (
+            (k, self.height, self.width, 3)
+            if self.fbs == 1
+            else (k, self.fbs, self.height, self.width, 3)
+        )
         return (
             jax.tree.map(spec, self.params),
             jax.tree.map(spec, self.states),
-            jax.ShapeDtypeStruct((k, self.height, self.width, 3), jnp.uint8),
+            jax.ShapeDtypeStruct(frame_shape, jnp.uint8),
             jax.ShapeDtypeStruct((k,), jnp.int32),
         )
 
@@ -885,15 +1046,21 @@ class BatchScheduler:
         single key recipe shared by serving adoption and the build CLI
         (``sbucket``/``sessions`` extend the stream key exactly like
         ``peers`` does for --multipeer; a DeepCache config keys a
-        capture+cached PAIR per bucket, and w8-quantized params add
-        ``quant-w8`` the way ``attn``/``fused`` already ride the key)."""
+        capture+cached PAIR per bucket, w8-quantized params add
+        ``quant-w8`` the way ``attn``/``fused`` already ride the key, and
+        a dp mesh adds ``dp-N`` via ``aot/cache.mesh_key_extra`` so a
+        sharded executable never collides with the single-device slot)."""
+        from ..aot.cache import mesh_key_extra
+
         model_id = model_id or self.model_id
         qextra = params_variant_extra(self.params)
+        mextra = mesh_key_extra(self.mesh)
         return {
             (k, v): stream_engine_key(
                 model_id, self.cfg, sbucket=k, sessions=self.max_sessions,
                 **({"variant": v} if v != "full" else {}),
                 **qextra,
+                **mextra,
             )
             for k in self._bucket_sizes
             for v in self._variants
@@ -919,7 +1086,12 @@ class BatchScheduler:
         """Swap every bucket step for a serialized AOT executable (the
         StreamEngine.use_aot_cache discipline, one key per bucket
         geometry).  All-or-nothing: a partial adoption would stall the
-        missing occupancy on a lazy compile mid-serve."""
+        missing occupancy on a lazy compile mid-serve.  dp-sharded
+        schedulers are not exported (a serialized program is
+        per-topology — the StreamEngine/MultiPeerEngine mesh policy);
+        prewarm_buckets is their no-retrace guarantee instead."""
+        if self.dp > 1:
+            return False
         from ..aot.cache import EngineCache
 
         cache = EngineCache(cache_dir)
@@ -952,17 +1124,25 @@ class BatchScheduler:
         """Eagerly compile every (bucket geometry, unet variant) NOW (jit
         alone is lazy): occupancy transitions at serve time must dispatch,
         not compile — a join stalling every live session on a retrace is
-        exactly what this subsystem exists to remove."""
+        exactly what this subsystem exists to remove.  On a dp mesh this
+        covers every (k, variant, dp) geometry, so join/leave/reshard
+        within the prewarmed set never retraces mid-serve."""
         for k in self._bucket_sizes:
             for v in self._variants:
                 if self._aot_adopted and (k, v) in self._bucket_steps:
                     continue
                 params_s, states_s, frames_s, idx_s = self._bucket_specs(k)
-                # devtel: attribute the eager compile to its bucket; the
-                # body IS a compile by construction, so in the
-                # no-monitoring fallback it self-times (fallback_record)
+                # devtel: attribute the eager compile to its bucket (the
+                # sharded label carries :dp<N>); the body IS a compile by
+                # construction, so in the no-monitoring fallback it
+                # self-times (fallback_record) — and it is EXPECTED: a
+                # legitimate operator-triggered prewarm (e.g. after a
+                # mesh reshape) must never false-alarm the watchdog even
+                # in the serving phase, while a LAZY dispatch compile
+                # (_step_batch_locked) keeps breach semantics
                 with devtel.compile_scope(
-                    f"sbucket-{k}:{v}", fallback_record=True
+                    self._bucket_label(k, v), fallback_record=True,
+                    expected=True,
                 ):
                     compiled = (
                         self._bucket_step(k, v)
@@ -972,8 +1152,8 @@ class BatchScheduler:
                 self._bucket_steps[(k, v)] = compiled
                 self._warmed_buckets.add((k, v))
                 logger.info(
-                    "prewarmed batchsched bucket %d/%d (%s)",
-                    k, self.max_sessions, v,
+                    "prewarmed batchsched bucket %d/%d (%s, dp=%d)",
+                    k, self.max_sessions, v, self.dp,
                 )
 
     # -- coalescing window + dispatcher ---------------------------------------
@@ -1002,7 +1182,8 @@ class BatchScheduler:
                 self._batches_in_flight(pending.t_enq) < self.PIPELINE_DEPTH
             )
             if (
-                room
+                self.fbs == 1
+                and room
                 and self.active.count(True) == 1
                 and self._queues[slot].depth == 0
             ):
@@ -1010,26 +1191,42 @@ class BatchScheduler:
                 # — dispatch THIS frame without touching the window queue
                 # at all (the pass-through-cheap promise: a lock and a
                 # gather/scatter, not a queue round-trip + thread handoff)
-                self._dispatch_entries_locked([(slot, pending)], pending)
+                self._dispatch_entries_locked([(slot, [pending])], pending)
                 return
             self._queues[slot].push(pending, stamp=pending.t_enq)
             if room and len(self._waiting_slots()) >= self.active.count(
                 True
             ):
                 # fast path: THIS frame completed the batch (every live
-                # session has work) — dispatch NOW on the caller thread:
-                # no window, no dispatcher handoff; each rider's fetch
-                # resolves its own per-slot row
+                # session has a full fbs group waiting) — dispatch NOW on
+                # the caller thread: no window, no dispatcher handoff;
+                # each rider's fetch resolves its own per-slot row
                 self._dispatch_inline_locked(pending)
                 return
             self._has_work.notify()
 
+    def _pop_group(self, slot: int):
+        """Pop one dispatch group for a slot: the single oldest frame
+        (fbs==1) or the slot's fbs OLDEST consecutive frames — the
+        second batching dimension the bucket step consumes as one
+        [fbs, H, W, 3] row.  Caller holds the lock."""
+        if self.fbs == 1:
+            got = self._queues[slot].pop()
+            return None if got is None else [got[0]]
+        plist = []
+        for _ in range(self.fbs):
+            got = self._queues[slot].pop()
+            if got is None:
+                break
+            plist.append(got[0])
+        return plist or None
+
     def _dispatch_inline_locked(self, submitter: _PendingFrame):
         entries = []
         for s in self._waiting_slots():
-            got = self._queues[s].pop()
-            if got is not None:
-                entries.append((s, got[0]))
+            plist = self._pop_group(s)
+            if plist is not None:
+                entries.append((s, plist))
         if not entries:
             return
         self._dispatch_entries_locked(entries, submitter)
@@ -1037,37 +1234,43 @@ class BatchScheduler:
     def _step_batch_locked(self, entries):
         """The ONE dispatch sequence both paths share (dispatcher loop and
         inline fast path): bucket-select, pad with the last ready row,
-        stack the PRE-STAGED device frames, stamp, step, slice per-slot
-        rows on device and kick each row's async readback.  Caller holds
-        the lock; a raising step is the caller's to deliver to the
-        waiters.  -> (rows, t_disp, occ, feed): ``feed`` False on a
-        bucket variant's first use (a lazy compile may ride it — not a
+        assemble the PRE-STAGED device frames (zero-copy per-shard on a
+        dp mesh), stamp, step, slice per-slot rows on device — each FROM
+        ITS OWN SHARD when sharded — and kick each row's async readback.
+        Caller holds the lock; a raising step is the caller's to deliver
+        to the waiters.  -> (rows, t_disp, occ, feed): ``feed`` False on
+        a bucket variant's first use (a lazy compile may ride it — not a
         capacity signal)."""
         idx = [s for s, _ in entries]
         k = self._bucket_for(len(idx))
-        pad = (idx + [idx[-1]] * k)[:k]
+        pad, positions = self._layout_pad(idx, k)
         # frames were staged to device ROW-SHAPED at submit time
-        # (stage_frame, outside any lock): a solo bucket consumes the
-        # staged buffer with ZERO extra device ops, a wider bucket pays
-        # one concatenate — never an H2D copy under the dispatch lock
-        by_slot = {
-            s: (
-                stage_frame(p.frame[None])
+        # (stage_frame, outside any lock, onto the slot's own shard): a
+        # solo bucket consumes the staged buffer with ZERO extra device
+        # ops, a wider bucket pays one concatenate/stack per shard —
+        # never an H2D copy under the dispatch lock
+        by_slot = {}
+        for s, plist in entries:
+            bufs = [
+                stage_frame(p.frame[None], device=self._slot_device(s))
                 if p.frame_dev is None
                 else p.frame_dev
-            )
-            for s, p in entries
-        }
-        frames_k = (
-            by_slot[idx[0]]
-            if k == 1
-            else jnp.concatenate([by_slot[s] for s in pad], axis=0)
-        )
+                for p in plist
+            ]
+            if self.fbs == 1:
+                by_slot[s] = bufs[0]
+            else:
+                # a (defensive) short group pads by repeating its last
+                # frame — identical compute, the absent handles were shed
+                bufs = (bufs + [bufs[-1]] * self.fbs)[: self.fbs]
+                by_slot[s] = jnp.concatenate(bufs, axis=0)
+        frames_k = self._assemble_frames(pad, by_slot, k)
         t_disp = time.monotonic()
         occ = len(entries)
-        for _, p in entries:
-            p.t_dispatch = t_disp
-            p.occupancy = occ
+        for _, plist in entries:
+            for p in plist:
+                p.t_dispatch = t_disp
+                p.occupancy = occ
         variant = "full"
         if self._cache_interval:
             # global DeepCache cadence: full capture every Nth batch step,
@@ -1091,9 +1294,9 @@ class BatchScheduler:
         feed = (k, variant) in self._warmed_buckets
         # compile-watchdog attribution: a bucket step that compiles HERE
         # (prewarm disabled, or an evicted/missed geometry) is recorded
-        # against its (k, variant) — in the serving phase that is the
-        # serve-time retrace breach this plane exists to catch
-        with devtel.compile_scope(f"sbucket-{k}:{variant}"):
+        # against its (k, variant[, dp]) — in the serving phase that is
+        # the serve-time retrace breach this plane exists to catch
+        with devtel.compile_scope(self._bucket_label(k, variant)):
             self.states, out = self._bucket_step(k, variant)(
                 self.params,
                 self.states,
@@ -1104,14 +1307,19 @@ class BatchScheduler:
         # per-slot readback plane: slice each rider's row ON DEVICE and
         # start its D2H copy now — a fetch resolves only its own buffer,
         # so one session's readback never bills the others and the next
-        # dispatch overlaps these copies.  A solo batch skips the slice
-        # (its whole output IS the row — _resolve_row squeezes leading
-        # singleton axes on the host for free)
-        rows = (
-            [out]
-            if len(entries) == 1
-            else [out[i] for i in range(len(entries))]
-        )
+        # dispatch overlaps these copies.  Sharded, each row slices FROM
+        # ITS OWN SHARD (no cross-device gather resolves one session's
+        # frame).  A single-device solo batch skips the slice (its whole
+        # output IS the row — _resolve_row squeezes leading singleton
+        # axes on the host for free)
+        if self.dp > 1:
+            rows = self._rows_from_sharded(out, positions, k)
+        else:
+            rows = (
+                [out]
+                if len(entries) == 1
+                else [out[i] for i in positions]
+            )
         for r in rows:
             try:
                 r.copy_to_host_async()
@@ -1119,14 +1327,116 @@ class BatchScheduler:
                 pass
         return rows, t_disp, occ, feed
 
+    def _layout_pad(self, idx, k: int):
+        """Bucket layout: which slot fills each of the k rows, and which
+        row each ENTRY resolves from.  Single-device: entries are a
+        prefix, padding repeats the last (the PR 7 layout).  On a dp
+        mesh rows are placed SHARD-AWARE: row i computes on shard
+        i//(k/dp), so each entry goes to a row on its state row's OWN
+        shard while that shard has space (claim() balances the live set,
+        so in steady state every row is home — zero cross-device hops);
+        only overload of one shard spills, and padding repeats a row
+        already resident on the padded shard.  -> (pad, positions) with
+        ``positions[j]`` the row entry j resolves from (its home-shard
+        occurrence when one exists)."""
+        if self.dp <= 1:
+            return (idx + [idx[-1]] * k)[:k], list(range(len(idx)))
+        rps = k // self.dp
+        shard_rows = [[] for _ in range(self.dp)]
+        spill = []
+        for s in idx:
+            d = self._slot_shard(s)
+            if len(shard_rows[d]) < rps:
+                shard_rows[d].append(s)
+            else:
+                spill.append(s)
+        for s in spill:  # one shard overloaded: first shard with space
+            for d in range(self.dp):
+                if len(shard_rows[d]) < rps:
+                    shard_rows[d].append(s)
+                    break
+        for d in range(self.dp):
+            # padding repeats a row already ON this shard when it has
+            # one (zero-copy duplicate); an entirely idle shard repeats
+            # the last entry (the one unavoidable hop — idle-shard
+            # padding is what makes below-minimum occupancy legal)
+            filler = shard_rows[d][-1] if shard_rows[d] else idx[-1]
+            while len(shard_rows[d]) < rps:
+                shard_rows[d].append(filler)
+        pad = [s for rows in shard_rows for s in rows]
+        positions = []
+        for s in idx:
+            home = self._slot_shard(s)
+            cand = [i for i, x in enumerate(pad) if x == s]
+            positions.append(
+                next((i for i in cand if i // rps == home), cand[0])
+            )
+        return pad, positions
+
+    def _assemble_frames(self, pad, by_slot, k: int):
+        """The global frame batch for one dispatch.  Single-device: one
+        concatenate/stack of the staged rows.  On a dp mesh: group the
+        bucket's rows by owning shard (row i of k -> shard i//(k/dp)),
+        build each shard's block ON ITS DEVICE (a straggler staged
+        elsewhere pays one explicit D2D hop) and assemble the global
+        [k, ...] array ZERO-COPY via make_array_from_single_device_arrays
+        — the batch is born sharded; nothing funnels through device 0."""
+        if self.dp <= 1:
+            if self.fbs == 1:
+                return (
+                    by_slot[pad[0]]
+                    if k == 1
+                    else jnp.concatenate([by_slot[s] for s in pad], axis=0)
+                )
+            return jnp.stack([by_slot[s] for s in pad])
+        rps = k // self.dp  # rows per shard (bucket sizes are dp multiples)
+        shards = []
+        for d in range(self.dp):
+            dev = self._dp_devs[d]
+            rows = []
+            for i in range(d * rps, (d + 1) * rps):
+                r = by_slot[pad[i]]
+                if dev not in r.devices():
+                    r = jax.device_put(r, dev)
+                rows.append(r)
+            if self.fbs == 1:
+                # rows are [1,H,W,3] staged buffers -> [rps,H,W,3]
+                shards.append(
+                    rows[0] if rps == 1 else jnp.concatenate(rows, axis=0)
+                )
+            else:
+                # rows are [fbs,H,W,3] groups -> [rps,fbs,H,W,3]
+                shards.append(jnp.stack(rows))
+        shape = (
+            (k, self.height, self.width, 3)
+            if self.fbs == 1
+            else (k, self.fbs, self.height, self.width, 3)
+        )
+        return jax.make_array_from_single_device_arrays(
+            shape, self._row_sh, shards
+        )
+
+    def _rows_from_sharded(self, out, positions, k: int):
+        """Per-entry device rows of a SHARDED bucket output: entry j's
+        row (``positions[j]``) is sliced from the addressable shard
+        that owns it (its ``copy_to_host_async`` + host resolve then
+        move only that session's bytes off that device) — fetch
+        isolation survives sharding by construction."""
+        rps = k // self.dp
+        shards = sorted(
+            out.addressable_shards, key=lambda s: s.index[0].start or 0
+        )
+        return [shards[i // rps].data[i % rps] for i in positions]
+
     @staticmethod
     def _fail_entries(entries, exc):
-        for _, p in entries:
-            if not p.future.cancelled():
-                try:
-                    p.future.set_exception(exc)
-                except InvalidStateError:
-                    pass
+        for _, plist in entries:
+            for p in plist:
+                if not p.future.cancelled():
+                    try:
+                        p.future.set_exception(exc)
+                    except InvalidStateError:
+                        pass
 
     def _recover_states_locked(self, cause):
         """A failed step invalidated the DONATED stacked state — left
@@ -1158,6 +1468,11 @@ class BatchScheduler:
                         )
                     per.append(placeholder)
             self.states = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+            if self.dp > 1:
+                # re-materialize the session-axis shards (the rebuilt
+                # stack is single-device) so the next donated dispatch
+                # doesn't pay a silent resharding copy
+                self.states = jax.device_put(self.states, self._row_sh)
             if self._cache_interval:
                 self._tick = 0  # fresh (zeroed) deep caches -> recapture
                 self._uncaptured.update(range(self.max_sessions))
@@ -1206,24 +1521,26 @@ class BatchScheduler:
                 maxlen=self._batches.maxlen,
             )
         self._batches.append(batch)
-        for i, (s, p) in enumerate(entries):
-            p.readback = (batch, i)
-            # other riders may ALREADY be blocked on their future (their
-            # frame sat in the window when this dispatch claimed it) — a
-            # marker result wakes them into their own per-row resolve.
-            # Only the EXACT pending whose submit is running this dispatch
-            # skips the Future machinery (its fetch hasn't started yet),
-            # and even it keeps the future when a similarity-skip dup may
-            # chain off it.
+        for i, (s, plist) in enumerate(entries):
             sess = self._sessions.get(s)
-            if p is not submitter or (
-                sess is not None and sess._sim is not None
-            ):
-                try:
-                    if not p.future.cancelled():
-                        p.future.set_result((batch, i))
-                except InvalidStateError:
-                    pass
+            for fi, p in enumerate(plist):
+                sub = fi if self.fbs > 1 else None
+                p.readback = (batch, i, sub)
+                # other riders may ALREADY be blocked on their future
+                # (their frame sat in the window when this dispatch
+                # claimed it) — a marker result wakes them into their own
+                # per-row resolve.  Only the EXACT pending whose submit is
+                # running this dispatch skips the Future machinery (its
+                # fetch hasn't started yet), and even it keeps the future
+                # when a similarity-skip dup may chain off it.
+                if p is not submitter or (
+                    sess is not None and sess._sim is not None
+                ):
+                    try:
+                        if not p.future.cancelled():
+                            p.future.set_result((batch, i, sub))
+                    except InvalidStateError:
+                        pass
 
     def _resolve_row(self, batch: _DispatchedBatch, row: int, t0: float):
         """Resolve ONE rider's per-slot row of a dispatched batch.  The
@@ -1254,7 +1571,9 @@ class BatchScheduler:
                         raise
                     # host-side squeeze (free): a sliced row is
                     # [fbs=1,H,W,3], a solo batch's unsliced output is
-                    # [k=1,fbs=1,H,W,3]; the scheduler is fbs==1 only
+                    # [k=1,fbs=1,H,W,3]; with fbs>1 the row stays the
+                    # session's [fbs,H,W,3] group — each handle slices
+                    # its own frame at fetch
                     while arr.ndim > 3 and arr.shape[0] == 1:
                         arr = arr[0]
                     # D2H accounting (obs/devtel.py): exactly one note
@@ -1296,10 +1615,13 @@ class BatchScheduler:
         return out, t1
 
     def _waiting_slots(self):
+        # a slot is dispatch-ready with a FULL group queued: one frame,
+        # or fbs consecutive frames when the scheduler batches the frame
+        # axis too (a partial group keeps waiting for its tail)
         return [
             s
             for s in range(self.max_sessions)
-            if self.active[s] and self._queues[s].depth > 0
+            if self.active[s] and self._queues[s].depth >= self.fbs
         ]
 
     def _oldest_enqueue(self, waiting):
@@ -1363,9 +1685,9 @@ class BatchScheduler:
                     break
                 entries = []
                 for s in self._waiting_slots():
-                    got = self._queues[s].pop()
-                    if got is not None:
-                        entries.append((s, got[0]))
+                    plist = self._pop_group(s)
+                    if plist is not None:
+                        entries.append((s, plist))
                 if entries:
                     self._dispatch_entries_locked(entries, None)
         # drain on stop
@@ -1390,9 +1712,10 @@ class BatchScheduler:
                 hist = dict(self._occ_hist)
                 hist[occupancy] = 1
                 self._occ_hist = hist
-            for _, p in entries:
-                if p.t_dispatch is not None:
-                    self._waits.append(p.t_dispatch - p.t_enq)
+            for _, plist in entries:
+                for p in plist:
+                    if p.t_dispatch is not None:
+                        self._waits.append(p.t_dispatch - p.t_enq)
         cb = self.on_step
         if cb is not None and feed:
             # feed=False on a bucket's first use: a lazy compile may ride
@@ -1429,10 +1752,22 @@ class BatchScheduler:
             "batchsched_max_sessions": self.max_sessions,
             "batchsched_steps_total": self.steps_total,
             "batchsched_window_ms": round(1e3 * self.window_s, 3),
+            "batchsched_dp": self.dp,
+            "batchsched_fbs": self.fbs,
             "batchsched_occupancy_hist": {
                 str(k): v for k, v in sorted(self._occ_hist.items())
             },
         }
+        if self.dp > 1:
+            # per-shard live-session occupancy (_slot_shard residence —
+            # claim() balances it): the operator's view of how evenly the
+            # session axis fills the mesh; bounded keys (dp values),
+            # GIL-atomic list scan
+            hist = {str(d): 0 for d in range(self.dp)}
+            for s, live in enumerate(self.active):
+                if live:
+                    hist[str(self._slot_shard(s))] += 1
+            out["batchsched_shard_sessions"] = hist
         if occ:
             out["batchsched_occupancy_p50"] = self._percentile(occ, 0.5)
             out["batchsched_occupancy_max"] = occ[-1]

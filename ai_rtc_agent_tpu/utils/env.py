@@ -154,6 +154,42 @@ def batchsched_enabled() -> bool:
     return get_bool("BATCHSCHED", True)
 
 
+def batchsched_dp() -> int:
+    """dp shard count for the batch scheduler's session axis
+    (BATCHSCHED_DP): the stacked [S, ...] session pytree shards its
+    leading axis over a dp mesh of this many devices, so one agent
+    process serves the whole chip complement it sits on.  0/1 (default)
+    keeps the single-device scheduler.  Derived from MESH_SHAPE's dp
+    component ONLY when BATCHSCHED_DP is unset: an explicit 0/1 is the
+    per-box kill-switch back to the single-device scheduler even under
+    a fleet-wide MESH_SHAPE."""
+    if get_str("BATCHSCHED_DP") is not None:
+        return max(1, get_int("BATCHSCHED_DP", 0))
+    return max(1, mesh_shape()[0])
+
+
+def mesh_shape() -> tuple:
+    """(dp, tp, sp) serving-mesh axis sizes from MESH_SHAPE ("8,1,1" or
+    "8x1x1"; trailing axes default to 1) — the declarative alternative to
+    the --tp/--sp CLI flags that also carries the scheduler's dp axis.
+    Unset -> (1, 1, 1)."""
+    v = get_str("MESH_SHAPE")
+    if not v:
+        return (1, 1, 1)
+    parts = [p.strip() for p in v.replace("x", ",").split(",") if p.strip()]
+    if len(parts) > 3:
+        raise ValueError(
+            f"MESH_SHAPE={v!r}: at most 3 axis sizes (dp,tp,sp)"
+        )
+    try:
+        sizes = [int(p) for p in parts]
+    except ValueError as e:
+        raise ValueError(f"MESH_SHAPE={v!r} is not integer axis sizes") from e
+    if any(s < 1 for s in sizes):
+        raise ValueError(f"MESH_SHAPE={v!r}: axis sizes must be >= 1")
+    return tuple(sizes + [1] * (3 - len(sizes)))
+
+
 def perf_log_path(default: str) -> str:
     """PERF_LOG_PATH with the bench-banking semantics: unset -> the
     caller's default (the repo log); an EMPTY value -> ``""`` (banking

@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+from ai_rtc_agent_tpu.utils.perfbank import paired as _paired  # noqa: E402
 
 FRAMES = int(os.getenv("BATCHSCHED_BENCH_FRAMES") or 16)
 PAIRS = int(os.getenv("BATCHSCHED_BENCH_PAIRS") or 24)
@@ -85,9 +86,12 @@ def run() -> dict:
     engine.prepare("bench prompt", seed=0)
 
     # --- the scheduler path: 4 claimed sessions, one vmapped bucket step
+    # dp=1 explicitly: this bench IS the single-device trajectory — a
+    # BATCHSCHED_DP env leaking in must not reshard the measured path
+    # (scripts/mesh_sched_bench.py owns the sharded numbers)
     sched = BatchScheduler(
         bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
-        max_sessions=SESSIONS, prewarm=True,
+        max_sessions=SESSIONS, prewarm=True, dp=1,
     )
     sessions = [
         sched.claim(f"bench-{i}", prompt="bench prompt", seed=i)
@@ -119,27 +123,9 @@ def run() -> dict:
                 s.fetch(h)
         return (time.perf_counter() - t0) / FRAMES
 
-    # Warmup (compiles + pool growth), then MANY SHORT paired reps with
-    # the leg order alternating: this box's throughput swings up to 5x in
-    # sub-second throttle bursts, so absolute times are meaningless — but
-    # two short legs measured adjacently see the same box state, and the
-    # MEDIAN of the paired ratios converges.  Per-leg mins are reported
-    # for the absolute ms fields.
-    def _paired(leg_a, leg_b, reps: int):
-        a_times, b_times, ratios = [], [], []
-        for i in range(reps):
-            if i % 2 == 0:
-                a = leg_a()
-                b = leg_b()
-            else:
-                b = leg_b()
-                a = leg_a()
-            a_times.append(a)
-            b_times.append(b)
-            ratios.append(a / b if b > 0 else 0.0)
-        ratios.sort()
-        return min(a_times), min(b_times), ratios[len(ratios) // 2]
-
+    # Warmup (compiles + pool growth), then MANY SHORT paired reps via
+    # perfbank.paired (median-of-adjacent-ratios throttle discipline).
+    # Per-leg mins are reported for the absolute ms fields.
     serialized_rep()
     batched_rep()
     serialized_s, batched_s, amortization = _paired(

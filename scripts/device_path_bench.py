@@ -46,6 +46,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+from ai_rtc_agent_tpu.utils.perfbank import paired as _paired  # noqa: E402
 
 FRAMES = int(os.getenv("DEVPATH_BENCH_FRAMES") or 24)
 PAIRS = int(os.getenv("DEVPATH_BENCH_PAIRS") or 8)
@@ -63,24 +64,6 @@ class _TracedFrame:
 
     def to_ndarray(self, format="rgb24"):  # noqa: A002 — frame contract
         return self._arr
-
-
-def _paired(leg_a, leg_b, reps: int):
-    """Alternating paired reps; the MEDIAN of per-pair ratios survives
-    this box's sub-second throttle swings (the batch_scheduler_bench
-    estimator discipline).  -> (min_a, min_b, median a/b)."""
-    ratios = []
-    a_vals, b_vals = [], []
-    for i in range(reps):
-        if i % 2 == 0:
-            a, b = leg_a(), leg_b()
-        else:
-            b, a = leg_b(), leg_a()
-        a_vals.append(a)
-        b_vals.append(b)
-        ratios.append(a / b if b > 0 else 0.0)
-    ratios.sort()
-    return min(a_vals), min(b_vals), ratios[len(ratios) // 2]
 
 
 def _variant_fields(cfg, params) -> dict:

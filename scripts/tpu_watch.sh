@@ -255,6 +255,13 @@ while true; do
   # quant label rather than bank dense numbers on the w8 trajectory)
   run_item "batchsched_w8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= QUANT_WEIGHTS=w8 QUANT_MIN_SIZE=256 python -u scripts/batch_scheduler_bench.py
   run_item "batchsched_dc3" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= UNET_CACHE=3 python -u scripts/batch_scheduler_bench.py
+  # ISSUE 12: the session axis across chips ON HARDWARE — with
+  # JAX_PLATFORMS=tpu the bench skips its virtual-device flag, so the dp
+  # axis is the real chip complement (a v5e-8 serves 8 rows on 8 chips;
+  # the committed CPU dp8 row prices only the dispatch machinery — THESE
+  # are the accelerator trajectory, never the CPU fallback)
+  run_item "meshsched_dp8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/mesh_sched_bench.py
+  run_item "meshsched_dp8_w8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= QUANT_WEIGHTS=w8 QUANT_MIN_SIZE=256 python -u scripts/mesh_sched_bench.py
   run_item "multipeer4" 2400 python -u bench.py --config multipeer --frames 80 --peers 4
   # below-capacity occupancy: VERDICT r2 weak #5 hardware proof (1 of 8
   # claimed slots must cost ~1 peer of step time via the bucket path)

@@ -37,6 +37,17 @@ class BadScheduler:
         out.copy_to_host_async()  # BAD stray-async-d2h
         return jax.device_get(out)  # BAD stray-d2h
 
+    def _drain_sharded_assembly(self, shards, sharding, entries):
+        # the ISSUE 12 sharded spelling of the same bug: np.asarray of a
+        # mesh-sharded global array is a CROSS-SHARD gather + host drain
+        # — one session's fetch pulls every shard's bytes through host
+        frames = jax.make_array_from_single_device_arrays(
+            (8, 64, 64, 3), sharding, shards
+        )
+        host = np.asarray(frames)  # BAD batch-drain (cross-shard gather)
+        for i, (s, p) in enumerate(entries):
+            p.future.set_result(host[i])
+
     # -- clean spellings ------------------------------------------------------
 
     def ok_host_asarray(self, frame_u8):

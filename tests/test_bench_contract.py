@@ -105,9 +105,15 @@ def test_unreachable_backend_replays_committed_tpu_number(tmp_path):
     assert d["stage_ms"]["compute"] == 20.0 and d["mfu"] == 0.21
 
 
+@pytest.mark.slow
 def test_contract_line_happy_path_tiny():
     """The full bench pipeline on the hermetic tiny model emits exactly one
-    well-formed contract line with a positive fps and stage breakdown."""
+    well-formed contract line with a positive fps and stage breakdown.
+
+    `slow` tier (ISSUE 12 budget satellite, ~50s of live tiny-bench):
+    the contract MACHINERY keeps tier-1 teeth via the refusal/replay/
+    fence tests in this file, and the live-bench smoke shape is the same
+    one the (also slow-tier) batchsched/meshsched smokes exercise."""
     r = _run_bench(
         {"JAX_PLATFORMS": "cpu"},
         args=("--frames", "4", "--probe-timeout", "120"),
@@ -183,13 +189,19 @@ def test_replay_prefers_same_variant_then_falls_back_labeled(tmp_path):
     assert d["value"] == 29.0 and d["attn_impl"] == "pallas"
 
 
+@pytest.mark.slow
 def test_bench_yields_to_watcher_item_lock(tmp_path):
     """Coordination: with a LIVE watcher pid and a fresh item lock, the
     non-watcher bench writes the stop file and waits for the lock's
     release before claiming; the watcher's own items (TPU_WATCH_OWNER=1)
     skip coordination entirely.  Deterministic: the lock is released only
     AFTER the bench's stop file appears, so subprocess startup time can't
-    race the release."""
+    race the release.
+
+    `slow` tier (ISSUE 12 budget satellite, ~14s): the OTHER half of the
+    watcher-lock contract — refusing to double-claim an unreleased lock
+    — stays tier-1 (test_bench_refuses_to_contend_with_unreleased_claim),
+    which is the wedge mode with teeth."""
     import threading
     import time as _time
 
@@ -540,6 +552,51 @@ def test_batch_scheduler_bench_contract(tmp_path):
     assert d["fingerprint"]["device_count"] >= 1
     banked = [json.loads(x) for x in log.read_text().splitlines()]
     assert banked and banked[-1]["metric"] == "batchsched_amortization_2s"
+
+
+@pytest.mark.slow
+def test_mesh_sched_bench_contract(tmp_path):
+    """Mesh-sharded scheduler amortization smoke (ISSUE 12): emits
+    exactly one contract line with the dp/session labels + fingerprint
+    and BANKS it.  Runs at dp=2 (two virtual devices — two bucket
+    prewarms per scheduler instead of eight); `slow` tier like its
+    batchsched sibling.  No ratio floor on this 2-core box: virtual
+    devices oversubscribe the host so the honest CPU value is <1 (the
+    committed dp8 PERF_LOG row + perf_compare fence carry the
+    trajectory; the TPU watcher row is the accelerator truth) — what
+    this smoke pins is the contract shape and that the sharded path
+    serves at all under the bench harness."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.pop("XLA_FLAGS", None)  # the bench forces its own device flag
+    env.update(
+        {
+            "PERF_LOG_PATH": str(log),
+            "MESHSCHED_BENCH_FRAMES": "4",
+            "MESHSCHED_BENCH_PAIRS": "3",
+            "MESHSCHED_BENCH_SESSIONS": "2",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/mesh_sched_bench.py"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "meshsched_amortization_dp2"
+    assert d["sessions"] == 2 and d["dp"] == 2
+    assert d["value"] > 0, d
+    assert d["fingerprint"]["jax_backend"] == "cpu"
+    assert d["fingerprint"]["device_count"] == 2
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "meshsched_amortization_dp2"
 
 
 # -- perf_compare.py: the trajectory fence (ISSUE 8) -------------------------

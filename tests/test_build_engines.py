@@ -7,7 +7,13 @@ import pytest
 from ai_rtc_agent_tpu.assets.build_engines import build
 
 
+@pytest.mark.slow
 def test_build_engine_tiny(tmp_path, monkeypatch):
+    """`slow` tier (ISSUE 12 budget satellite, ~16s of CLI build): the
+    serving-side adoption of a prebuilt engine stays tier-1
+    (test_serving_adopts_prebuilt_engine), as do the EngineCache
+    build/load/donation pins in tests/test_aot_cache.py — this is the
+    CLI-driver composition over the same machinery."""
     (key,), _ = build("tiny-test", cache_dir=str(tmp_path))
     d = os.path.join(tmp_path, key)
     assert os.path.isdir(d)

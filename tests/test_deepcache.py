@@ -237,11 +237,19 @@ def test_aot_pair_build_and_fresh_adoption(tmp_path):
     assert eng2._tick == 3
 
 
+@pytest.mark.slow
 def test_multipeer_global_cadence():
     """Multipeer + DeepCache: one GLOBAL cadence for all slots (the vmapped
     step applies one graph to every slot anyway); buckets now COMPOSE with
     the cache (VERDICT r3 item 7); a connect resets the cadence so a fresh
-    slot's zeroed cache is never consumed before its first capture."""
+    slot's zeroed cache is never consumed before its first capture.
+
+    `slow` tier (ISSUE 12 budget satellite, ~15s of capture+cached
+    compiles): the global-cadence semantics keep lighter tier-1 siblings
+    — the engine-level cadence pin (test_engine_cadence_and_flops), the
+    scheduler's uncaptured-rider forcing (test_batch_scheduler) and the
+    equivalence driver's DC leg (bit-exact through the same global-tick
+    discipline this test exercises on the multipeer tier)."""
     from ai_rtc_agent_tpu.models import registry
     from ai_rtc_agent_tpu.parallel.multipeer import MultiPeerEngine
 

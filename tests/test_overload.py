@@ -833,6 +833,39 @@ def test_shed_frames_do_not_feed_admission_ewma():
         rp.close()
 
 
+def test_shed_in_batch_delivers_passthrough_per_position():
+    """ISSUE 12 review finding: routing fbs>1 through the BatchScheduler
+    made a per-position batch shed reachable (the scheduler's bounded
+    window can evict part of a group).  The batched wrapper must deliver
+    source pixels for the shed position, the stepped output for the
+    rest, and feed only the stepped frames to the counters — a raw
+    ShedFrame object must never escape toward the encoder."""
+    from ai_rtc_agent_tpu.resilience.overload import ShedFrame
+
+    class _PartialShedInner:
+        def __call__(self, frame):
+            raise AssertionError("batched surface expected")
+
+        def submit_batch(self, frames):
+            return list(frames)
+
+        def fetch_batch(self, handles, src_frames=None):
+            return ["out0", ShedFrame(handles[1])]
+
+    clock = Clock()
+    sup = SessionSupervisor("s", clock=clock, sleep=lambda s: None)
+    rp = ResilientPipeline(_PartialShedInner(), sup, warm_steps=0)
+    ladder, adm = _ladder(clock=clock)
+    rp.throttle = ladder
+    try:
+        outs = rp.fetch_batch(rp.submit_batch(["f0", "f1"]), ["s0", "s1"])
+        assert outs == ["out0", "s1"]
+        assert sup.passthrough_frames == 1
+        assert sup.processed_frames == 1
+    finally:
+        rp.close()
+
+
 def test_shed_marker_sync_path_delivers_passthrough():
     """Same invariant on the sync (depth-1) surface: __call__ returning a
     ShedFrame marker must deliver passthrough and feed neither the step

@@ -25,6 +25,51 @@ def test_make_mesh_shapes():
         M.make_mesh(dp=16)
 
 
+def test_session_axis_rules_and_knobs(monkeypatch):
+    """ISSUE 12 units: the session-axis sharding recipe (shared by the
+    dp scheduler and multipeer) and the MESH_SHAPE/BATCHSCHED_DP knob
+    parsing — all compile-free."""
+    from ai_rtc_agent_tpu.utils import env
+
+    m = M.make_mesh(dp=4)
+    assert SH.session_axis_spec(m) == P("dp")
+    repl, row = SH.session_shardings(m)
+    assert repl.spec == P() and row.spec == P("dp")
+    devs = SH.dp_devices(m)
+    assert len(devs) == 4 and len(set(devs)) == 4
+    # shard d of a leading-axis sharded array lives on dp_devices[d]
+    arr = jax.device_put(jnp.arange(8.0), row)
+    by_start = {
+        (s.index[0].start or 0): next(iter(s.data.devices()))
+        for s in arr.addressable_shards
+    }
+    assert [by_start[i * 2] for i in range(4)] == devs
+    # a trivial axis replicates (the single-device scheduler unchanged)
+    assert SH.session_axis_spec(M.make_mesh(tp=2)) == P()
+
+    # knob parsing: MESH_SHAPE feeds dp when BATCHSCHED_DP is unset
+    monkeypatch.delenv("BATCHSCHED_DP", raising=False)
+    monkeypatch.setenv("MESH_SHAPE", "8,1,1")
+    assert env.mesh_shape() == (8, 1, 1)
+    assert env.batchsched_dp() == 8
+    monkeypatch.setenv("MESH_SHAPE", "4x2")
+    assert env.mesh_shape() == (4, 2, 1)
+    monkeypatch.setenv("BATCHSCHED_DP", "2")
+    assert env.batchsched_dp() == 2  # explicit knob wins
+    # explicit 0 is the per-box kill-switch even under a fleet MESH_SHAPE
+    monkeypatch.setenv("MESH_SHAPE", "8,1,1")
+    monkeypatch.setenv("BATCHSCHED_DP", "0")
+    assert env.batchsched_dp() == 1
+    monkeypatch.delenv("MESH_SHAPE")
+    assert env.batchsched_dp() == 1  # off -> single-device
+    monkeypatch.setenv("MESH_SHAPE", "bogus")
+    with pytest.raises(ValueError):
+        env.mesh_shape()
+    monkeypatch.setenv("MESH_SHAPE", "1,2,3,4")
+    with pytest.raises(ValueError):
+        env.mesh_shape()
+
+
 def test_collectives_in_shard_map(rng):
     from functools import partial
     from jax.experimental.shard_map import shard_map

@@ -166,10 +166,13 @@ def test_device_transfer_catches_drains_and_stray_puts():
     assert "BadScheduler._drain_via_alias" in scopes  # fn = self._step_cached
     assert "BadScheduler._stage" in scopes  # bare device_put
     assert "BadScheduler._pull" in scopes  # copy_to_host_async + device_get
+    # ISSUE 12: np.asarray of a mesh-sharded global array (the assembled
+    # frame batch / a sharded step output) is a cross-shard gather drain
+    assert "BadScheduler._drain_sharded_assembly" in scopes
     assert "stray H2D" in msgs and "stray D2H" in msgs
     src = (FIXTURES / "device_transfers_bad.py").read_text().splitlines()
     flagged = {src[f.line - 1].strip() for f in fs}
-    assert len(fs) == 6, "\n".join(f.render() for f in fs)
+    assert len(fs) == 7, "\n".join(f.render() for f in fs)
     assert all("# BAD" in s for s in flagged), flagged
     assert not any(s.startswith("BadScheduler.ok_") for s in scopes), scopes
 
