@@ -18,10 +18,16 @@ the tier that joins N such processes into one serving surface:
   recycling via the admission-freeze rung, re-points a dead agent's
   clients through the existing webhook path, and serves a fleet-rollup
   ``/metrics`` (JSON + Prometheus exposition) aggregated across agents.
+* :mod:`~ai_rtc_agent_tpu.fleet.journey` — cross-process trace
+  correlation: one ``journey_id`` minted at placement and threaded
+  through every hop (router ring, agent flight recorder, webhooks),
+  with agent-side evidence auto-captured on the alert paths and
+  one-GET incident bundles at ``GET /fleet/debug/journey/<id>``.
 
 Architecture + runbook: docs/fleet.md.
 """
 
+from .journey import JourneyLog
 from .registry import AGENT_STATES, AgentRecord, FleetPoller, FleetRegistry
 from .router import build_router_app
 
@@ -30,5 +36,6 @@ __all__ = [
     "AgentRecord",
     "FleetPoller",
     "FleetRegistry",
+    "JourneyLog",
     "build_router_app",
 ]

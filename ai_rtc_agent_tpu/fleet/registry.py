@@ -260,11 +260,14 @@ class FleetRegistry:
             except Exception:
                 logger.exception("fleet on_dead handler failed")
 
-    def ingest_event(self, event: dict, agent_id: str | None):
+    def ingest_event(self, event: dict, agent_id: str | None) -> str | None:
         """One webhook volley from an agent (StreamDegraded family).
         ``agent_id`` is the owner resolved from the router's session
         table (None when unattributable, e.g. a RETRACE_BREACH's
-        synthetic stream id) — the event still counts in the rollup."""
+        synthetic stream id) — the event still counts in the rollup.
+        Returns the breach state when the volley was one (the router's
+        journey plane auto-captures evidence on exactly that signal),
+        else None."""
         self._count("fleet_events_ingested")
         state = str(event.get("state", ""))
         if event.get("event") == "StreamDegraded" and state in BREACH_STATES:
@@ -273,6 +276,8 @@ class FleetRegistry:
             if rec is not None and rec.state == "HEALTHY":
                 # accelerate: the next poll confirms or clears this
                 rec.state = "DEGRADED"
+            return state
+        return None
 
     # -- placement ------------------------------------------------------------
 

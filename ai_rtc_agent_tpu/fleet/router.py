@@ -52,14 +52,17 @@ from aiohttp import web
 from ..server.events import StreamEventHandler
 from ..utils import env
 from ..utils.profiling import FrameStats
+from .journey import JourneyLog
 from .registry import FleetPoller, FleetRegistry
 
 logger = logging.getLogger(__name__)
 
 # response headers worth carrying back through the proxy verbatim
 # (X-Stream-Id included: a client can only act on an AGENT_DEAD webhook
-# if it knows which stream id was ITS session)
-_PASS_HEADERS = ("Content-Type", "Location", "Retry-After", "X-Stream-Id")
+# if it knows which stream id was ITS session; X-Journey-Id/-Leg are the
+# cross-process correlation key the client echoes on a re-offer)
+_PASS_HEADERS = ("Content-Type", "Location", "Retry-After", "X-Stream-Id",
+                 "X-Journey-Id", "X-Journey-Leg")
 
 
 def _parse_retry_after(value: str | None) -> float | None:
@@ -91,13 +94,14 @@ class _SessionTable:
         self.evicted = 0
 
     def remember(self, stream_id: str, agent_id: str, room_id: str,
-                 kind: str):
+                 kind: str, journey_id: str | None = None, leg: int = 1):
         self._m.pop(stream_id, None)
         while len(self._m) >= self.bound:
             self._m.pop(next(iter(self._m)))
             self.evicted += 1
         self._m[stream_id] = {
-            "agent": agent_id, "room_id": room_id, "kind": kind
+            "agent": agent_id, "room_id": room_id, "kind": kind,
+            "journey_id": journey_id, "leg": leg,
         }
 
     def owner(self, stream_id: str) -> str | None:
@@ -141,6 +145,25 @@ async def _place_and_proxy(request: web.Request, path: str,
         except (ValueError, AttributeError, UnicodeDecodeError):
             room_id = ""
 
+    # journey correlation (fleet/journey.py): mint one id per placed
+    # session, or — when the client echoes a KNOWN X-Journey-Id (the
+    # crash-replacement re-offer, taught by the AGENT_DEAD webhook) —
+    # continue that journey with an incremented leg so the survivor's
+    # records join the dead agent's.  An unknown echoed id is ignored
+    # (a client cannot graft itself onto ring state it never had).
+    journeys: JourneyLog | None = app["journeys"]
+    journey_id = None
+    leg = 1
+    if journeys is not None:
+        echoed = request.headers.get("X-Journey-Id")
+        if journeys.known(echoed):
+            journey_id = echoed
+            leg = journeys.next_leg(echoed)
+        else:
+            journey_id = journeys.mint()
+        headers["X-Journey-Id"] = journey_id
+        headers["X-Journey-Leg"] = str(leg)
+
     tried: set = set()
     hint: float | None = None
     for _ in range(app["place_attempts"]):
@@ -164,6 +187,14 @@ async def _place_and_proxy(request: web.Request, path: str,
                     rec.backoff(ra, reg.now())
                     hint = ra if hint is None else min(hint, ra)
                     stats.count("fleet_placement_retries")
+                    if journeys is not None:
+                        # a continuation's refusal belongs in its ring
+                        # (fresh journeys have no record yet — minted
+                        # ids only materialize at a placement)
+                        journeys.note(
+                            journey_id, "agent_503",
+                            agent=rec.agent_id, retry_after=ra,
+                        )
                     continue
                 if 200 <= resp.status < 300:
                     reg.note_placed(rec)
@@ -172,12 +203,27 @@ async def _place_and_proxy(request: web.Request, path: str,
                     )
                     if sid:
                         app["session_table"].remember(
-                            sid, rec.agent_id, room_id, kind
+                            sid, rec.agent_id, room_id, kind,
+                            journey_id=journey_id, leg=leg,
                         )
+                        if journeys is not None:
+                            # the SAME leg number the agent was told in
+                            # the forwarded header — concurrent
+                            # re-offers or a table eviction racing the
+                            # proxy await must not desync the record
+                            # from the agent-side recorder bindings
+                            journeys.place(
+                                journey_id, rec.agent_id, sid, kind,
+                                room_id, retried=len(tried) - 1, leg=leg,
+                            )
                 out_headers = {
                     k: resp.headers[k]
                     for k in _PASS_HEADERS if k in resp.headers
                 }
+                if journey_id is not None and 200 <= resp.status < 300:
+                    # stamp even when the agent tier predates the echo
+                    out_headers.setdefault("X-Journey-Id", journey_id)
+                    out_headers.setdefault("X-Journey-Leg", str(leg))
                 return web.Response(
                     status=resp.status, body=payload, headers=out_headers
                 )
@@ -188,6 +234,8 @@ async def _place_and_proxy(request: web.Request, path: str,
             reg.note_poll_fail(rec)
             continue
     stats.count("fleet_rejects")
+    if journeys is not None and journeys.known(journey_id):
+        journeys.note(journey_id, "rejected")
     retry = hint if hint is not None else reg.retry_after_hint(
         app["retry_after_s"]
     )
@@ -287,13 +335,125 @@ async def fleet_events(request):
         return web.Response(status=400, text="event must be an object")
     stream_id = str(event.get("stream_id", ""))
     agent_id = request.app["session_table"].owner(stream_id)
-    request.app["fleet"].ingest_event(event, agent_id)
+    breach_state = request.app["fleet"].ingest_event(event, agent_id)
+    _journey_ingest(request.app, event, stream_id, agent_id, breach_state)
     if event.get("event") == "StreamEnded":
         # the session is gone on the agent — keeping the mapping would
         # send spurious AGENT_DEAD re-points to long-idle clients and
         # crowd live sessions out of the bounded table under churn
         request.app["session_table"].forget(stream_id)
     return web.Response(text="OK")
+
+
+def _journey_ingest(app, event: dict, stream_id: str,
+                    agent_id: str | None, breach_state: str | None):
+    """Thread one ingested webhook into the journey ring — and on a
+    breach volley, auto-capture the owning agent's evidence NOW (the
+    one moment the records are guaranteed still alive; an agent that
+    later dies by SIGKILL gives no second chance)."""
+    journeys: JourneyLog | None = app["journeys"]
+    if journeys is None:
+        return
+    # the webhook carries the journey id once the agent tier threads it;
+    # the session table resolves legacy payloads
+    jid = str(event.get("journey_id") or "") or journeys.journey_for_stream(
+        stream_id
+    )
+    if not journeys.known(jid):
+        return
+    name = event.get("event")
+    if name == "StreamStarted":
+        journeys.note_started(stream_id)
+        return
+    if name == "StreamEnded":
+        journeys.end_stream(stream_id)
+        return
+    if breach_state is not None:
+        journeys.note(jid, "degraded", state=breach_state,
+                      stream_id=stream_id)
+        # session-table attribution first; the journey's own last leg is
+        # the authoritative fallback (a long-lived stream can have been
+        # evicted from the bounded table — its breach must still capture)
+        owner = agent_id or journeys.last_agent(jid)
+        if owner is not None:
+            _capture_evidence(
+                app, jid, owner, seal_reason=f"breach {breach_state}"
+            )
+
+
+async def _pull_fragment(app, rec, journey_id: str):
+    """ONE pull of an agent's ``/debug/flight?journey=`` fragment —
+    the single spelling of the evidence-pull protocol shared by the
+    breach-path capture and the bundle endpoint's live fan-out.
+    -> (fragment dict | None, error string | None); a 404 is (None,
+    None): the agent holds no records for this journey."""
+    import aiohttp
+
+    try:
+        async with app["http"].get(
+            rec.base_url + "/debug/flight", params={"journey": journey_id}
+        ) as resp:
+            if resp.status == 200:
+                body = await resp.json()
+                if isinstance(body, dict):
+                    return body, None
+                return None, "non-object fragment body"
+            if resp.status == 404:
+                return None, None
+            return None, f"HTTP {resp.status}"
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            ValueError) as e:
+        return None, str(e)
+
+
+def _capture_evidence(app, journey_id: str, agent_id: str,
+                      seal_reason: str | None = None):
+    """Pull the agent's journey fragment into the evidence store
+    (fire-and-forget task, bounded in-flight set), then optionally seal
+    an incident bundle so the evidence survives even the bounded
+    evidence ring's later churn."""
+    tasks: set = app["journey_tasks"]
+    inflight: set = app["journey_inflight"]
+    key = (journey_id, agent_id)
+    if key in inflight or len(tasks) >= 16:
+        # a breach volley's duplicate pulls (DEGRADED→FAILED→SLO within
+        # ms) and capture storms must not fan out redundant HTTP — near-
+        # identical fragments would churn the bounded evidence ring out
+        # of its DISTINCT captures.  The seal is cheap local work
+        # though: freeze the bundle from whatever is banked rather than
+        # losing the incident.
+        journeys: JourneyLog | None = app["journeys"]
+        if seal_reason is not None and journeys is not None:
+            journeys.seal_bundle(journey_id, seal_reason)
+        return
+
+    async def run():
+        journeys: JourneyLog | None = app["journeys"]
+        if journeys is None:
+            return
+        rec = app["fleet"].agents.get(agent_id)
+        if rec is not None and rec.state != "DEAD":
+            fragment, err = await _pull_fragment(app, rec, journey_id)
+            if fragment is not None:
+                journeys.add_evidence(journey_id, agent_id, fragment)
+            elif err is not None:
+                logger.debug("evidence pull from %s failed: %s",
+                             agent_id, err)
+        # seal even when the pull was impossible (record gone, agent
+        # DEAD by the time the task ran): an incident with only banked
+        # evidence still beats an incident with no bundle at all
+        if seal_reason is not None:
+            journeys.seal_bundle(journey_id, seal_reason)
+
+    inflight.add(key)
+    task = asyncio.get_running_loop().create_task(run())
+    tasks.add(task)
+
+    def _done(t, key=key):
+        tasks.discard(t)
+        inflight.discard(key)
+
+    task.add_done_callback(_done)
 
 
 async def fleet_drain(request):
@@ -369,6 +529,155 @@ async def health(_):
     return web.Response(content_type="application/json", text="OK")
 
 
+async def journey_index(request):
+    """``GET /fleet/debug/journeys``: the directory of tracked journeys
+    + sealed incident bundles (JSON only — journey identity never
+    becomes a /metrics label)."""
+    journeys: JourneyLog | None = request.app["journeys"]
+    if journeys is None:
+        return web.json_response(
+            {"error": "journey plane disabled (JOURNEY_ENABLE=0)"},
+            status=404,
+        )
+    return web.json_response(journeys.index())
+
+
+async def journey_bundle(request):
+    """``GET /fleet/debug/journey/<id>``: ONE incident bundle for the
+    whole cross-process session journey —
+
+    * the router's journey ring (placed → degraded → agent_dead →
+      re_placed → …, wall-clock stamped),
+    * evidence captured from agents on the alert paths (flight
+      snapshots + timelines + devtel compiles, surviving dead agents),
+    * a LIVE fan-out over every agent that served any leg, pulling its
+      current ``/debug/flight?journey=`` fragment, and
+    * the sealed bundles the alert paths froze.
+
+    ``?format=chrome`` merges every captured leg into a single Perfetto
+    trace with per-agent process ids (obs/export.py)."""
+    app = request.app
+    journeys: JourneyLog | None = app["journeys"]
+    if journeys is None:
+        return web.json_response(
+            {"error": "journey plane disabled (JOURNEY_ENABLE=0)"},
+            status=404,
+        )
+    unknown = sorted(k for k in request.query if k != "format")
+    if unknown:
+        # a tooling URL with a mistyped param must fail loudly, not
+        # quietly serve the unfiltered bundle as if the filter applied
+        return web.json_response(
+            {"error": f"unknown query param(s): {', '.join(unknown)}"},
+            status=400,
+        )
+    fmt = request.query.get("format", "json")
+    if fmt not in ("json", "chrome"):
+        return web.json_response(
+            {"error": f"unknown format {fmt!r}"}, status=400
+        )
+    jid = request.match_info["id"]
+    record = journeys.get(jid)
+    if record is None:
+        return web.json_response(
+            {"error": f"unknown journey {jid!r}"}, status=404
+        )
+
+    # live fan-out over the agents that served any leg (the DEAD ones
+    # are exactly what the evidence store exists for) — pulls run
+    # CONCURRENTLY: an incident GET must not serialize N slow agents'
+    # timeouts exactly when the operator is debugging
+    fragments = []
+    seen_agents = []
+    for leg in record["legs"]:
+        if leg["agent"] not in seen_agents:
+            seen_agents.append(leg["agent"])
+    live_recs = []
+    for agent_id in seen_agents:
+        rec = app["fleet"].agents.get(agent_id)
+        if rec is None or rec.state == "DEAD":
+            fragments.append({
+                "source": "unreachable", "agent": agent_id,
+                "state": rec.state if rec is not None else "unknown",
+            })
+        else:
+            live_recs.append((agent_id, rec))
+    if live_recs:
+        pulls = await asyncio.gather(*[
+            _pull_fragment(app, rec, jid) for _aid, rec in live_recs
+        ])
+        for (agent_id, _rec), (fragment, err) in zip(live_recs, pulls):
+            if fragment is not None:
+                # the router's registry id is authoritative — spread
+                # FIRST so the agent's self-reported "agent" (WORKER_ID,
+                # possibly unset/divergent) cannot overwrite it and
+                # desync the chrome-merge dedup keys from the evidence
+                # entries keyed by the same id
+                fragments.append(
+                    {**fragment, "source": "live", "agent": agent_id}
+                )
+            elif err is not None:
+                fragments.append({
+                    "source": "error", "agent": agent_id, "error": err,
+                })
+            # (None, None): the agent holds no records for this journey
+    bundle = {
+        "journey_id": jid,
+        "journey": record,
+        "fragments": fragments,
+        "evidence": journeys.evidence_for(jid),
+        "bundles": journeys.bundles_for(jid),
+    }
+    if fmt == "chrome":
+        from ..obs.export import merge_chrome_traces
+
+        sources = _chrome_sources(bundle)
+        if not sources:
+            return web.json_response(
+                {"error": f"no captures recorded for journey {jid!r}"},
+                status=404,
+            )
+        return web.json_response(merge_chrome_traces(sources, journey=jid))
+    return web.json_response(bundle)
+
+
+def _chrome_sources(bundle: dict) -> list:
+    """Collect every captured snapshot in the bundle as
+    ``(snapshot, meta)`` merge sources — evidence first (it may be all
+    that survives a corpse), then live fragments, deduplicated by
+    (agent, capture identity)."""
+    sources: list = []
+    seen: set = set()
+
+    def add(agent: str, snap):
+        if not isinstance(snap, dict):
+            return
+        key = (agent, snap.get("id")
+               or (snap.get("session"), snap.get("taken_at")))
+        if key in seen:
+            return
+        seen.add(key)
+        meta = dict(snap.get("journey") or {})
+        meta.setdefault("agent", agent)
+        sources.append((snap, meta))
+
+    def add_fragment(agent: str, frag: dict):
+        for snap in frag.get("snapshots") or []:
+            add(agent, snap)
+        for snap in (frag.get("sessions") or {}).values():
+            add(agent, snap)
+
+    for sealed in bundle.get("bundles", []):
+        for ev in sealed.get("evidence", []):
+            add_fragment(ev.get("agent", ""), ev.get("fragment") or {})
+    for ev in bundle.get("evidence", []):
+        add_fragment(ev.get("agent", ""), ev.get("fragment") or {})
+    for frag in bundle.get("fragments", []):
+        if frag.get("source") == "live":
+            add_fragment(frag.get("agent", ""), frag)
+    return sources
+
+
 async def metrics(request):
     """Fleet rollup: counters from the router's FrameStats plus the
     registry's aggregate gauges.  Aggregated across agents by
@@ -379,6 +688,11 @@ async def metrics(request):
     out.update(app["fleet"].snapshot())
     out["fleet_sessions_tracked"] = len(app["session_table"])
     out["fleet_session_table_evicted"] = app["session_table"].evicted
+    if app["journeys"] is not None:
+        # journey rollup (fleet/journey.py): aggregate counters + the
+        # placement→first-frame percentiles — the journey id itself is
+        # never a label (metric-cardinality discipline)
+        out.update(app["journeys"].snapshot())
     fmt = request.query.get("format", "json")
     if fmt == "prom":
         from ..obs.promexport import CONTENT_TYPE, render
@@ -405,14 +719,25 @@ def _on_agent_dead(app):
     def on_dead(rec):
         handler: StreamEventHandler = app["fleet_events"]
         stats: FrameStats = app["stats"]
+        journeys: JourneyLog | None = app["journeys"]
         for sid, entry in app["session_table"].pop_agent_sessions(
             rec.agent_id
         ):
             stats.count("fleet_sessions_repointed")
+            journey = None
+            jid = entry.get("journey_id")
+            if journeys is not None and journeys.known(jid):
+                journeys.note(jid, "agent_dead", agent=rec.agent_id,
+                              stream_id=sid)
+                # seal NOW: the corpse answers no more pulls, so the
+                # bundle is whatever evidence the breach path banked
+                journeys.seal_bundle(jid, f"AGENT_DEAD {rec.agent_id}")
+                journey = {"journey_id": jid, "leg": entry.get("leg", 1)}
             handler.handle_session_state(
                 sid, entry.get("room_id", ""), "AGENT_DEAD",
                 f"agent {rec.agent_id} is unreachable — re-offer through "
                 f"the router to land on a replacement",
+                journey=journey,
             )
 
     return on_dead
@@ -433,6 +758,14 @@ async def _on_cleanup(app):
     poller = app.get("poller")
     if poller is not None:
         await poller.stop()
+    # cancel pending evidence pulls BEFORE closing their shared session
+    # — a queued task touching a closed ClientSession dies with an
+    # unretrieved RuntimeError instead of a clean cancellation
+    tasks = list(app.get("journey_tasks", ()))
+    for task in tasks:
+        task.cancel()
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
     http = app.get("http")
     if http is not None:
         await http.close()
@@ -459,6 +792,13 @@ def build_router_app(
     if app["fleet"].stats is None:
         app["fleet"].stats = app["stats"]
     app["fleet_events"] = events_handler or StreamEventHandler()
+    # journey plane (fleet/journey.py): JOURNEY_ENABLE=0 removes it —
+    # no ids minted/forwarded, the debug endpoints 404
+    app["journeys"] = (
+        JourneyLog(stats=app["stats"]) if env.journey_enabled() else None
+    )
+    app["journey_tasks"] = set()
+    app["journey_inflight"] = set()  # (journey_id, agent_id) pull dedup
     app["fleet"].on_dead = _on_agent_dead(app)
 
     app.on_startup.append(_on_startup)
@@ -473,6 +813,8 @@ def build_router_app(
     app.router.add_post("/fleet/events", fleet_events)
     app.router.add_post("/fleet/drain", fleet_drain)
     app.router.add_get("/fleet/health", fleet_health)
+    app.router.add_get("/fleet/debug/journeys", journey_index)
+    app.router.add_get("/fleet/debug/journey/{id}", journey_bundle)
     app.router.add_get("/", health)
     app.router.add_get("/metrics", metrics)
     return app

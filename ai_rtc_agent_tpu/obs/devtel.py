@@ -322,6 +322,14 @@ class DevTelPlane:
             "recent_compiles": safe_list(self.compiles)[-8:],
         }
 
+    def fragment(self) -> dict:
+        """The incident-bundle rendering (``/debug/flight?journey=``):
+        the /health view plus the breach that fired, so a merged fleet
+        bundle explains a frozen leg without a second pull — composed
+        from health() so new watchdog fields can never drift out of
+        the bundle."""
+        return {**self.health(), "last_breach": self.last_breach}
+
 
 # ---------------------------------------------------------------------------
 # module-level dispatch: ONE forwarding jax.monitoring listener (listeners

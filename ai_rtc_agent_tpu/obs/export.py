@@ -3,7 +3,11 @@
 ``to_chrome_trace`` turns a snapshot (obs/recorder.py) into the Trace
 Event Format that Perfetto and ``chrome://tracing`` load directly:
 
-* one *process* per capture (pid 1, named after the session),
+* one *process* per capture (named after the session — and, when the
+  capture is bound to a fleet journey, stamped with the
+  journey/agent/leg so merged multi-agent exports stay
+  distinguishable; :func:`merge_chrome_traces` renders each source
+  under its own pid),
 * one *track* (tid) per pipeline stage (obs/trace.py ``STAGES``) — spans
   that overlap within a stage (pipelined serving keeps several frames in
   flight) are spilled onto ``<stage> #2``-style overflow lanes so every
@@ -57,14 +61,39 @@ def _lane_out(spans):
     return out, len(lanes)
 
 
-def to_chrome_trace(snapshot: dict) -> dict:
-    """Snapshot -> ``{"traceEvents": [...]}`` (Perfetto-loadable)."""
-    pid = 1
+def to_chrome_trace(snapshot: dict, pid: int = 1,
+                    meta: dict | None = None) -> dict:
+    """Snapshot -> ``{"traceEvents": [...]}`` (Perfetto-loadable).
+
+    ``pid``/``meta`` serve the multi-source merge
+    (:func:`merge_chrome_traces`): each source renders under its own
+    process id, and the journey/agent/leg metadata
+    (``{"journey_id", "agent", "leg"}`` — defaulting to the snapshot's
+    own ``journey`` binding) is stamped into the process-name metadata
+    event and every span/instant's ``args`` so merged multi-agent
+    exports stay distinguishable inside Perfetto."""
     session = snapshot.get("session", "?")
+    if meta is None:
+        meta = snapshot.get("journey") or None
+    proc_name = f"session {session}"
+    stamp: dict = {}
+    if meta:
+        stamp = {
+            k: v for k, v in (
+                ("journey_id", meta.get("journey_id")),
+                ("agent", meta.get("agent")),
+                ("leg", meta.get("leg")),
+            ) if v not in (None, "")
+        }
+        label = " ".join(
+            f"{k.replace('_id', '')} {v}" for k, v in stamp.items()
+        )
+        if label:
+            proc_name = f"{label} session {session}"
     events: list = [
         {
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-            "args": {"name": f"session {session}"},
+            "args": {"name": proc_name, **stamp},
         },
         {
             "ph": "M", "name": "thread_name", "pid": pid, "tid": _EVENTS_TID,
@@ -131,7 +160,7 @@ def to_chrome_trace(snapshot: dict) -> dict:
                 "ph": "X", "name": stage, "cat": "frame", "pid": pid,
                 "tid": lane_tid[lane],
                 "ts": us(t0), "dur": max(0.0, round(1e6 * (t1 - t0), 1)),
-                "args": {"frame_id": fid},
+                "args": {"frame_id": fid, **stamp},
             })
 
     # frame marks (terminal markers, similarity skips, ingest sheds)
@@ -141,7 +170,8 @@ def to_chrome_trace(snapshot: dict) -> dict:
             events.append({
                 "ph": "i", "s": "t", "name": name, "cat": "lifecycle",
                 "pid": pid, "tid": _LIFECYCLE_TID, "ts": us(t),
-                "args": {"frame_id": fid, "terminal": fr.get("terminal")},
+                "args": {"frame_id": fid, "terminal": fr.get("terminal"),
+                         **stamp},
             })
 
     # event log (supervisor/overload/restart/webhook) as instants
@@ -151,7 +181,8 @@ def to_chrome_trace(snapshot: dict) -> dict:
         kind = ev.pop("kind", "event")
         events.append({
             "ph": "i", "s": "p", "name": kind, "cat": "resilience",
-            "pid": pid, "tid": _EVENTS_TID, "ts": us(t), "args": ev,
+            "pid": pid, "tid": _EVENTS_TID, "ts": us(t),
+            "args": {**ev, **stamp},
         })
 
     return {
@@ -161,6 +192,45 @@ def to_chrome_trace(snapshot: dict) -> dict:
             "session": session,
             "reason": snapshot.get("reason"),
             "snapshot_id": snapshot.get("id"),
+            **stamp,
+        },
+    }
+
+
+def merge_chrome_traces(sources, journey: str | None = None) -> dict:
+    """Merge several flight-recorder captures — typically one per leg of
+    a fleet journey, pulled from different agent processes — into ONE
+    Perfetto-loadable document.
+
+    ``sources``: iterable of ``(snapshot, meta)`` where ``meta`` is the
+    ``{"journey_id", "agent", "leg"}`` stamp (falls back to the
+    snapshot's own ``journey`` binding).  Each source renders under its
+    own process id, so two agents' identically-named stage tracks can
+    never collide; within a source the per-stage lane discipline of
+    :func:`to_chrome_trace` holds unchanged.
+
+    Time bases are per-source: every process's monotonic clock is
+    normalized to start near 0 (cross-host clocks do not line up; the
+    journey ring's wall-clock stamps in the JSON bundle give the
+    absolute ordering)."""
+    events: list = []
+    rendered = []
+    for i, (snapshot, meta) in enumerate(sources):
+        doc = to_chrome_trace(snapshot, pid=i + 1, meta=meta)
+        events.extend(doc["traceEvents"])
+        rendered.append({
+            "pid": i + 1,
+            "session": snapshot.get("session"),
+            "agent": (meta or {}).get("agent"),
+            "leg": (meta or {}).get("leg"),
+            "snapshot_id": snapshot.get("id"),
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "journey_id": journey,
+            "sources": rendered,
         },
     }
 

@@ -60,6 +60,22 @@ _HELP = {
     "fleet_sessions_repointed_total": (
         "clients re-pointed off DEAD agents through AGENT_DEAD webhooks"
     ),
+    # journey rollup (fleet/journey.py): aggregate-only by construction —
+    # the journey id is NEVER a label; per-journey detail lives at the
+    # JSON debug endpoint GET /fleet/debug/journey/<id>
+    "journeys_total": "session journeys placed by the router (one per client session, across every leg)",
+    "journeys_tracked": "journeys currently held in the bounded router table",
+    "journey_legs_total": "placements across all journeys (leg 1 + crash re-placements)",
+    "journey_replacements_total": "crash re-placements: legs that continued an existing journey on a new agent",
+    "journey_events_total": "entries appended to journey event rings",
+    "journeys_evicted_total": "journeys evicted from the bounded table (oldest first)",
+    "journey_evidence_captured_total": "agent-side captures stored on breach webhooks (the records that survive a corpse)",
+    "journey_bundles_sealed_total": "incident bundles frozen on the alert paths (AGENT_DEAD, breach volleys)",
+    "journey_bundles_stored": "sealed incident bundles currently retained (bounded store)",
+    "journey_started_total": "StreamStarted webhooks joined to a placement (placement-to-first-frame samples)",
+    "journey_place_to_start_ms_p50": "placement-to-first-frame latency, median (bounded reservoir)",
+    "journey_place_to_start_ms_p95": "placement-to-first-frame latency, p95",
+    "journey_place_to_start_ms_p99": "placement-to-first-frame latency, p99",
 }
 
 

@@ -48,6 +48,19 @@ class SessionRecorder:
         self._clock = clock
         n = env.get_int("FLIGHT_EVENTS", 256)
         self.events: collections.deque = collections.deque(maxlen=max(1, n))
+        # fleet journey correlation (fleet/journey.py): set by the agent
+        # from the router's X-Journey-Id header; rides every snapshot
+        self.journey: dict | None = None
+
+    def set_journey(self, journey_id: str, leg: int = 1, agent: str = ""):
+        """Bind this session to its fleet journey — every snapshot,
+        sealed timeline (via the tracer) and black-box capture carries
+        the id from here on, and the event log records the leg start so
+        a merged bundle shows where each process picked the session up."""
+        meta = {"journey_id": journey_id, "leg": int(leg), "agent": agent}
+        self.journey = meta
+        self.tracer.journey = meta
+        self.event("journey", **meta)
 
     def event(self, kind: str, **data):
         """One structured entry.  Always on (the black box must be
@@ -68,6 +81,7 @@ class SessionRecorder:
             "session": self.session_id,
             "reason": reason,
             "taken_at": round(self._clock(), 6),
+            "journey": self.journey,
             "events": safe_list(self.events),
             "frames": self.tracer.snapshot_frames(),
         }
@@ -149,6 +163,7 @@ class FlightRecorder:
                     "session": s["session"],
                     "reason": s["reason"],
                     "taken_at": s["taken_at"],
+                    "journey_id": (s.get("journey") or {}).get("journey_id"),
                     "frames": len(s["frames"]),
                     "events": len(s["events"]),
                 }

@@ -307,6 +307,11 @@ class SessionTracer:
         # tracing off and every sealed one feeds the stage histograms —
         # the ring is only retained while tracing proper is on
         self.slo = slo
+        # fleet journey correlation (``{"journey_id","leg","agent"}``,
+        # set via SessionRecorder.set_journey): stamped onto sealed
+        # timelines at SNAPSHOT time only — the per-frame hot path never
+        # reads it
+        self.journey: dict | None = None
         n = (
             env.get_int("TRACE_RING_FRAMES", 256)
             if ring_frames is None
@@ -369,4 +374,10 @@ class SessionTracer:
         self.frames_completed += 1
 
     def snapshot_frames(self) -> list:
-        return [t.to_dict() for t in safe_list(self.ring)]
+        out = [t.to_dict() for t in safe_list(self.ring)]
+        journey = self.journey
+        if journey:
+            for d in out:
+                d["journey_id"] = journey.get("journey_id")
+                d["leg"] = journey.get("leg")
+        return out

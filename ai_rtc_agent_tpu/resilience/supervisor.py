@@ -133,6 +133,11 @@ class SessionSupervisor:
         self._loop: asyncio.AbstractEventLoop | None = None
         self.passthrough_frames = 0
         self.processed_frames = 0
+        # owner-stamped correlation context (e.g. the fleet journey the
+        # agent threads off the router's X-Journey-Id header) — rendered
+        # verbatim in snapshot() so /health answers "which journey is
+        # this session a leg of" without a second lookup
+        self.context: dict = {}
         self.transitions: list = []  # (t, old, new, reason), bounded
         # resources owned by wrappers (ResilientPipeline's step worker):
         # released in stop() so session teardown needs only the supervisor
@@ -201,6 +206,7 @@ class SessionSupervisor:
             now = self._clock()
             return {
                 "state": self._state,
+                **({"context": dict(self.context)} if self.context else {}),
                 "reason": self._reason,
                 "since_s": round(now - self._since, 3),
                 "restarts": self._restarts,

@@ -57,6 +57,61 @@ logger = logging.getLogger(__name__)
 # health state machine + passthrough degradation; SUPERVISOR=0 disables
 # ---------------------------------------------------------------------------
 
+def _journey_of(app, session_key: str) -> dict | None:
+    """The session's fleet-journey binding ({"journey_id","leg","agent"})
+    or None on single-process deployments."""
+    return app.get("journey_map", {}).get(session_key)
+
+
+def _parse_journey(app, request) -> dict | None:
+    """The router's ``X-Journey-Id``/``X-Journey-Leg`` headers as a
+    journey binding dict — None (and zero residue) without the headers
+    or with ``JOURNEY_ENABLE=0``."""
+    if not app.get("journey_enabled", True):
+        return None
+    journey_id = request.headers.get("X-Journey-Id")
+    if not journey_id:
+        return None
+    try:
+        leg = max(1, int(request.headers.get("X-Journey-Leg", "1")))
+    except ValueError:
+        leg = 1
+    return {
+        "journey_id": journey_id,
+        "leg": leg,
+        "agent": env.get_str("WORKER_ID") or "",
+    }
+
+
+def _bind_journey(app, request, session_key: str) -> dict | None:
+    """Thread the journey headers into this session: the journey map
+    (webhooks, /health context) and the flight recorder + tracer (every
+    snapshot and sealed timeline), so the fleet's incident bundle can
+    join this process's records to the other legs'.  WHEP viewers echo
+    the header without binding — they own no recorder to thread."""
+    meta = _parse_journey(app, request)
+    if meta is None:
+        return None
+    app.setdefault("journey_map", {})[session_key] = meta
+    flight = app.get("flight")
+    if flight is not None:
+        # register is idempotent get-or-create — binding here means the
+        # recorder is born journeyed even before supervision wraps it
+        flight.register(session_key).set_journey(**meta)
+    return meta
+
+
+def _journey_headers(meta: dict | None) -> dict:
+    """Response-header echo: the client learns its journey id from the
+    signaling answer (and the router confirms the agent threaded it)."""
+    if not meta:
+        return {}
+    return {
+        "X-Journey-Id": meta["journey_id"],
+        "X-Journey-Leg": str(meta["leg"]),
+    }
+
+
 def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
     """Wrap a session pipeline in the resilience layer and register its
     supervisor for /health.  Returns the pipeline unchanged when
@@ -104,6 +159,7 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
             handler.handle_session_state(
                 session_key, room_id, new, reason,
                 flight_snapshot_id=snap_id, recent_events=recent,
+                journey=_journey_of(app, session_key),
             )
 
         try:  # may fire from a worker thread — webhooks belong on the loop
@@ -114,6 +170,10 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
     sup = SessionSupervisor(
         session_key, resync=resync, on_transition=on_transition
     )
+    jmeta = _journey_of(app, session_key)
+    if jmeta is not None:
+        # /health shows which journey this session is a leg of
+        sup.context["journey"] = jmeta
     if rec is not None:
         sup.on_event = rec.event  # restart attempts/outcomes -> event log
     wrapped = ResilientPipeline(pipeline, sup)
@@ -162,6 +222,7 @@ def _session_tracer(app, session_key: str, src_track=None):
 
 def _end_supervision(app, session_key: str):
     sup = app.get("supervisors", {}).pop(session_key, None)
+    app.get("journey_map", {}).pop(session_key, None)
     if sup is not None:
         sup.stop()
     ov = app.get("overload")
@@ -409,6 +470,10 @@ async def offer(request):
     if pipeline is None:
         _release_admission(app, stream_id)
         return _overloaded_response(app, _slots_full_text(app))
+    # fleet journey correlation: bound BEFORE the SDP dance so on_track
+    # (which fires inside setRemoteDescription) supervises a session
+    # that already knows its journey
+    jmeta = _bind_journey(app, request, stream_id)
     # everything between the claim and the connection handlers taking over
     # must release the slot on failure — a leaked slot is permanent 503s
     pc = None
@@ -467,10 +532,15 @@ async def offer(request):
                 await pc.close()
                 pcs.discard(pc)
                 release_pipeline()
+                journey = _journey_of(app, stream_id)  # before the map clears
                 _end_supervision(app, stream_id)
-                stream_event_handler.handle_stream_ended(stream_id, room_id)
+                stream_event_handler.handle_stream_ended(
+                    stream_id, room_id, journey=journey
+                )
             elif pc.connectionState == "connected":
-                stream_event_handler.handle_stream_started(stream_id, room_id)
+                stream_event_handler.handle_stream_started(
+                    stream_id, room_id, journey=_journey_of(app, stream_id)
+                )
 
         await pc.setRemoteDescription(offer_sdp)
         answer = await pc.createAnswer()
@@ -497,8 +567,9 @@ async def offer(request):
         # the session's server-side identity: the fleet router maps the
         # session to this agent with it (WHIP/WHEP get the same from
         # their Location headers) so DELETEs route back and a crash can
-        # re-point exactly the affected clients
-        headers={"X-Stream-Id": stream_id},
+        # re-point exactly the affected clients; the journey echo
+        # confirms the correlation id was threaded end to end
+        headers={"X-Stream-Id": stream_id, **_journey_headers(jmeta)},
     )
 
 
@@ -638,6 +709,9 @@ async def whep(request):
             "Access-Control-Allow-Origin": "*",
             "Access-Control-Allow-Headers": "*",
             "Location": f"/whep/{session_id}",
+            # viewers carry the correlation id too (the router placed
+            # this leg); no recorder binds — a WHEP leg has no pipeline
+            **_journey_headers(_parse_journey(app, request)),
         },
         text=answer.sdp,
     )
@@ -663,6 +737,7 @@ async def whip(request):
     if pipeline is None:
         _release_admission(app, session_id)
         return _overloaded_response(app, _slots_full_text(app))
+    jmeta = _bind_journey(app, request, session_id)
 
     pc = None
 
@@ -767,6 +842,7 @@ async def whip(request):
             "Access-Control-Allow-Origin": "*",
             "Access-Control-Allow-Headers": "*",
             "Location": f"/whip/{session_id}",
+            **_journey_headers(jmeta),
         },
         text=answer.sdp,
     )
@@ -913,37 +989,69 @@ async def drain(request):
     })
 
 
+def _debug_error(status: int, message: str) -> web.Response:
+    """Debug-surface errors are JSON bodies (tooling consumes these
+    endpoints; an empty 200 or a bare text body reads as success to a
+    naive ``jq`` pipeline)."""
+    return web.json_response({"error": message}, status=status)
+
+
 async def debug_flight(request):
     """The flight recorder's pull surface (docs/observability.md):
 
       GET /debug/flight                     index (sessions, snapshots)
       GET /debug/flight?session=<key>       live capture of a session
       GET /debug/flight?id=<snapshot-id>    stored post-mortem snapshot
+      GET /debug/flight?journey=<jid>       journey fragment: every live
+                                            capture + stored snapshot +
+                                            recent devtel compiles bound
+                                            to that fleet journey (the
+                                            router's bundle fan-out
+                                            pulls exactly this)
       &format=chrome | jsonl                Perfetto / grep renderings
     """
     flight = request.app.get("flight")
     if flight is None:
-        return web.Response(status=404, text="flight recorder disabled")
+        return _debug_error(404, "flight recorder disabled")
     q = request.query
+    unknown = sorted(k for k in q if k not in ("id", "session", "format",
+                                               "journey"))
+    if unknown:
+        # a mistyped selector must not quietly serve the index as a 200
+        return _debug_error(
+            400, f"unknown query param(s): {', '.join(unknown)}"
+        )
     fmt = q.get("format", "json")
     if fmt not in ("json", "chrome", "jsonl"):
-        return web.Response(status=400, text=f"unknown format {fmt!r}")
+        return _debug_error(400, f"unknown format {fmt!r}")
+    if "journey" in q:
+        if "id" in q or "session" in q:
+            return _debug_error(
+                400, "journey= is a selector of its own — drop id=/session="
+            )
+        if fmt != "json":
+            return _debug_error(
+                400, "journey fragments are JSON — the router's "
+                     "/fleet/debug/journey endpoint renders the merged "
+                     "chrome trace",
+            )
+        return _journey_fragment(request.app, flight, q["journey"])
     if "id" in q:
         snap = flight.get_snapshot(q["id"])
         if snap is None:
-            return web.Response(status=404, text=f"unknown snapshot {q['id']!r}")
+            return _debug_error(404, f"unknown snapshot {q['id']!r}")
     elif "session" in q:
         rec = flight.session(q["session"])
         if rec is None:
-            return web.Response(status=404, text=f"unknown session {q['session']!r}")
+            return _debug_error(404, f"unknown session {q['session']!r}")
         snap = rec.snapshot(reason="on-demand")
     else:
         if fmt != "json":
             # the index is not a capture — a tooling URL whose id/session
             # variable expanded empty should fail loudly, not feed the
             # index dict to a Perfetto loader
-            return web.Response(
-                status=400, text="format= applies to a capture — pass id= or session="
+            return _debug_error(
+                400, "format= applies to a capture — pass id= or session="
             )
         return web.json_response(flight.index())
     if fmt == "chrome":
@@ -957,6 +1065,40 @@ async def debug_flight(request):
             text=to_jsonl(snap), content_type="application/x-ndjson"
         )
     return web.json_response(snap)  # fmt == "json", validated above
+
+
+def _journey_fragment(app, flight, journey_id: str) -> web.Response:
+    """This agent's share of a fleet journey: live captures of sessions
+    bound to it, stored snapshots that carry it, and the recent devtel
+    compiles — the one body the router's incident bundle pulls per
+    agent.  404 when this agent holds no records for the journey (the
+    router treats that as "this leg left nothing here")."""
+    from ..obs.trace import safe_list
+
+    sessions = {}
+    for sid, rec in list(flight.sessions.items()):
+        if (rec.journey or {}).get("journey_id") == journey_id:
+            sessions[sid] = rec.snapshot(reason="journey-pull")
+    snapshots = [
+        s for s in safe_list(flight.snapshots)
+        if (s.get("journey") or {}).get("journey_id") == journey_id
+    ]
+    if not sessions and not snapshots:
+        return _debug_error(
+            404, f"no records for journey {journey_id!r} on this agent"
+        )
+    fragment = {
+        "agent": env.get_str("WORKER_ID") or "",
+        "journey_id": journey_id,
+        "sessions": sessions,
+        "snapshots": snapshots,
+    }
+    devtel_plane = app.get("devtel")
+    if devtel_plane is not None:
+        # the device side of the incident (compile watchdog state) rides
+        # the fragment so a frozen leg explains itself in one pull
+        fragment["devtel"] = devtel_plane.fragment()
+    return web.json_response(fragment)
 
 
 async def debug_trace(request):
@@ -1346,6 +1488,7 @@ async def on_startup(app):
                 handler.handle_session_state(
                     session_key, "", "SLO_BREACH", reason,
                     recent_events=recent,
+                    journey=_journey_of(app, session_key),
                 )
 
             try:  # tick may one day run off-loop; webhooks belong on it
@@ -1501,6 +1644,11 @@ def build_app(
     app["mode"] = mode
     app["unet_cache"] = unet_cache
     app["provider"] = provider or get_provider()
+    # fleet journey correlation (fleet/journey.py): session -> binding
+    # threaded off the router's X-Journey-Id header; JOURNEY_ENABLE=0
+    # makes the agent ignore the headers entirely
+    app["journey_enabled"] = env.journey_enabled()
+    app["journey_map"] = {}
 
     app.on_startup.append(on_startup)
     app.on_shutdown.append(on_shutdown)

@@ -23,9 +23,17 @@ logger = logging.getLogger(__name__)
 
 
 class WebhookEvent(BaseModel):
+    """``journey_id``/``journey_leg`` are the fleet's cross-process
+    correlation key (fleet/journey.py), threaded by the router's
+    ``X-Journey-Id`` header — None on single-process deployments.  On
+    an ``AGENT_DEAD`` re-point the client echoes ``journey_id`` back on
+    its re-offer so the replacement leg joins the same journey."""
+
     stream_id: str
     room_id: str
     timestamp: int
+    journey_id: str | None = None
+    journey_leg: int | None = None
 
 
 class StreamStartedEvent(WebhookEvent):
@@ -146,11 +154,27 @@ class StreamEventHandler:
             asyncio.run(self._post(ev))
             return None
 
-    def handle_stream_started(self, stream_id: str, room_id: str):
-        return self.send_request("StreamStarted", stream_id, room_id)
+    @staticmethod
+    def _journey_extra(journey: dict | None) -> dict:
+        """``journey``: the agent-side ``{"journey_id", "leg"}`` mapping
+        (server/agent.py threads it off the router's headers) — flattened
+        into the event's correlation fields."""
+        if not journey:
+            return {}
+        return {
+            "journey_id": journey.get("journey_id"),
+            "journey_leg": journey.get("leg"),
+        }
 
-    def handle_stream_ended(self, stream_id: str, room_id: str):
-        return self.send_request("StreamEnded", stream_id, room_id)
+    def handle_stream_started(self, stream_id: str, room_id: str,
+                              journey: dict | None = None):
+        return self.send_request("StreamStarted", stream_id, room_id,
+                                 **self._journey_extra(journey))
+
+    def handle_stream_ended(self, stream_id: str, room_id: str,
+                            journey: dict | None = None):
+        return self.send_request("StreamEnded", stream_id, room_id,
+                                 **self._journey_extra(journey))
 
     def handle_session_state(
         self,
@@ -160,6 +184,7 @@ class StreamEventHandler:
         reason: str,
         flight_snapshot_id: str | None = None,
         recent_events: list | None = None,
+        journey: dict | None = None,
     ):
         """Supervisor transition -> webhook: non-HEALTHY states emit
         StreamDegraded (state carries DEGRADED/RECOVERING/FAILED), a return
@@ -169,6 +194,7 @@ class StreamEventHandler:
         post-mortem (docs/resilience.md)."""
         name = "StreamRecovered" if state == "HEALTHY" else "StreamDegraded"
         extra = {"state": state, "reason": reason}
+        extra.update(self._journey_extra(journey))
         if name == "StreamDegraded":
             if flight_snapshot_id is not None:
                 extra["flight_snapshot_id"] = flight_snapshot_id

@@ -145,6 +145,17 @@ def devtel_enabled() -> bool:
     return get_bool("DEVTEL_ENABLE", True)
 
 
+def journey_enabled() -> bool:
+    """Fleet journey plane (fleet/journey.py) — cross-process trace
+    correlation: the router mints an ``X-Journey-Id`` per placed
+    session, keeps a bounded per-journey event ring, and serves
+    one-GET incident bundles at ``/fleet/debug/journey/<id>``.
+    ``JOURNEY_ENABLE=0`` removes the plane: no ids are minted or
+    forwarded, the debug endpoints 404, and the remaining JOURNEY_*
+    knobs are never read."""
+    return get_bool("JOURNEY_ENABLE", True)
+
+
 def batchsched_enabled() -> bool:
     """Continuous cross-session batch scheduler (stream/scheduler.py) —
     the default single-device serving path.  BATCHSCHED=0 restores the

@@ -74,6 +74,9 @@ DEFAULT_METRIC_TOLERANCES = {
     # ~30µs host kernel — the fence catches allocation/locking landing
     # back on the DEVTEL_ENABLE=0 path, sized for CI throttle noise
     "devtel_off_overhead_ratio": 0.35,
+    # journey-ring off-mode residue (ISSUE 13): one disabled note() call
+    # per request against the same kernel — same failure mode, same fence
+    "journey_off_overhead_ratio": 0.35,
     # fleet router hop (ISSUE 11): added /offer p50 vs direct-to-agent —
     # a ~1ms absolute number on a contended box, so the fence is wide;
     # what it catches is the hop going pathological (per-request agent

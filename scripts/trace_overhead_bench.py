@@ -28,9 +28,14 @@ host-plane bench (shared CI boxes throttle in bursts):
             (one module-global read + None test per hook)
   devtel_on   the same hooks with an enabled plane counting (lock + two
             int adds per hook) — the always-on devtel cost
+  journey_off  kernel + the fleet journey-ring hook (fleet/journey.py
+            JourneyLog.note — the router's per-request hot call) with
+            the plane DISABLED (JOURNEY_ENABLE=0): one attribute read
+  journey_on   the same hook with the plane enabled recording — a dict
+            get + wall-clock read + bounded-deque append per call
 
-Prints TWO JSON contract lines and appends both to PERF_LOG.jsonl
-(PERF_LOG_PATH overrides; empty disables).  The first metric is
+Prints THREE JSON contract lines and appends all of them to
+PERF_LOG.jsonl (PERF_LOG_PATH overrides; empty disables).  The first metric is
 ``trace_off_overhead_ratio`` = off / baseline — the number that must stay
 within noise of 1.0 (tests/test_bench_contract.py guards it loosely; the
 absolute per-frame figures ride along for the log).
@@ -39,7 +44,10 @@ off-mode contract (ISSUE 8 acceptance: ≤5% over the trace-off ratio on
 an uncontended box) and is guarded by the same test.  The second line is
 ``devtel_off_overhead_ratio`` = devtel_off / baseline — the device-
 telemetry plane's off-mode contract (ISSUE 10, same ≤5% discipline),
-fenced by scripts/perf_compare.py's built-in tolerance.
+fenced by scripts/perf_compare.py's built-in tolerance.  The third line
+is ``journey_off_overhead_ratio`` = journey_off / baseline — the fleet
+journey plane's off-mode contract (ISSUE 13, same ≤1.05 discipline,
+same perf_compare fence).
 
 Env knobs: TRACE_BENCH_FRAMES (default 2000).
 """
@@ -54,6 +62,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from ai_rtc_agent_tpu.fleet.journey import JourneyLog
 from ai_rtc_agent_tpu.media.frames import VideoFrame
 from ai_rtc_agent_tpu.obs import devtel
 from ai_rtc_agent_tpu.obs.devtel import DevTelPlane
@@ -139,6 +148,21 @@ def _leg_devtel(frames) -> float:
     return time.perf_counter() - t0
 
 
+def _leg_journey(frames, jlog: JourneyLog, journey_id: str) -> float:
+    """The router's journey-ring hot call exactly as wired: one
+    ``note()`` per request, around the same kernel + hop-guard
+    scaffolding.  Disabled log = the JOURNEY_ENABLE=0 serving state
+    (one attribute read); enabled log = a dict get + wall-clock read +
+    bounded-deque append."""
+    t0 = time.perf_counter()
+    for f in frames:
+        _kernel(f)
+        jlog.note(journey_id, "placed")
+        for _hop in _HOPS:
+            pass
+    return time.perf_counter() - t0
+
+
 def _leg_on(frames, tracer: SessionTracer, flight=None) -> float:
     """Tracing ENABLED: full span stamping at every hop + terminal."""
     t0 = time.perf_counter()
@@ -159,7 +183,7 @@ def _leg_on(frames, tracer: SessionTracer, flight=None) -> float:
 
 
 def run() -> tuple:
-    """-> (devtel contract entry, trace/SLO contract entry)."""
+    """-> (devtel entry, journey entry, trace/SLO contract entry)."""
     frames = _make_frames(FRAMES)
 
     ctrl_off = TraceController()
@@ -194,17 +218,30 @@ def run() -> tuple:
     devtel_plane = DevTelPlane()
     devtel_plane.enabled = True
 
+    # journey legs (fleet/journey.py): off = the JOURNEY_ENABLE=0
+    # serving state (note() is one attribute read); on = an enabled log
+    # with one placed journey recording every call into its bounded ring
+    jlog_off = JourneyLog()
+    jlog_off.enabled = False
+    jlog_on = JourneyLog()
+    jlog_on.enabled = True
+    bench_jid = jlog_on.mint()
+    jlog_on.place(bench_jid, "bench-agent", "bench-stream", "offer")
+
     # warmup (allocator, numpy dispatch, code paths)
     _leg_baseline(frames[:64])
     _leg_off(frames[:64], tracer_off)
     _leg_off(frames[:64], tracer_slo_off)
     _leg_devtel(frames[:64])
+    _leg_journey(frames[:64], jlog_off, bench_jid)
+    _leg_journey(frames[:64], jlog_on, bench_jid)
     _leg_on(frames[:64], tracer_slo_on)
     _leg_on(frames[:64], tracer_on)
 
     base_r, off_r, on_r, flight_r = [], [], [], []
     slo_off_r, slo_on_r = [], []
     devtel_off_r, devtel_on_r = [], []
+    journey_off_r, journey_on_r = [], []
     for _ in range(5):  # interleaved best-of (CI boxes throttle in bursts)
         base_r.append(_leg_baseline(frames))
         off_r.append(_leg_off(frames, tracer_off))
@@ -214,6 +251,8 @@ def run() -> tuple:
         devtel.activate(devtel_plane)
         devtel_on_r.append(_leg_devtel(frames))
         devtel.deactivate(devtel_plane)
+        journey_off_r.append(_leg_journey(frames, jlog_off, bench_jid))
+        journey_on_r.append(_leg_journey(frames, jlog_on, bench_jid))
         slo_on_r.append(_leg_on(frames, tracer_slo_on))
         on_r.append(_leg_on(frames, tracer_on))
         flight_r.append(_leg_on(frames, rec.tracer, flight=flight))
@@ -221,11 +260,13 @@ def run() -> tuple:
     on_s, flight_s = min(on_r), min(flight_r)
     slo_off_s, slo_on_s = min(slo_off_r), min(slo_on_r)
     devtel_off_s, devtel_on_s = min(devtel_off_r), min(devtel_on_r)
+    journey_off_s, journey_on_s = min(journey_off_r), min(journey_on_r)
 
     us = lambda s: round(1e6 * s / FRAMES, 3)  # noqa: E731
     ratio = off_s / base_s if base_s > 0 else 0.0
     slo_ratio = slo_off_s / base_s if base_s > 0 else 0.0
     devtel_ratio = devtel_off_s / base_s if base_s > 0 else 0.0
+    journey_ratio = journey_off_s / base_s if base_s > 0 else 0.0
     stamp = datetime.now(timezone.utc).isoformat()
     fp = fingerprint(probe_jax=False)
     devtel_entry = {
@@ -249,7 +290,27 @@ def run() -> tuple:
         "recorded_at": stamp,
         "fingerprint": fp,
     }
-    return devtel_entry, {
+    journey_entry = {
+        "check": "trace_overhead_bench",
+        "frames": FRAMES,
+        "journey_off_us_per_frame": us(journey_off_s),
+        "journey_on_us_per_frame": us(journey_on_s),
+        "journey_off_overhead_us_per_frame": us(journey_off_s - base_s),
+        "journey_on_overhead_us_per_frame": us(journey_on_s - base_s),
+        # the on-leg actually recorded into the ring every call
+        "journey_events_counted": jlog_on.events_total,
+        # the journey plane's off-mode contract (ISSUE 13 acceptance ≤1.05)
+        "metric": "journey_off_overhead_ratio",
+        "value": round(journey_ratio, 4),
+        "unit": "x",
+        "vs_baseline": round(journey_ratio, 4),
+        "backend": "cpu",
+        "live": True,
+        "label": f"trace_overhead_{FRAMES}f",
+        "recorded_at": stamp,
+        "fingerprint": fp,
+    }
+    return devtel_entry, journey_entry, {
         "check": "trace_overhead_bench",
         "frames": FRAMES,
         "hops": len(_HOPS) + 1,
@@ -298,15 +359,25 @@ def main():
         "unit": "x",
         "vs_baseline": 0.0,
     }
+    journey_entry = {
+        "check": "trace_overhead_bench",
+        "metric": "journey_off_overhead_ratio",
+        "value": 0.0,
+        "unit": "x",
+        "vs_baseline": 0.0,
+    }
     try:
-        devtel_entry, entry = run()
+        devtel_entry, journey_entry, entry = run()
         _bank(entry)
         _bank(devtel_entry)
+        _bank(journey_entry)
     except Exception as e:  # contract: one JSON line per metric on EVERY exit
         entry["error"] = f"{type(e).__name__}: {e}"
         devtel_entry["error"] = entry["error"]
+        journey_entry["error"] = entry["error"]
     print(json.dumps(entry))
     print(json.dumps(devtel_entry))
+    print(json.dumps(journey_entry))
 
 
 if __name__ == "__main__":

@@ -8,12 +8,24 @@ devices, so this is exactly the surface it routes against.
 
 Adds a test-only drive surface the parent test uses to move media:
 
-  POST /_test/pump  {"frames": N}   push N frames into every connected
-                                    session's inbound track and pull N
-                                    processed frames out; returns
-                                    {"sessions": {pc_id: delivered}}
+  POST /_test/pump  {"frames": N,   push N frames into every connected
+                     "stale": K}    session's inbound track and pull N
+                                    processed frames out (plus K aged
+                                    frames first — the ingest hop sheds
+                                    them, sealing their trace timelines);
+                                    returns {"sessions": {pc_id: delivered}}
   POST /_test/close                 close every peer connection (clients
                                     hanging up — ends the sessions)
+  POST /_test/webhook {"url","token"}  point the agent's webhook plane at
+                                    the router's /fleet/events ingest
+                                    (the production WEBHOOK_URL wiring,
+                                    set post-spawn because the router's
+                                    port is only known then)
+  POST /_test/degrade               force every live session's supervisor
+                                    DEGRADED through the real transition
+                                    path (auto flight snapshot + webhook
+                                    volley — the breach the journey
+                                    plane's evidence capture rides)
 
 Prints one JSON line {"port": <bound port>} on stdout once serving.
 """
@@ -23,12 +35,14 @@ import asyncio
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 from aiohttp import web
 
+from ai_rtc_agent_tpu.media.frames import VideoFrame
 from ai_rtc_agent_tpu.server.agent import build_app
 from ai_rtc_agent_tpu.server.signaling import LoopbackProvider
 
@@ -53,6 +67,7 @@ async def _pump(request):
     except ValueError:
         return web.Response(status=400, text="invalid JSON")
     n = int(body.get("frames", 10))
+    stale = int(body.get("stale", 0))
     out = {}
     for pc in list(request.app["pcs"]):
         if (
@@ -61,6 +76,15 @@ async def _pump(request):
             or not pc.out_tracks
         ):
             continue
+        # aged frames first: the ingest hop sheds them freshest-wins,
+        # which SEALS their trace timelines — the loopback tier has no
+        # send hop, so sheds are how sealed frames reach the black box
+        for i in range(stale):
+            f = VideoFrame.from_ndarray(
+                np.full((8, 8, 3), i, dtype=np.uint8)
+            )
+            f.wall_ts = time.monotonic() - 10.0
+            await pc.in_track.push(f)
         delivered = 0
         for i in range(n):
             frame = np.full((8, 8, 3), (i * 7) % 256, dtype=np.uint8)
@@ -79,10 +103,30 @@ async def _close_all(request):
     return web.json_response({"closed": len(pcs)})
 
 
+async def _set_webhook(request):
+    body = await request.json()
+    handler = request.app["stream_event_handler"]
+    handler.webhook_url = body.get("url")
+    handler.token = body.get("token")
+    return web.json_response({"ok": True})
+
+
+async def _degrade(request):
+    out = {}
+    for sid, sup in list(request.app.get("supervisors", {}).items()):
+        # the real breach path: DEGRADED transition -> auto flight
+        # snapshot + StreamDegraded webhook (with the journey binding)
+        sup.note_overload("test: forced degrade")
+        out[sid] = sup.state
+    return web.json_response({"sessions": out})
+
+
 async def main(port: int) -> None:
     app = build_app(pipeline=FakePipeline(), provider=LoopbackProvider())
     app.router.add_post("/_test/pump", _pump)
     app.router.add_post("/_test/close", _close_all)
+    app.router.add_post("/_test/webhook", _set_webhook)
+    app.router.add_post("/_test/degrade", _degrade)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", port)

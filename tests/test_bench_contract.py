@@ -331,8 +331,9 @@ def test_trace_overhead_bench_contract(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-800:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
-    # two contract lines: the trace/SLO quartet + the devtel leg (ISSUE 10)
-    assert len(lines) == 2, r.stdout
+    # three contract lines: the trace/SLO quartet + the devtel leg
+    # (ISSUE 10) + the fleet journey leg (ISSUE 13)
+    assert len(lines) == 3, r.stdout
     by_metric = {json.loads(ln)["metric"]: json.loads(ln) for ln in lines}
     d = by_metric["trace_off_overhead_ratio"]
     for k in ("metric", "value", "unit", "vs_baseline"):
@@ -362,10 +363,20 @@ def test_trace_overhead_bench_contract(tmp_path):
     assert dt["devtel_off_overhead_us_per_frame"] < 25.0, dt
     # the on-leg actually counted every hook (2 per frame x frames x reps)
     assert dt["devtel_transfers_counted"] > 0, dt
-    # banked: BOTH entries landed in the log
+    # the fleet journey plane's off-mode contract (ISSUE 13: the
+    # JOURNEY_ENABLE=0 note() residue is one attribute read — same loose
+    # CI fence, same multi-x failure mode it exists to catch)
+    jt = by_metric["journey_off_overhead_ratio"]
+    assert "error" not in jt, jt
+    assert 0 < jt["value"] <= 1.5, jt
+    assert jt["journey_off_overhead_us_per_frame"] < 25.0, jt
+    # the on-leg actually recorded into the bounded ring
+    assert jt["journey_events_counted"] > 0, jt
+    # banked: all THREE entries landed in the log
     banked = [json.loads(x) for x in log.read_text().splitlines()]
-    assert {b["metric"] for b in banked[-2:]} == {
+    assert {b["metric"] for b in banked[-3:]} == {
         "trace_off_overhead_ratio", "devtel_off_overhead_ratio",
+        "journey_off_overhead_ratio",
     }
 
 
@@ -909,6 +920,42 @@ def test_perf_compare_knows_devtel_leg(tmp_path, capsys):
     assert r.returncode == 0, r.stdout + r.stderr
     _write_jsonl(fresh, [
         {"metric": "devtel_off_overhead_ratio", "value": 1.4, "unit": "x",
+         "backend": "cpu", "label": "trace_overhead_2000f"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+
+
+def test_perf_compare_knows_journey_leg(tmp_path, capsys):
+    """ISSUE 13 satellite: the journey-ring off-mode ratio ships with a
+    built-in lower-is-better fence (0.35) — a fresh run past it fails
+    with no --tolerance-metric flags."""
+    main = _perf_compare_main()
+
+    def _perf_compare(args):
+        class R:
+            pass
+
+        r = R()
+        r.returncode = main(args)
+        r.stdout = capsys.readouterr().out
+        r.stderr = ""
+        return r
+
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "journey_off_overhead_ratio", "value": 1.0, "unit": "x",
+         "backend": "cpu", "live": True, "label": "trace_overhead_2000f"},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "journey_off_overhead_ratio", "value": 1.3, "unit": "x",
+         "backend": "cpu", "label": "trace_overhead_2000f"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    _write_jsonl(fresh, [
+        {"metric": "journey_off_overhead_ratio", "value": 1.4, "unit": "x",
          "backend": "cpu", "label": "trace_overhead_2000f"},
     ])
     r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])

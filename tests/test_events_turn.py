@@ -22,6 +22,10 @@ def test_event_models_schema():
         "room_id": "r1",
         "timestamp": 123,
         "event": "StreamStarted",
+        # fleet journey correlation (ISSUE 13): None outside a fleet —
+        # single-process payloads carry the fields, unset
+        "journey_id": None,
+        "journey_leg": None,
     }
     assert StreamEndedEvent(stream_id="s", room_id="r", timestamp=1).event == "StreamEnded"
 

@@ -204,7 +204,12 @@ class FakeAgent:
         self.mode = "ok"
         self.fail_delete = False  # transient 5xx mode for DELETE
         self.sessions: dict = {}
-        self.hits = {"offer": 0, "whip": 0, "drain": [], "delete": []}
+        self.hits = {"offer": 0, "whip": 0, "drain": [], "delete": [],
+                     "flight": []}
+        # journey fragments served at GET /debug/flight?journey= —
+        # {journey_id: fragment-dict}, set by tests simulating an agent
+        # that holds records for the journey
+        self.flight: dict = {}
         self.server = None
 
     def _app(self):
@@ -218,10 +223,19 @@ class FakeAgent:
                     headers={"Retry-After": str(self.retry_after)},
                 )
             sid = f"{self.name}-s{len(self.sessions) + 1}"
-            self.sessions[sid] = {}
+            self.sessions[sid] = {
+                "journey": req.headers.get("X-Journey-Id"),
+                "leg": req.headers.get("X-Journey-Leg"),
+            }
+            headers = {"X-Stream-Id": sid}
+            # a journey-aware agent echoes the binding (server/agent.py)
+            if req.headers.get("X-Journey-Id"):
+                headers["X-Journey-Id"] = req.headers["X-Journey-Id"]
+                headers["X-Journey-Leg"] = req.headers.get(
+                    "X-Journey-Leg", "1"
+                )
             return web.json_response(
-                {"sdp": "answer-sdp", "type": "answer"},
-                headers={"X-Stream-Id": sid},
+                {"sdp": "answer-sdp", "type": "answer"}, headers=headers
             )
 
         async def whip(req):
@@ -261,7 +275,19 @@ class FakeAgent:
             self.hits["drain"].append(body["action"])
             return web.json_response({"draining": body["action"] == "freeze"})
 
+        async def debug_flight(req):
+            jid = req.query.get("journey", "")
+            self.hits["flight"].append(jid)
+            frag = self.flight.get(jid)
+            if frag is None:
+                return web.json_response(
+                    {"error": f"no records for journey {jid!r}"},
+                    status=404,
+                )
+            return web.json_response(frag)
+
         app.router.add_post("/offer", offer)
+        app.router.add_get("/debug/flight", debug_flight)
         app.router.add_post("/whip", whip)
         app.router.add_delete("/whip/{session}", whip_delete)
         app.router.add_get("/capacity", capacity)
@@ -625,13 +651,24 @@ def test_fleet_metrics_prom_conformance():
             r = await client.get("/metrics", params={"format": "prom"})
             assert r.status == 200
             assert r.headers["Content-Type"].startswith("text/plain")
-            families = validate_exposition(await r.text())
+            text = await r.text()
+            families = validate_exposition(text)
             assert families["fleet_placements_total"]["type"] == "counter"
             assert families["fleet_agents"]["type"] == "gauge"
             assert families["fleet_sessions"]["type"] == "gauge"
-            # NEVER labeled by unbounded agent/session identity: the
-            # fleet rollup is aggregate-only, so no sample carries any
-            # label at all
+            # journey families (ISSUE 13) ride the same rollup with
+            # dedicated HELP rows
+            assert families["journeys_total"]["type"] == "counter"
+            assert families["journey_legs_total"]["type"] == "counter"
+            assert families["journey_replacements_total"]["type"] == "counter"
+            assert families["journeys_tracked"]["type"] == "gauge"
+            assert families["journey_bundles_stored"]["type"] == "gauge"
+            assert "# HELP journeys_total session journeys placed" in text
+            assert ("# HELP journey_replacements_total crash re-placements"
+                    in text)
+            # NEVER labeled by unbounded agent/session/journey identity:
+            # the fleet rollup is aggregate-only, so no sample —
+            # including every journey family — carries any label at all
             for fam in families.values():
                 for _name, labels, _v in fam["samples"]:
                     assert labels == {}, (fam, labels)
@@ -841,6 +878,423 @@ def test_drain_before_first_poll_is_not_recyclable():
             reg.note_poll(reg.agents["a"], None,
                           {"status": "HEALTHY", "sessions": {}})
             assert reg.agents["a"].recyclable
+        finally:
+            await client.close()
+            await a.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# session journeys (ISSUE 13): correlation ids, the router ring, evidence
+# auto-capture, incident bundles
+# ---------------------------------------------------------------------------
+
+from ai_rtc_agent_tpu.fleet.journey import JourneyLog
+
+
+def _jlog(monkeypatch=None, clock=None, **env):
+    if monkeypatch is not None:
+        for k, v in env.items():
+            monkeypatch.setenv(k, str(v))
+    return JourneyLog(clock=clock or Clock())
+
+
+def test_journey_log_legs_ring_eviction_and_latency(monkeypatch):
+    clock = Clock()
+    jl = _jlog(monkeypatch, clock=clock, JOURNEY_MAX=2, JOURNEY_RING=4)
+    j1 = jl.mint()
+    assert jl.next_leg(j1) == 1 and not jl.known(j1)  # lazily materialized
+    assert jl.place(j1, "a0", "s1", "offer", "room", retried=1) == 1
+    assert jl.known(j1) and jl.journey_for_stream("s1") == j1
+    # placement->first-frame latency off the StreamStarted ingest
+    clock.now = 0.25
+    jl.note_started("s1")
+    snap = jl.snapshot()
+    assert snap["journey_started_total"] == 1
+    assert snap["journey_place_to_start_ms_p50"] == 250.0
+    # re-placement increments the leg and the replacement counter
+    assert jl.next_leg(j1) == 2
+    assert jl.place(j1, "a1", "s2", "offer", "room") == 2
+    rec = jl.get(j1)
+    assert [leg["agent"] for leg in rec["legs"]] == ["a0", "a1"]
+    kinds = [e["kind"] for e in rec["events"]]
+    assert kinds == ["placed", "started", "re_placed"]
+    assert rec["events"][0]["retried"] == 1
+    assert jl.snapshot()["journey_replacements_total"] == 1
+    # the ring is bounded (JOURNEY_RING=4): oldest entries evicted
+    for i in range(6):
+        jl.note(j1, "degraded", state="DEGRADED", i=i)
+    assert len(jl.get(j1)["events"]) == 4
+    # ended forgets the stream mapping, keeps the record
+    jl.end_stream("s2")
+    assert jl.journey_for_stream("s2") is None and jl.known(j1)
+    # the journey TABLE is bounded (JOURNEY_MAX=2): oldest evicted with
+    # its stream mappings
+    j2, j3 = jl.mint(), jl.mint()
+    jl.place(j2, "a0", "s3", "whip", "")
+    jl.place(j3, "a0", "s4", "whip", "")
+    assert not jl.known(j1) and jl.journey_for_stream("s1") is None
+    assert jl.snapshot()["journeys_evicted_total"] == 1
+    assert jl.snapshot()["journeys_tracked"] == 2
+    # aggregate-only: nothing keyed by journey identity
+    assert all(not isinstance(v, (dict, list))
+               for v in jl.snapshot().values())
+
+
+def test_journey_evidence_and_bundles_survive_eviction(monkeypatch):
+    jl = _jlog(monkeypatch, JOURNEY_MAX=1, JOURNEY_EVIDENCE=2,
+               JOURNEY_BUNDLES=2)
+    j1 = jl.mint()
+    jl.place(j1, "a0", "s1", "offer", "")
+    for i in range(3):  # bounded evidence: oldest dropped
+        jl.add_evidence(j1, "a0", {"snapshots": [], "i": i})
+    assert [e["fragment"]["i"] for e in jl.evidence_for(j1)] == [1, 2]
+    # re-seals COALESCE per journey: a flapping session's breach
+    # volleys must not evict other journeys' only incident record
+    jl.seal_bundle(j1, "breach DEGRADED")
+    bundle = jl.seal_bundle(j1, "AGENT_DEAD a0")
+    assert len(jl.bundles) == 1  # replaced, not appended
+    assert jl.bundles_for(j1)[0]["reason"] == "AGENT_DEAD a0"
+    assert bundle["journey_id"] == j1
+    assert [e["kind"] for e in bundle["journey"]["events"]][-1] == "bundle"
+    assert len(bundle["evidence"]) == 2
+    # sealed bundles outlive the journey table's eviction churn
+    j2 = jl.mint()
+    jl.place(j2, "a1", "s2", "offer", "")
+    assert not jl.known(j1)
+    assert jl.bundles_for(j1) and jl.bundles_for(j1)[0]["reason"].startswith(
+        "AGENT_DEAD"
+    )
+    assert jl.seal_bundle("j-unknown", "x") is None
+    snap = jl.snapshot()
+    assert snap["journey_bundles_sealed_total"] == 2
+    assert snap["journey_evidence_captured_total"] == 3
+    # an explicit leg (what the router already forwarded to the agent)
+    # wins over the recomputed one — concurrent re-offers or a table
+    # eviction racing the proxy await must not desync record vs agent
+    jl.place(j2, "a2", "s9", "offer", "", leg=7)
+    assert jl.get(j2)["legs"][-1]["leg"] == 7
+    # a typo'd ring kind is a programming error, not telemetry
+    with pytest.raises(ValueError):
+        jl.note(j2, "agent-dead")
+
+
+def test_journey_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("JOURNEY_ENABLE", "0")
+    jl = JourneyLog(clock=Clock())
+    assert jl.enabled is False
+    jid = jl.mint()
+    assert jl.place(jid, "a0", "s1", "offer", "") == 1
+    assert not jl.known(jid)
+    jl.note(jid, "degraded")
+    assert jl.snapshot()["journey_events_total"] == 0
+
+
+def _fragment(agent, jid, session="s", taken_at=10.0, snap_id="flt-1"):
+    """A minimal agent-side journey fragment: one stored snapshot with a
+    frame span + the journey binding (the shape server/agent.py serves
+    at GET /debug/flight?journey=)."""
+    return {
+        "agent": agent,
+        "journey_id": jid,
+        "sessions": {},
+        "snapshots": [{
+            "id": snap_id,
+            "session": session,
+            "reason": "DEGRADED: test",
+            "taken_at": taken_at,
+            "journey": {"journey_id": jid, "leg": 1, "agent": agent},
+            "events": [{"t": taken_at, "kind": "supervisor",
+                        "old": "HEALTHY", "new": "DEGRADED"}],
+            "frames": [{
+                "frame_id": 1, "session": session, "born": taken_at,
+                "terminal": "sent",
+                "spans": [["engine_step", taken_at, taken_at + 0.01]],
+                "marks": [["terminal:sent", taken_at + 0.01]],
+            }],
+        }],
+        "devtel": {"phase": "serving", "recent_compiles": []},
+    }
+
+
+def test_router_mints_forwards_and_continues_journeys():
+    """The correlation tentpole at the router: a placed session gets a
+    journey id (forwarded to the agent, echoed to the client); an
+    AGENT_DEAD webhook carries it; the client's re-offer echoing it
+    continues the SAME journey with leg 2 on the replacement agent."""
+    posted = []
+
+    class FakeResp:
+        status = 200
+
+    class FakeSession:
+        async def post(self, url, headers=None, json=None):
+            posted.append(json)
+            return FakeResp()
+
+    async def go():
+        a = await FakeAgent("a").start()
+        b = await FakeAgent("b").start()
+        events = StreamEventHandler(
+            session_factory=FakeSession,
+            webhook_url="http://client-notify.example/hook", token="t",
+        )
+        app, client, reg = await _router([a, b], dead_after=2,
+                                         events=events)
+        try:
+            r = await client.post("/offer", json=_OFFER)
+            assert r.status == 200
+            jid = r.headers["X-Journey-Id"]
+            assert jid.startswith("j-")
+            assert r.headers["X-Journey-Leg"] == "1"
+            sid = r.headers["X-Stream-Id"]
+            # the agent saw the forwarded headers
+            owner = app["session_table"].owner(sid)
+            agent = a if owner == "a" else b
+            assert agent.sessions[sid]["journey"] == jid
+            jl = app["journeys"]
+            rec = jl.get(jid)
+            assert [e["kind"] for e in rec["events"]] == ["placed"]
+            assert rec["legs"][0] == {
+                "leg": 1, "agent": owner, "stream_id": sid, "kind": "offer",
+                "room_id": "r1", "placed_at": rec["legs"][0]["placed_at"],
+            }
+
+            # the agent dies: the AGENT_DEAD webhook carries the journey
+            # id and a bundle seals (with whatever evidence exists)
+            dead_rec = reg.agents[owner]
+            reg.note_poll_fail(dead_rec)
+            reg.note_poll_fail(dead_rec)
+            assert dead_rec.state == "DEAD"
+            await asyncio.sleep(0)
+            await asyncio.gather(*list(events._tasks))
+            ev = next(e for e in posted if e.get("state") == "AGENT_DEAD")
+            assert ev["journey_id"] == jid and ev["journey_leg"] == 1
+            kinds = [e["kind"] for e in jl.get(jid)["events"]]
+            assert "agent_dead" in kinds and "bundle" in kinds
+            assert jl.bundles_for(jid)
+
+            # the client re-offers echoing the id: SAME journey, leg 2,
+            # on the surviving agent
+            r = await client.post("/offer", json=_OFFER,
+                                  headers={"X-Journey-Id": jid})
+            assert r.status == 200
+            assert r.headers["X-Journey-Id"] == jid
+            assert r.headers["X-Journey-Leg"] == "2"
+            survivor = "b" if owner == "a" else "a"
+            assert app["session_table"].owner(
+                r.headers["X-Stream-Id"]
+            ) == survivor
+            rec = jl.get(jid)
+            assert rec["legs"][1]["leg"] == 2
+            assert rec["legs"][1]["agent"] == survivor
+            assert [e["kind"] for e in rec["events"]][-1] == "re_placed"
+
+            # an UNKNOWN echoed id cannot graft onto ring state: a fresh
+            # journey is minted instead
+            r = await client.post("/offer", json=_OFFER,
+                                  headers={"X-Journey-Id": "j-forged"})
+            assert r.status == 200
+            assert r.headers["X-Journey-Id"] != "j-forged"
+
+            m = await (await client.get("/metrics")).json()
+            assert m["journeys_total"] == 2
+            assert m["journey_legs_total"] == 3
+            assert m["journey_replacements_total"] == 1
+            assert m["journey_bundles_sealed_total"] == 1
+        finally:
+            await client.close()
+            await a.close()
+            await b.close()
+
+    run(go())
+
+
+def test_breach_webhook_autocaptures_evidence_and_bundle():
+    """The alert-path auto-capture: a StreamDegraded breach volley makes
+    the router pull the owning agent's ?journey= fragment and seal a
+    bundle — BEFORE any crash can erase the records."""
+    async def go():
+        a = await FakeAgent("a").start()
+        events = StreamEventHandler(webhook_url=None, token=None)
+        app, client, reg = await _router([a], events=events)
+        try:
+            r = await client.post("/offer", json=_OFFER)
+            jid = r.headers["X-Journey-Id"]
+            sid = r.headers["X-Stream-Id"]
+            a.flight[jid] = _fragment("a", jid, session=sid)
+            breach = {
+                "event": "StreamDegraded", "state": "DEGRADED",
+                "stream_id": sid, "room_id": "r1", "timestamp": 1,
+                "journey_id": jid, "journey_leg": 1,
+                "reason": "step timeout",
+            }
+            # a volley of near-simultaneous breach webhooks (DEGRADED →
+            # FAILED within ms) dedups to ONE in-flight pull — duplicate
+            # fragments must not churn the bounded evidence ring
+            r = await client.post("/fleet/events", json=breach)
+            assert r.status == 200
+            r = await client.post(
+                "/fleet/events", json={**breach, "state": "FAILED"}
+            )
+            assert r.status == 200
+            await asyncio.gather(*list(app["journey_tasks"]))
+            jl = app["journeys"]
+            ev = jl.evidence_for(jid)
+            assert len(ev) == 1 and ev[0]["agent"] == "a"
+            assert ev[0]["fragment"]["snapshots"][0]["id"] == "flt-1"
+            assert a.hits["flight"] == [jid]
+            assert not app["journey_inflight"]  # key released with the task
+            bundles = jl.bundles_for(jid)
+            assert bundles and bundles[0]["reason"] == "breach DEGRADED"
+            assert bundles[0]["evidence"]  # the capture rode the seal
+            kinds = [e["kind"] for e in jl.get(jid)["events"]]
+            assert kinds[:2] == ["placed", "degraded"]
+            assert "evidence" in kinds and "bundle" in kinds
+            # a session-table eviction must not blind the capture:
+            # attribution falls back to the journey's own last leg
+            app["session_table"].forget(sid)
+            r = await client.post(
+                "/fleet/events", json={**breach, "state": "SLO_BREACH"}
+            )
+            assert r.status == 200
+            await asyncio.gather(*list(app["journey_tasks"]))
+            assert len(jl.evidence_for(jid)) == 2
+            assert a.hits["flight"] == [jid, jid]
+            m = await (await client.get("/metrics")).json()
+            assert m["journey_evidence_captured_total"] == 2
+        finally:
+            await client.close()
+            await a.close()
+
+    run(go())
+
+
+def test_journey_bundle_endpoint_one_get_and_chrome_merge():
+    """The one-GET incident bundle: router ring + stored evidence (the
+    dead agent's) + live fragments (the survivor's) in one body, and
+    ?format=chrome merging every captured leg into a single validated
+    Perfetto doc with per-agent disjoint pids."""
+    from test_obs import _validate_chrome
+
+    async def go():
+        a = await FakeAgent("a").start()
+        b = await FakeAgent("b").start()
+        events = StreamEventHandler(webhook_url=None, token=None)
+        app, client, reg = await _router([a, b], dead_after=2,
+                                         events=events)
+        try:
+            r = await client.post("/offer", json=_OFFER)
+            jid = r.headers["X-Journey-Id"]
+            sid = r.headers["X-Stream-Id"]
+            owner = app["session_table"].owner(sid)
+            dead_agent, live_agent = (a, b) if owner == "a" else (b, a)
+            # breach -> evidence banked from the soon-to-die agent
+            dead_agent.flight[jid] = _fragment(owner, jid, session=sid)
+            await client.post("/fleet/events", json={
+                "event": "StreamDegraded", "state": "RETRACE_BREACH",
+                "stream_id": sid, "room_id": "r1", "timestamp": 1,
+                "journey_id": jid,
+            })
+            await asyncio.gather(*list(app["journey_tasks"]))
+            # the agent dies; the client re-offers onto the survivor
+            rec = reg.agents[owner]
+            reg.note_poll_fail(rec)
+            reg.note_poll_fail(rec)
+            r = await client.post("/offer", json=_OFFER,
+                                  headers={"X-Journey-Id": jid})
+            assert r.status == 200
+            sid2 = r.headers["X-Stream-Id"]
+            live_agent.flight[jid] = _fragment(
+                live_agent.name, jid, session=sid2, taken_at=20.0,
+                snap_id="flt-2",
+            )
+            live_agent.flight[jid]["snapshots"][0]["journey"]["leg"] = 2
+
+            # ONE GET: the whole story
+            r = await client.get(f"/fleet/debug/journey/{jid}")
+            assert r.status == 200
+            bundle = await r.json()
+            kinds = [e["kind"] for e in bundle["journey"]["events"]]
+            for expected in ("placed", "degraded", "agent_dead",
+                             "re_placed"):
+                assert expected in kinds, kinds
+            # the dead agent's records came from the evidence store...
+            assert [e["agent"] for e in bundle["evidence"]] == [owner]
+            srcs = {f["source"] for f in bundle["fragments"]}
+            assert "unreachable" in srcs  # the corpse answers nothing
+            # ...the survivor's from the live fan-out
+            live = [f for f in bundle["fragments"]
+                    if f.get("source") == "live"]
+            assert [f["agent"] for f in live] == [live_agent.name]
+            assert bundle["bundles"]  # sealed on the alert paths
+            # every piece shares the one journey id
+            assert bundle["journey_id"] == jid
+            assert all(
+                s["journey"]["journey_id"] == jid
+                for f in live for s in f["snapshots"]
+            )
+
+            # the merged Perfetto doc validates with per-agent pids
+            r = await client.get(f"/fleet/debug/journey/{jid}",
+                                 params={"format": "chrome"})
+            assert r.status == 200
+            doc = await r.json()
+            evs = _validate_chrome(doc)
+            agent_by_pid = {
+                e["pid"]: e["args"]["agent"] for e in evs
+                if e["ph"] == "M" and e["name"] == "process_name"
+            }
+            assert len(agent_by_pid) >= 2
+            assert set(agent_by_pid.values()) == {owner, live_agent.name}
+            assert all(
+                e["args"]["journey_id"] == jid for e in evs
+                if e["ph"] == "X"
+            )
+
+            # crisp error surfaces: unknown id 404, unknown param 400,
+            # bad format 400 — all JSON bodies
+            r = await client.get("/fleet/debug/journey/j-nope")
+            assert r.status == 404 and "error" in await r.json()
+            r = await client.get(f"/fleet/debug/journey/{jid}",
+                                 params={"fromat": "chrome"})
+            assert r.status == 400
+            assert "fromat" in (await r.json())["error"]
+            r = await client.get(f"/fleet/debug/journey/{jid}",
+                                 params={"format": "jsonl"})
+            assert r.status == 400
+            # the directory endpoint lists it
+            idx = await (await client.get("/fleet/debug/journeys")).json()
+            assert [j["journey_id"] for j in idx["journeys"]] == [jid]
+            assert idx["journeys"][0]["legs"] == 2
+            assert idx["bundles"]
+        finally:
+            await client.close()
+            await a.close()
+            await b.close()
+
+    run(go())
+
+
+def test_journey_kill_switch_removes_plane(monkeypatch):
+    monkeypatch.setenv("JOURNEY_ENABLE", "0")
+
+    async def go():
+        a = await FakeAgent("a").start()
+        app, client, reg = await _router([a])
+        try:
+            assert app["journeys"] is None
+            r = await client.post("/offer", json=_OFFER)
+            assert r.status == 200
+            assert "X-Journey-Id" not in r.headers
+            assert a.sessions["a-s1"]["journey"] is None
+            r = await client.get("/fleet/debug/journeys")
+            assert r.status == 404 and "error" in await r.json()
+            r = await client.get("/fleet/debug/journey/j-x")
+            assert r.status == 404
+            m = await (await client.get("/metrics")).json()
+            assert "journeys_total" not in m
         finally:
             await client.close()
             await a.close()

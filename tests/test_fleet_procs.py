@@ -9,10 +9,18 @@ loopback media) on loopback ports behind an in-process fleet router:
 2. drain-to-zero — one agent drains via the admission-freeze rung while
    the OTHERS keep delivering every pumped frame, and flips
    ``recyclable`` once its sessions close;
-3. crash replacement — a SIGKILLed agent is declared DEAD by the poll
-   loop, its client is re-pointed through the webhook path
-   (StreamDegraded state=AGENT_DEAD), and the re-offer lands and
-   streams on a surviving agent.
+3. crash replacement + journey stitching (ISSUE 13) — the victim's
+   sessions degrade first (the real supervisor path: auto flight
+   snapshot + StreamDegraded webhook to the router's /fleet/events,
+   which auto-captures the agent's ``?journey=`` evidence), THEN the
+   agent is SIGKILLed mid-stream: the poll loop declares it DEAD, the
+   AGENT_DEAD webhook carries the ``journey_id``, the client re-offers
+   echoing it and lands on a survivor as leg 2 — and ONE
+   ``GET /fleet/debug/journey/<id>`` returns the stitched incident
+   bundle: router journey ring (placed → degraded → agent_dead →
+   re_placed) + the dead agent's auto-captured snapshot + the
+   survivor's live timeline, all sharing one journey id; the merged
+   ``?format=chrome`` export validates with per-agent disjoint pids.
 
 One test function: the 3 process spawns (~a second each, concurrent)
 are paid once for all three acceptance legs.
@@ -39,24 +47,30 @@ PROC = os.path.join(REPO, "tests", "fleet_agent_proc.py")
 
 AGENT_ENV = {
     # small + deterministic: 2 sessions per agent, no device planes, no
-    # warmup drops (pushed == delivered must hold exactly)
+    # warmup drops (pushed == delivered must hold exactly).  The flight
+    # recorder + tracing are ON: the journey-stitch leg needs the
+    # victim's auto-captured snapshot and sealed timelines.
     "OVERLOAD_MAX_SESSIONS": "2",
     "WARMUP_FRAMES": "0",
     "DROP_FRAMES": "0",
     "PIPELINE_DEPTH": "1",
     "DEVTEL_ENABLE": "0",
     "SLO_ENABLE": "0",
-    "FLIGHT_RECORDER": "0",
+    "FLIGHT_RECORDER": "1",
+    "TRACE_ENABLE": "1",
     "JAX_PLATFORMS": "cpu",
 }
 
 
 def _spawn_agents(n):
     procs = []
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env.update(AGENT_ENV)
-    for _ in range(n):
+    for i in range(n):
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env.update(AGENT_ENV)
+        # the agent's published identity — journey fragments stamp it,
+        # so the merged chrome export can tell the legs' agents apart
+        env["WORKER_ID"] = f"agent{i}"
         procs.append(subprocess.Popen(
             [sys.executable, PROC, "--port", "0"],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -166,10 +180,17 @@ def test_three_process_fleet(monkeypatch):
 
             # -- leg 1: placement by capacity spreads one per agent -----
             sids = []
+            jids = {}  # stream id -> journey id (the correlation key)
             for _ in range(3):
                 r = await client.post("/offer", json=_OFFER)
                 assert r.status == 200, await r.text()
-                sids.append(r.headers["X-Stream-Id"])
+                sid = r.headers["X-Stream-Id"]
+                sids.append(sid)
+                # the journey id is minted at placement, threaded to the
+                # agent and echoed on the answer
+                jids[sid] = r.headers["X-Journey-Id"]
+                assert r.headers["X-Journey-Leg"] == "1"
+            assert len(set(jids.values())) == 3
             owners = {sid: app["session_table"].owner(sid) for sid in sids}
             assert sorted(owners.values()) == sorted(names), owners
             for name in names:
@@ -183,6 +204,14 @@ def test_three_process_fleet(monkeypatch):
                 )
                 assert list(pumped["sessions"].values()) == [15], pumped
 
+            # point every agent's webhook plane at the router's ingest
+            # (the production WEBHOOK_URL wiring, set post-spawn because
+            # the router's port is only known now)
+            events_url = str(client.make_url("/fleet/events"))
+            for name in names:
+                await agent_post(by_name[name][1], "/_test/webhook",
+                                 {"url": events_url, "token": "t"})
+
             # -- leg 2: drain one agent to zero without touching others -
             drain_name = owners[sids[1]]
             keep = [n for n in names if n != drain_name]
@@ -194,9 +223,9 @@ def test_three_process_fleet(monkeypatch):
             # a new session never lands on the draining agent
             r = await client.post("/offer", json=_OFFER)
             assert r.status == 200
-            extra_owner = app["session_table"].owner(
-                r.headers["X-Stream-Id"]
-            )
+            extra_sid = r.headers["X-Stream-Id"]
+            jids[extra_sid] = r.headers["X-Journey-Id"]
+            extra_owner = app["session_table"].owner(extra_sid)
             assert extra_owner in keep
             # the OTHERS keep delivering every frame mid-drain
             for name in keep:
@@ -216,13 +245,34 @@ def test_three_process_fleet(monkeypatch):
 
             await _wait_for(drained, 15, "drain to zero")
 
-            # -- leg 3: crash replacement ------------------------------
+            # -- leg 3: crash replacement + journey stitching ----------
             crash_name = extra_owner  # owns sessions; NOT the drained box
+            crash_port = by_name[crash_name][1]
             crash_sids = [
                 sid for sid, e in list(app["session_table"]._m.items())
                 if e["agent"] == crash_name
             ]
             assert crash_sids
+
+            # seal some timelines on the victim (aged frames shed at
+            # ingest), then force the real breach path: DEGRADED ->
+            # auto flight snapshot -> StreamDegraded webhook -> the
+            # router pulls the agent's ?journey= evidence EAGERLY —
+            # the records that must survive the SIGKILL below
+            pumped = await agent_post(
+                crash_port, "/_test/pump", {"frames": 5, "stale": 3}
+            )
+            assert list(pumped["sessions"].values())
+            degraded = await agent_post(crash_port, "/_test/degrade", {})
+            assert set(degraded["sessions"].values()) == {"DEGRADED"}
+            crash_jids = [jids[sid] for sid in crash_sids]
+
+            async def evidence_banked():
+                jl = app["journeys"]
+                return all(jl.evidence_for(j) for j in crash_jids)
+
+            await _wait_for(evidence_banked, 15, "evidence auto-capture")
+
             by_name[crash_name][0].kill()
 
             async def dead():
@@ -242,22 +292,96 @@ def test_three_process_fleet(monkeypatch):
             assert all(
                 ev["event"] == "StreamDegraded" for ev in events_seen
             )
+            # the re-point webhook teaches the client its journey id
+            assert {ev["journey_id"] for ev in events_seen} == set(
+                crash_jids
+            )
 
-            # the re-pointed client re-offers through the router and
-            # lands on the ONE agent still taking sessions...
+            # the re-pointed client re-offers ECHOING the journey id and
+            # lands on the ONE agent still taking sessions as leg 2...
             survivor = [n for n in keep if n != crash_name][0]
-            r = await client.post("/offer", json=_OFFER)
+            crash_jid = events_seen[0]["journey_id"]
+            r = await client.post(
+                "/offer", json=_OFFER,
+                headers={"X-Journey-Id": crash_jid},
+            )
             assert r.status == 200, await r.text()
             new_sid = r.headers["X-Stream-Id"]
             assert app["session_table"].owner(new_sid) == survivor
+            assert r.headers["X-Journey-Id"] == crash_jid
+            assert r.headers["X-Journey-Leg"] == "2"
             # ...and the replacement session streams end to end (the
-            # agent-side PLI/keyframe machinery re-primes on connect)
+            # agent-side PLI/keyframe machinery re-primes on connect),
+            # sealing post-re-offer timelines for the bundle below
             pumped = await agent_post(
-                by_name[survivor][1], "/_test/pump", {"frames": 10}
+                by_name[survivor][1], "/_test/pump",
+                {"frames": 10, "stale": 3},
             )
             assert sum(pumped["sessions"].values()) == (
                 10 * len(pumped["sessions"])
             )
+
+            # -- the ISSUE 13 acceptance: ONE GET returns the stitched
+            # incident bundle for the whole cross-process journey
+            r = await client.get(f"/fleet/debug/journey/{crash_jid}")
+            assert r.status == 200
+            bundle = await r.json()
+            kinds = [e["kind"] for e in bundle["journey"]["events"]]
+            for expected in ("placed", "degraded", "agent_dead",
+                             "re_placed"):
+                assert expected in kinds, kinds
+            legs = bundle["journey"]["legs"]
+            assert [(leg["leg"], leg["agent"]) for leg in legs] == [
+                (1, crash_name), (2, survivor),
+            ]
+            # the dead agent's records came from the auto-captured
+            # evidence (its process is a corpse by now)...
+            ev = [e for e in bundle["evidence"] if e["agent"] == crash_name]
+            assert ev
+            dead_frag = ev[0]["fragment"]
+            dead_snaps = dead_frag["snapshots"]
+            assert dead_snaps, dead_frag
+            assert all(
+                s["journey"]["journey_id"] == crash_jid
+                and s["journey"]["agent"] == crash_name
+                for s in dead_snaps
+            )
+            # ...the auto-snapshot holds the supervisor DEGRADED event
+            # and the sealed (shed) timelines from before the crash
+            assert any(
+                e.get("kind") == "supervisor" and e.get("new") == "DEGRADED"
+                for s in dead_snaps for e in s["events"]
+            )
+            assert any(s["frames"] for s in dead_snaps)
+            assert {f["journey_id"]
+                    for s in dead_snaps for f in s["frames"]} <= {crash_jid}
+            assert "unreachable" in {
+                f["source"] for f in bundle["fragments"]
+            }
+            # ...and the survivor's live timeline joins the same journey
+            live = [f for f in bundle["fragments"]
+                    if f.get("source") == "live"]
+            assert [f["agent"] for f in live] == [survivor]
+            live_caps = live[0]["sessions"]
+            assert new_sid in live_caps
+            assert live_caps[new_sid]["journey"]["leg"] == 2
+            assert live_caps[new_sid]["frames"]  # post-re-offer timelines
+            assert bundle["bundles"], "alert paths sealed no bundle"
+
+            # the merged chrome export validates with per-agent pids
+            from test_obs import _validate_chrome
+
+            r = await client.get(
+                f"/fleet/debug/journey/{crash_jid}",
+                params={"format": "chrome"},
+            )
+            assert r.status == 200
+            evs = _validate_chrome(await r.json())
+            agent_by_pid = {
+                e["pid"]: e["args"].get("agent") for e in evs
+                if e["ph"] == "M" and e["name"] == "process_name"
+            }
+            assert {crash_name, survivor} <= set(agent_by_pid.values())
 
             # rollup reflects the whole story
             m = await (await client.get("/metrics")).json()
@@ -266,6 +390,11 @@ def test_three_process_fleet(monkeypatch):
             assert m["fleet_agents_died_total"] == 1
             assert m["fleet_sessions_repointed_total"] == len(crash_sids)
             assert m["fleet_placements_total"] == 5
+            assert m["journeys_total"] == 4
+            assert m["journey_legs_total"] == 5
+            assert m["journey_replacements_total"] == 1
+            assert m["journey_evidence_captured_total"] >= len(crash_sids)
+            assert m["journey_bundles_sealed_total"] >= 1
         finally:
             await http.close()
             await client.close()

@@ -108,6 +108,9 @@ def test_multipeer_aot_cache_roundtrip(bundle, tmp_path):
     )
 
 
+@pytest.mark.slow  # compile-heavy composition (own tiny-xl build + step):
+# the tiny-model sibling test_multipeer_per_peer_prompt_isolation keeps
+# per-slot prompt-update isolation in tier-1 (ISSUE 13 budget pairing)
 def test_multipeer_sdxl_extras_swap_on_prompt_update(rng):
     """Round-1 defect regression: per-slot prompt updates on an SDXL-style
     engine must swap the POOLED embeds (added_text), not just cond/uncond."""

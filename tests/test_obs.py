@@ -211,11 +211,15 @@ def test_snapshot_carries_frames_and_events():
 
 def _validate_chrome(doc: dict):
     """The satellite's conformance gate: parses, fields conform, spans per
-    track are well-formed (disjoint — nesting is spilled to lanes)."""
+    track are well-formed (disjoint — nesting is spilled to lanes).
+    Tracks are identified by (pid, tid): a merged multi-agent export
+    (obs/export.merge_chrome_traces) renders each source under its own
+    process id, and two processes' identically-numbered tids are
+    DIFFERENT tracks in the trace-event format."""
     doc = json.loads(json.dumps(doc))  # must survive a JSON round-trip
     events = doc["traceEvents"]
     assert isinstance(events, list) and events
-    by_tid: dict = {}
+    by_track: dict = {}
     for ev in events:
         assert ev["ph"] in ("M", "X", "i"), ev
         assert isinstance(ev["pid"], int)
@@ -228,13 +232,15 @@ def _validate_chrome(doc: dict):
         assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0.0
         if ev["ph"] == "X":
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0.0
-            by_tid.setdefault(ev["tid"], []).append((ev["ts"], ev["ts"] + ev["dur"]))
+            by_track.setdefault((ev["pid"], ev["tid"]), []).append(
+                (ev["ts"], ev["ts"] + ev["dur"])
+            )
         if ev["ph"] == "i":
             assert ev["s"] in ("t", "p", "g")
-    for tid, spans in by_tid.items():
+    for track, spans in by_track.items():
         spans.sort()
         for (_, end0), (start1, _) in zip(spans, spans[1:]):
-            assert start1 >= end0, f"overlapping spans on tid {tid}: {spans}"
+            assert start1 >= end0, f"overlapping spans on {track}: {spans}"
     return events
 
 
@@ -706,6 +712,150 @@ def test_chaos_degrade_autocaptures_flight_snapshot(monkeypatch):
             assert (
                 await client.get("/debug/flight", params={"format": "chrome"})
             ).status == 400
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# fleet journey correlation (ISSUE 13): header threading, the ?journey=
+# fragment selector, JSON error bodies, and the multi-source Chrome merge
+# ---------------------------------------------------------------------------
+
+def test_merge_chrome_traces_per_agent_pids_and_stamps():
+    """Two agents' captures merge into ONE Perfetto doc: disjoint pids,
+    journey/agent/leg stamped into process metadata and span args —
+    identically-numbered stage tids no longer collide across agents."""
+    from ai_rtc_agent_tpu.obs.export import merge_chrome_traces
+
+    snap_a = _synthetic_snapshot()
+    snap_b = _synthetic_snapshot()
+    snap_b["session"] = "s2"
+    doc = merge_chrome_traces(
+        [
+            (snap_a, {"journey_id": "j-1", "agent": "agent0", "leg": 1}),
+            (snap_b, {"journey_id": "j-1", "agent": "agent1", "leg": 2}),
+        ],
+        journey="j-1",
+    )
+    events = _validate_chrome(doc)
+    pids = {e["pid"] for e in events}
+    assert pids == {1, 2}
+    # per-agent disjoint pids: every event of one agent shares one pid
+    by_pid_agent = {}
+    for e in events:
+        if e["ph"] == "M" and e["name"] == "process_name":
+            by_pid_agent[e["pid"]] = e["args"]["agent"]
+            assert e["args"]["journey_id"] == "j-1"
+    assert by_pid_agent == {1: "agent0", 2: "agent1"}
+    # span args carry the stamp (Perfetto's "which leg is this" answer)
+    spans = [e for e in events if e["ph"] == "X"]
+    assert spans
+    assert all(e["args"]["journey_id"] == "j-1" for e in spans)
+    assert {e["args"]["leg"] for e in spans} == {1, 2}
+    assert doc["otherData"]["journey_id"] == "j-1"
+    assert len(doc["otherData"]["sources"]) == 2
+
+
+def test_agent_threads_journey_headers_and_serves_fragment(monkeypatch):
+    """The agent half of the tentpole: X-Journey-Id on /offer binds the
+    session's recorder/tracer/supervisor context, every snapshot +
+    sealed timeline carries it, and GET /debug/flight?journey= serves
+    the one-pull fragment the router's bundle fan-out consumes."""
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    monkeypatch.setenv("TRACE_ENABLE", "1")
+    monkeypatch.setenv("WORKER_ID", "agent-frag")
+
+    async def go():
+        app = build_app(pipeline=ChaosPipeline(), provider=LoopbackProvider())
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/offer",
+                json={
+                    "room_id": "jr",
+                    "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+                },
+                headers={"X-Journey-Id": "j-abc", "X-Journey-Leg": "2"},
+            )
+            assert r.status == 200
+            # the signaling answer echoes the binding
+            assert r.headers["X-Journey-Id"] == "j-abc"
+            assert r.headers["X-Journey-Leg"] == "2"
+            sid = r.headers["X-Stream-Id"]
+
+            # /health session snapshot carries the journey context
+            h = await (await client.get("/health")).json()
+            ctx = h["sessions"][sid]["context"]["journey"]
+            assert ctx["journey_id"] == "j-abc" and ctx["leg"] == 2
+
+            # stream a stale burst so timelines seal (the ingest hop
+            # sheds the aged frames, terminal-marking their traces —
+            # the loopback tier has no send hop to seal "sent" on)
+            pc = next(iter(app["pcs"]))
+            viewer = pc.out_tracks[0]
+            for fill in (10, 11):
+                await pc.in_track.push(_vframe(fill, age_s=10.0))
+            await pc.in_track.push(_vframe(20))
+            await asyncio.wait_for(viewer.recv(), timeout=3.0)
+
+            # an auto/on-demand snapshot carries the journey binding
+            snap_id = app["flight"].take_snapshot(sid, reason="test")
+            snap = app["flight"].get_snapshot(snap_id)
+            assert snap["journey"]["journey_id"] == "j-abc"
+            assert snap["journey"]["agent"] == "agent-frag"
+            # sealed timelines carry it too (the merged export's stamp)
+            assert snap["frames"]
+            assert all(
+                f["journey_id"] == "j-abc" and f["leg"] == 2
+                for f in snap["frames"]
+            )
+            # the black box logged the leg start
+            assert any(e["kind"] == "journey" for e in snap["events"])
+            # the index names the journey per stored snapshot
+            idx = await (await client.get("/debug/flight")).json()
+            assert any(
+                s["id"] == snap_id and s["journey_id"] == "j-abc"
+                for s in idx["snapshots"]
+            )
+
+            # the fragment: live capture + stored snapshot + devtel
+            r = await client.get(
+                "/debug/flight", params={"journey": "j-abc"}
+            )
+            assert r.status == 200
+            frag = await r.json()
+            assert frag["agent"] == "agent-frag"
+            assert sid in frag["sessions"]
+            assert [s["id"] for s in frag["snapshots"]] == [snap_id]
+            assert "recent_compiles" in frag["devtel"]
+
+            # unknown journey: 404 with a JSON error body (never an
+            # empty 200 a jq pipeline reads as success)
+            r = await client.get(
+                "/debug/flight", params={"journey": "j-none"}
+            )
+            assert r.status == 404
+            assert "error" in await r.json()
+            # journey fragments are JSON-only; merge happens router-side
+            r = await client.get(
+                "/debug/flight",
+                params={"journey": "j-abc", "format": "chrome"},
+            )
+            assert r.status == 400 and "error" in await r.json()
+            # unknown query params are rejected, not silently ignored
+            r = await client.get(
+                "/debug/flight", params={"sessoin": "typo"}
+            )
+            assert r.status == 400
+            assert "sessoin" in (await r.json())["error"]
+            # mixed selectors are ambiguous
+            r = await client.get(
+                "/debug/flight", params={"journey": "j-abc", "id": snap_id}
+            )
+            assert r.status == 400 and "error" in await r.json()
         finally:
             await client.close()
 

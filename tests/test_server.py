@@ -195,10 +195,10 @@ def test_offer_full_cycle_with_webhooks(monkeypatch):
         app["stream_event_handler"].webhook_url = None  # default: disabled
         # capture events instead of HTTP
         app["stream_event_handler"].handle_stream_started = (
-            lambda s, r: events.append(("started", r))
+            lambda s, r, **kw: events.append(("started", r))
         )
         app["stream_event_handler"].handle_stream_ended = (
-            lambda s, r: events.append(("ended", r))
+            lambda s, r, **kw: events.append(("ended", r))
         )
         try:
             r = await client.post(
