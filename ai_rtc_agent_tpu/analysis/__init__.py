@@ -10,8 +10,20 @@ fix).  This package encodes the rules as checkers over stdlib ``ast``
 (no new dependencies):
 
   async-blocking     blocking calls lexically inside ``async def``
+  bounded-queue      asyncio.Queue/deque without an explicit finite bound
+  device-transfer    device transfers outside the blessed staging/
+                     readback helpers (the whole-batch drain bug class)
   encoder-reconfig   encoder bitrate/GOP mutations outside the single
                      reconfigure() path (media/codec.py owns tr_h264_*)
+  lock-discipline    an attribute written under ``with self._lock:`` in
+                     one method, lock-free in another (the PR 5
+                     shared-flag race class)
+  loop-affinity      thread-tainted code touching loop-bound asyncio
+                     objects; async-def code blocking on threads (the
+                     PR 6 lock-on-the-loop incident)
+  task-lifecycle     spawned tasks / minted futures that never reach an
+                     owner on some path (fire-and-forget orphans; the
+                     PR 9 inline-batch unresolved-future hang)
   pooled-view        pool-returned memoryviews escaping frame scope
   span-pairing       trace.begin() without a matching end on some path
                      (obs/trace.py frame timelines must stay well-formed)

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import ast
 
-from .core import Finding, ScopedVisitor, dotted
+from .core import Finding, ScopedVisitor, dotted, import_maps
 
 CHECKER = "bounded-queue"
 
@@ -105,20 +105,6 @@ class _Visitor(ScopedVisitor):
         self.generic_visit(node)
 
 
-def _import_maps(tree) -> tuple[dict, dict]:
-    """-> (local name -> (module, original name), module alias -> module)."""
-    frm, mods = {}, {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module:
-            for a in node.names:
-                frm[a.asname or a.name] = (node.module, a.name)
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.asname:
-                    mods[a.asname] = a.name
-    return frm, mods
-
-
 def check(project) -> list:
     findings = []
     for mod in project.modules:
@@ -127,7 +113,7 @@ def check(project) -> list:
             or mod.rel in _EXEMPT_FILES
         ):
             continue
-        v = _Visitor(mod, *_import_maps(mod.tree))
+        v = _Visitor(mod, *import_maps(mod.tree))
         v.visit(mod.tree)
         findings.extend(v.findings)
     return findings

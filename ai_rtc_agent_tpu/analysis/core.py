@@ -217,11 +217,14 @@ def run_checkers(project: Project, checkers=None) -> list:
         device_transfers,
         encoder_reconfig,
         env_registry,
+        lock_discipline,
+        loop_affinity,
         metric_cardinality,
         metrics_registry,
         pooled_views,
         regressions,
         span_pairing,
+        task_lifecycle,
         trace_purity,
     )
 
@@ -230,9 +233,12 @@ def run_checkers(project: Project, checkers=None) -> list:
         "bounded-queue": bounded_queues.check,
         "device-transfer": device_transfers.check,
         "encoder-reconfig": encoder_reconfig.check,
+        "lock-discipline": lock_discipline.check,
+        "loop-affinity": loop_affinity.check,
         "metric-cardinality": metric_cardinality.check,
         "pooled-view": pooled_views.check,
         "span-pairing": span_pairing.check,
+        "task-lifecycle": task_lifecycle.check,
         "trace-purity": trace_purity.check,
         "env-registry": env_registry.check,
         "metrics-registry": metrics_registry.check,
@@ -253,9 +259,12 @@ ALL_CHECKERS = (
     "bounded-queue",
     "device-transfer",
     "encoder-reconfig",
+    "lock-discipline",
+    "loop-affinity",
     "metric-cardinality",
     "pooled-view",
     "span-pairing",
+    "task-lifecycle",
     "trace-purity",
     "env-registry",
     "metrics-registry",
@@ -294,6 +303,73 @@ def const_str(node):
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
     return None
+
+
+def attr_of_self(expr):
+    """'x' for ``self.x``, else None (the shared instance-attribute
+    convention of the concurrency checkers)."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+_LOCK_TOKENS = {"lock", "locks", "rlock", "mutex", "cond", "condition", "cv"}
+
+
+def lock_terminal(expr) -> str:
+    """Terminal identifier of a lock expression, unwrapping call forms
+    (``self._lock_for(key)`` names ``_lock_for``)."""
+    while isinstance(expr, ast.Call):
+        expr = expr.func
+    return terminal_name(expr)
+
+
+def lockish_name(expr) -> bool:
+    """Does the expression's terminal identifier name a lock?  Shared by
+    lock-discipline and loop-affinity so the two checkers can never
+    disagree about what counts as a lock.  Matching is per snake_case
+    TOKEN, not substring — ``_submit_lock``/``_ring_lock``/``_cv`` hit,
+    while ``_blocking_guard``/``_per_second``/``_clock`` do not (a
+    substring match would flag every ``block`` and ``seconds``)."""
+    tokens = lock_terminal(expr).lower().split("_")
+    return any(t in _LOCK_TOKENS for t in tokens)
+
+
+def import_maps(tree):
+    """-> (local name -> (module, original name), module alias -> module):
+    `from asyncio import Queue as Q` binds Q -> ("asyncio", "Queue") and
+    `import collections as c` binds c -> "collections", so renamed
+    imports cannot smuggle a flagged construct past a dotted-name scan."""
+    frm, mods = {}, {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                frm[a.asname or a.name] = (node.module, a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    mods[a.asname] = a.name
+    return frm, mods
+
+
+def canonical_dotted(func, frm, mods) -> str:
+    """``dotted(func)`` with the leading segment resolved through the
+    module's import aliases: ``Q(...)`` -> "asyncio.Queue",
+    ``aio.Event(...)`` -> "asyncio.Event"."""
+    d = dotted(func)
+    if not d:
+        return ""
+    parts = d.split(".")
+    if parts[0] in frm:
+        module, orig = frm[parts[0]]
+        parts = module.split(".") + [orig] + parts[1:]
+    elif parts[0] in mods:
+        parts = mods[parts[0]].split(".") + parts[1:]
+    return ".".join(parts)
 
 
 class ScopedVisitor(ast.NodeVisitor):

@@ -9,8 +9,8 @@ the exit stamps on every path) or prove, per function, that every
 ``begin`` reaches an ``end`` on **all** paths (early returns, raises,
 branches, loops), typically via ``try/finally``.
 
-This checker proves the latter with a small path-sensitive walk over the
-function body (same-function scope — traces don't hand open spans across
+This checker proves the latter with the shared path-sensitive walk in
+:mod:`.paths` (same-function scope — traces don't hand open spans across
 calls in this codebase):
 
 * tracked receivers: attribute calls whose receiver's terminal identifier
@@ -41,47 +41,36 @@ from __future__ import annotations
 import ast
 
 from .core import Finding, const_str, terminal_name
+from .paths import PathWalker, iter_matching
 
 CHECKER = "span-pairing"
 
 _WILDCARD = "<dynamic>"
-_STATE_CAP = 64  # path-state explosion bound; overflow is FLAGGED, not dropped
 
 
-def _is_trace_call(node, attr: str) -> bool:
+def _is_trace_call(node, attrs=("begin", "end")) -> bool:
     return (
         isinstance(node, ast.Call)
         and isinstance(node.func, ast.Attribute)
-        and node.func.attr == attr
+        and node.func.attr in attrs
         and "trace" in terminal_name(node.func.value).lower()
     )
 
 
-def _calls_in_order(node):
-    """begin/end calls in source (pre-order) position, not descending into
-    nested function/class definitions."""
-    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
-                         ast.ClassDef)):
-        return
-    if _is_trace_call(node, "begin") or _is_trace_call(node, "end"):
-        yield node
-    for child in ast.iter_child_nodes(node):
-        yield from _calls_in_order(child)
-
-
-class _FuncWalk:
-    """Path-sensitive begin/end balance for ONE function body."""
+class _SpanDomain:
+    """begin/end pairing semantics over :class:`~.paths.PathWalker`
+    states (tuples of open span names)."""
 
     def __init__(self, mod, scope: str):
         self.mod = mod
         self.scope = scope
         self.findings: list = []
         self._exit_lines: set = set()
-        self._overflow_at: int | None = None
 
-    # -- state transitions ----------------------------------------------------
+    def events(self, node):
+        yield from iter_matching(node, _is_trace_call)
 
-    def _apply_call(self, state: tuple, call: ast.Call) -> tuple:
+    def apply(self, state: tuple, call: ast.Call) -> tuple:
         if call.func.attr == "begin":
             name = const_str(call.args[0]) if call.args else None
             return state + (name if name is not None else _WILDCARD,)
@@ -106,117 +95,29 @@ class _FuncWalk:
         ))
         return state
 
-    def _apply_node(self, states: set, node) -> set:
-        for call in _calls_in_order(node):
-            states = {self._apply_call(st, call) for st in states}
-        return states
-
-    def _record_exit(self, states: set, line: int, finals: tuple, what: str):
-        for fin in reversed(finals):  # enclosing finally blocks still run
-            states = self._walk(fin, states, ())
-        for st in states:
-            if st and line not in self._exit_lines:
-                self._exit_lines.add(line)
-                self.findings.append(Finding(
-                    CHECKER, self.mod.rel, line, ",".join(st),
-                    f"span(s) {', '.join(st)} still open at {what} — "
-                    "close with end() on every path, or use "
-                    "`with trace.span(...)`", self.scope,
-                ))
-
-    # -- structured walk ------------------------------------------------------
-
-    def _walk(self, stmts, states: set, finals: tuple, seen: set | None = None) -> set:
-        """-> possible open-span states at normal fall-through.  ``seen``
-        (when walking a try body) accumulates every intermediate state —
-        an exception can fire between any two statements, so the handler
-        is entered from all of them, open spans included."""
-        for stmt in stmts:
-            if seen is not None:
-                seen |= states
-            if len(states) > _STATE_CAP:
-                # do NOT silently drop paths (a leaking path past the cap
-                # would scan clean) — flag the function as unprovable and
-                # bound the walk deterministically
-                if self._overflow_at is None:
-                    self._overflow_at = stmt.lineno
-                states = set(sorted(states)[:_STATE_CAP])
-            if isinstance(stmt, (ast.Return, ast.Raise)):
-                states = self._apply_node(states, stmt)
-                self._record_exit(
-                    states, stmt.lineno, finals,
-                    "return" if isinstance(stmt, ast.Return) else "raise",
-                )
-                return set()
-            if isinstance(stmt, ast.If):
-                states = self._apply_node(states, stmt.test)
-                a = self._walk(stmt.body, states, finals, seen)
-                b = self._walk(stmt.orelse, states, finals, seen)
-                states = a | b
-            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-                states = self._apply_node(states, stmt.iter)
-                once = self._walk(stmt.body, states, finals, seen)
-                states = self._walk(stmt.orelse, states | once, finals, seen)
-            elif isinstance(stmt, ast.While):
-                states = self._apply_node(states, stmt.test)
-                once = self._walk(stmt.body, states, finals, seen)
-                states = self._walk(stmt.orelse, states | once, finals, seen)
-            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-                for item in stmt.items:
-                    for call in _calls_in_order(item.context_expr):
-                        if call.func.attr == "begin":
-                            # `with trace.begin(...)` CRASHES at runtime:
-                            # begin() returns None, which is no context
-                            # manager — the with-form is trace.span()
-                            self.findings.append(Finding(
-                                CHECKER, self.mod.rel, call.lineno, "begin",
-                                "trace.begin() used as a `with` context — "
-                                "begin() returns None (TypeError at "
-                                "runtime); use `with trace.span(...)`",
-                                self.scope,
-                            ))
-                        else:
-                            states = {
-                                self._apply_call(st, call) for st in states
-                            }
-                states = self._walk(stmt.body, states, finals, seen)
-            elif isinstance(stmt, ast.Try):
-                inner_finals = (
-                    finals + (stmt.finalbody,) if stmt.finalbody else finals
-                )
-                # handlers are entered from EVERY intermediate state of
-                # the body — an exception firing between a begin and its
-                # end arrives at the handler with that span OPEN (the
-                # {entry} ∪ {body-complete} approximation missed exactly
-                # the leak class this checker exists to catch)
-                body_seen = set(states)
-                body_out = self._walk(stmt.body, states, inner_finals, body_seen)
-                handler_in = body_seen | body_out
-                if seen is not None:  # uncaught exceptions keep propagating
-                    seen |= body_seen
-                outs = self._walk(stmt.orelse, body_out, inner_finals, seen)
-                for h in stmt.handlers:
-                    outs |= self._walk(h.body, handler_in, inner_finals, seen)
-                if stmt.finalbody:
-                    outs = self._walk(stmt.finalbody, outs, finals, seen)
-                states = outs
-            else:
-                states = self._apply_node(states, stmt)
-        if seen is not None:
-            seen |= states
-        return states
-
-    def run(self, fn) -> list:
-        remaining = self._walk(fn.body, {()}, ())
-        self._record_exit(remaining, fn.lineno, (), "function exit")
-        if self._overflow_at is not None:
+    def with_event(self, call):
+        if call.func.attr == "begin":
+            # `with trace.begin(...)` CRASHES at runtime: begin() returns
+            # None, which is no context manager — the with-form is
+            # trace.span()
             self.findings.append(Finding(
-                CHECKER, self.mod.rel, self._overflow_at, "<state-overflow>",
-                "path-state overflow (>64 open-span states) — begin/end "
-                "balance not provable; simplify the function or use "
+                CHECKER, self.mod.rel, call.lineno, "begin",
+                "trace.begin() used as a `with` context — begin() returns "
+                "None (TypeError at runtime); use `with trace.span(...)`",
+                self.scope,
+            ))
+            return None
+        return call
+
+    def exit(self, state: tuple, line: int, what: str):
+        if state and line not in self._exit_lines:
+            self._exit_lines.add(line)
+            self.findings.append(Finding(
+                CHECKER, self.mod.rel, line, ",".join(state),
+                f"span(s) {', '.join(state)} still open at {what} — "
+                "close with end() on every path, or use "
                 "`with trace.span(...)`", self.scope,
             ))
-        return self.findings
 
 
 class _Collector(ast.NodeVisitor):
@@ -232,8 +133,20 @@ class _Collector(ast.NodeVisitor):
         self._stack.append(node.name)
         scope = ".".join(self._stack)
         # only pay the path walk when the function touches begin/end at all
-        if any(True for _ in _calls_in_order_body(node)):
-            self.findings.extend(_FuncWalk(self.mod, scope).run(node))
+        if any(
+            True for stmt in node.body
+            for _ in iter_matching(stmt, _is_trace_call)
+        ):
+            domain = _SpanDomain(self.mod, scope)
+            overflow = PathWalker(domain).run(node)
+            if overflow is not None:
+                domain.findings.append(Finding(
+                    CHECKER, self.mod.rel, overflow, "<state-overflow>",
+                    "path-state overflow (>64 open-span states) — begin/end "
+                    "balance not provable; simplify the function or use "
+                    "`with trace.span(...)`", scope,
+                ))
+            self.findings.extend(domain.findings)
         self.generic_visit(node)
         self._stack.pop()
 
@@ -244,11 +157,6 @@ class _Collector(ast.NodeVisitor):
         self._stack.append(node.name)
         self.generic_visit(node)
         self._stack.pop()
-
-
-def _calls_in_order_body(fn):
-    for stmt in fn.body:
-        yield from _calls_in_order(stmt)
 
 
 def check(project) -> list:

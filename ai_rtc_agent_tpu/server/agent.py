@@ -43,6 +43,7 @@ from ..resilience.supervisor import (
     worst_state,
 )
 from ..utils import env
+from ..utils.dispatch import spawn
 from ..utils.profiling import FrameStats
 from . import turn
 from .events import StreamEventHandler
@@ -423,7 +424,7 @@ async def _claim_pipeline(app, session_key: str | None = None):
             return None, None
 
         def release():
-            asyncio.ensure_future(asyncio.to_thread(peer.release))
+            spawn(asyncio.to_thread(peer.release))
 
         return peer, release
 
@@ -440,7 +441,7 @@ async def _claim_pipeline(app, session_key: str | None = None):
         )
 
     def release_session():
-        asyncio.ensure_future(asyncio.to_thread(session.release))
+        spawn(asyncio.to_thread(session.release))
 
     return session, release_session
 

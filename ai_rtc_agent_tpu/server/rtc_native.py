@@ -48,6 +48,7 @@ from ..media import rtcp as rtcp_mod
 from ..media import sockio
 from ..media.plane import H264RingSource, H264Sink
 from ..utils import env as env_util
+from ..utils.dispatch import spawn
 from ..utils.profiling import FrameStats
 from . import sdp
 
@@ -750,7 +751,7 @@ class NativeRtpPeerConnection:
         def dispatch(fn, *args):
             r = fn(*args)
             if asyncio.iscoroutine(r):
-                asyncio.ensure_future(r)
+                spawn(r)
 
         stats = self._provider.stats
         if stats is not None:
@@ -762,7 +763,7 @@ class NativeRtpPeerConnection:
             # DCEP open accepted — surface it exactly like aiortc does
             if stats is not None:
                 stats.count("datachannels")
-            asyncio.ensure_future(self._emit("datachannel", channel))
+            spawn(self._emit("datachannel", channel))
 
         def on_message(channel, message):
             if stats is not None:

@@ -837,7 +837,10 @@ class StreamEngine:
             )
             dh = shaped["unet_cache"]
             state["unet_cache"] = jnp.zeros(dh.shape, dh.dtype)
-            self._tick = 0  # first real submit captures a fresh cache
+            # first real submit captures a fresh cache; prepare() is the
+            # single-thread build phase — serving threads exist only
+            # after it returns the engine
+            self._tick = 0  # tpurtc: allow[lock-discipline] -- prepare() runs before the engine is shared; submit/update paths (the guarded writers) cannot be live yet
         self.state = state
         return self
 
@@ -937,7 +940,7 @@ class StreamEngine:
         """
         if self.state is None:
             raise RuntimeError("call prepare() first")
-        self.last_submit_was_skip = False
+        self.last_submit_was_skip = False  # tpurtc: allow[lock-discipline] -- thread-local descriptor (PR 5 fix): each calling thread writes only its own _submit_skip_flag slot
         if self._fault_scope is not None:
             # injected slow step (blocks this worker thread, simulating a
             # wedged device dispatch), DeviceLostError, or NaN output —

@@ -6,11 +6,47 @@ paths, and the agent registers ASYNC handlers on all of them
 never runs it (found via RuntimeWarnings in the secure soak test).  One
 helper instead of three hand-rolled dispatches, so the class of bug is
 fixed once.
+
+:func:`spawn` is the blessed fire-and-forget spelling the task-lifecycle
+checker points at: a bare ``asyncio.ensure_future(coro)`` drops the only
+strong reference (the loop keeps a weak one — the task can be collected
+mid-flight) and leaves its exception unretrieved.  ``spawn`` parks the
+task in a module registry until done and logs the failure from the
+done-callback, so "background" never means "silently lost".
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
+
+logger = logging.getLogger(__name__)
+
+#: strong refs to in-flight background tasks; the done-callback discards,
+#: so the registry is bounded by what is genuinely still running
+_BACKGROUND: set = set()
+
+
+def _reap(task) -> None:
+    _BACKGROUND.discard(task)
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        name = getattr(task, "get_name", lambda: "<future>")()
+        logger.error("background task %s failed: %r", name, exc,
+                     exc_info=exc)
+
+
+def spawn(coro) -> "asyncio.Task":
+    """Schedule ``coro`` fire-and-forget, KEEPING ownership: a strong
+    reference until completion plus exception retrieval in the
+    done-callback (the task-lifecycle registry sink).  Raises
+    ``RuntimeError`` exactly like ``ensure_future`` when no loop runs."""
+    task = asyncio.ensure_future(coro)
+    _BACKGROUND.add(task)
+    task.add_done_callback(_reap)
+    return task
 
 
 def fire_handler(handler) -> None:
@@ -21,6 +57,6 @@ def fire_handler(handler) -> None:
     r = handler()
     if asyncio.iscoroutine(r):
         try:
-            asyncio.ensure_future(r)
+            spawn(r)
         except RuntimeError:
             r.close()

@@ -105,9 +105,12 @@ def main(argv=None) -> int:
     if files is not None:
         # a partial scan set cannot prove registry completeness (unread
         # knobs / metric collisions live across files) — per-file rules only
+        # (the concurrency trio resolves same-module/same-class and is
+        # per-file by construction)
         checkers = ("async-blocking", "bounded-queue", "device-transfer",
-                    "encoder-reconfig", "metric-cardinality", "pooled-view",
-                    "span-pairing", "trace-purity", "retry-4xx",
+                    "encoder-reconfig", "lock-discipline", "loop-affinity",
+                    "metric-cardinality", "pooled-view", "span-pairing",
+                    "task-lifecycle", "trace-purity", "retry-4xx",
                     "restart-defaults")
 
     project, parse_errors = load_project(root, files=files)

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Local CI entry point (docs/static-analysis.md).
+#
+# Two rungs, fast first:
+#   1. the git-scoped analyzer pass over exactly what you touched
+#      (check_static --changed: per-file checkers, suppression hygiene,
+#      baseline discipline — seconds);
+#   2. the full static-analysis tier-1 gate in-process
+#      (tests/test_static_analysis.py: every checker against its
+#      known-bad fixture, precision pins, AND the repo-wide
+#      zero-findings-with-EMPTY-baseline scan — the same gate tier-1
+#      runs, so a green precommit cannot be vetoed by the analyzer gate
+#      in CI).
+#
+# Usage:  scripts/precommit.sh [--fast]
+#   --fast   rung 1 only (the pre-every-commit loop; run the full gate
+#            before pushing)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== check_static --changed"
+python scripts/check_static.py --changed
+
+if [[ "${1:-}" != "--fast" ]]; then
+    echo "== static-analysis tier-1 gate (in-process repo scan)"
+    python -m pytest tests/test_static_analysis.py -q \
+        -p no:cacheprovider -p no:randomly
+fi
+
+echo "precommit: clean"

@@ -1,0 +1,64 @@
+"""Known-bad fixture for the lock-discipline checker.
+
+``BadSharedEngine`` reproduces the shape of the PR 5 shipped bug:
+sessions share ONE engine, and ``submit`` wrote a flag lock-free at the
+top while also writing it (and the skip counter) under ``_submit_lock``
+further down — a concurrent session's ``to_thread`` hop read the other
+session's write (the shipped fix made the flag thread-local).  The
+checker's signal is MIXED DISCIPLINE: the guarded write declares the
+attribute shared, so every lock-free write elsewhere in the class is a
+race half-fixed.
+
+``OkEngine`` pins the clean spellings: all writes guarded, ``__init__``
+construction writes, the ``*_locked`` caller-holds-the-lock suffix
+idiom, and a reasoned suppression for a proven single-thread phase.
+"""
+
+import threading
+
+
+class BadSharedEngine:
+    def __init__(self):
+        self._submit_lock = threading.Lock()
+        self.last_submit_was_skip = False
+        self._skip_count = 0
+
+    def submit(self, frame):
+        self.last_submit_was_skip = False  # BAD: lock-free write
+        with self._submit_lock:
+            if self._similar(frame):
+                self.last_submit_was_skip = True  # guarded: mixed!
+                self._skip_count += 1
+                return None
+            self._skip_count = 0
+            return frame
+
+    def reset(self):
+        self._skip_count = 0  # BAD: lock-free write elsewhere
+
+    def _similar(self, frame):
+        return False
+
+
+class OkEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tick = 0  # ok: construction happens before sharing
+        self._mode = "full"
+
+    def submit(self, frame):
+        with self._lock:
+            self._tick += 1  # ok: guarded
+            return self._advance_locked(frame)
+
+    def _advance_locked(self, frame):
+        self._mode = "cached"  # ok: *_locked = caller holds the lock
+        return frame
+
+    def set_mode(self, mode):
+        with self._lock:
+            self._mode = mode  # ok: guarded
+
+    def prepare(self):
+        # ok only with the proof attached: reasoned suppression
+        self._tick = 0  # tpurtc: allow[lock-discipline] -- prepare() runs before worker threads exist

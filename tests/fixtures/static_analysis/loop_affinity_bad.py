@@ -1,0 +1,169 @@
+"""Known-bad fixture for the loop-affinity checker.
+
+``BadSinkActuation`` reproduces the shape of the PR 6 shipped bug: the
+network-adaptation tick actuated ``sink.reconfigure()`` ON the event
+loop, and reconfigure takes ``_enc_lock`` — the lock a codec worker
+holds across whole encodes — so one rung move stalled every session
+sharing the loop.  The fix pushed actuation to ``run_in_executor``
+(``OkSinkActuation``).  ``BadDispatcher`` is the thread side: a
+dispatcher thread touching loop-bound asyncio objects directly
+(``put_nowait`` on an asyncio.Queue, ``set_result`` on a
+``create_future`` future, ``set`` on an asyncio.Event, ``call_later``)
+instead of crossing via ``call_soon_threadsafe`` /
+``run_coroutine_threadsafe`` — the ok_* spellings.  Thread-safe
+primitives (``queue.Queue``, ``threading.Event``,
+``concurrent.futures.Future``) stay clean by construction.
+"""
+
+import asyncio
+import queue
+import threading
+from asyncio import Event as AEvent, Queue as AQueue
+from concurrent.futures import Future
+
+
+class BadDispatcher:
+    def __init__(self, loop):
+        self._loop = loop
+        self._frames: asyncio.Queue = asyncio.Queue(maxsize=8)
+        self._ready = asyncio.Event()
+        self._handoff: queue.Queue = queue.Queue(maxsize=8)
+        self._done = threading.Event()
+        self._thread = None
+
+    async def arm(self):
+        self._waiter = asyncio.get_running_loop().create_future()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+        self._thread.start()
+
+    def _drive(self):
+        while True:
+            item = self._step()
+            self._frames.put_nowait(item)  # BAD: asyncio queue off-loop
+            self._waiter.set_result(item)  # BAD: asyncio future off-loop
+            self._ready.set()  # BAD: asyncio event off-loop
+            self._loop.call_later(0.1, self._tick)  # BAD: loop-only API
+            self._loop.create_task(self._notify())  # BAD: loop-only API
+
+    def _step(self):
+        return None
+
+    def _tick(self):
+        pass
+
+    async def _notify(self):
+        pass
+
+
+class OkDispatcher:
+    def __init__(self, loop):
+        self._loop = loop
+        self._frames: asyncio.Queue = asyncio.Queue(maxsize=8)
+        self._ready = asyncio.Event()
+        self._handoff: queue.Queue = queue.Queue(maxsize=8)
+        self._done = threading.Event()
+        self._row_fut: Future = Future()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join()
+
+    def _drive(self):
+        item = self._step()
+        # ok: the threadsafe crossings
+        self._loop.call_soon_threadsafe(self._frames.put_nowait, item)
+        self._loop.call_soon_threadsafe(self._ready.set)
+        asyncio.run_coroutine_threadsafe(self._notify(), self._loop)
+        # ok: thread-safe primitives are THE handoff tier
+        self._handoff.put_nowait(item)
+        self._done.set()
+        self._row_fut.set_result(item)
+
+    def _step(self):
+        return None
+
+    async def _notify(self):
+        pass
+
+
+class BadAliasDispatcher:
+    """Renamed imports cannot smuggle an asyncio object past the taint:
+    ``from asyncio import Queue as AQueue`` resolves to the same
+    canonical origin (the bounded-queue alias discipline)."""
+
+    def __init__(self):
+        self._frames = AQueue(maxsize=8)
+        self._ready = AEvent()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+        self._thread.start()
+
+    def _drive(self):
+        item = self._step()
+        self._frames.put_nowait(item)  # BAD: alias-imported asyncio queue
+        self._ready.set()  # BAD: alias-imported asyncio event
+
+    def _step(self):
+        return None
+
+
+class BadSinkActuation:
+    """The PR 6 _enc_lock-on-the-loop incident, in shape."""
+
+    def __init__(self):
+        self._enc_lock = threading.Lock()
+
+    async def apply_profile(self, profile):
+        with self._enc_lock:  # BAD: threading lock on the event loop
+            self._set_rate(profile)
+
+    async def apply_profile_worse(self, profile):
+        with self._enc_lock:  # BAD: and held ACROSS an await
+            await self._push_config(profile)
+
+    def _set_rate(self, profile):
+        pass
+
+    async def _push_config(self, profile):
+        pass
+
+
+class BadResultWait:
+    async def fetch(self, pool, coro, loop):
+        handle = pool.submit(self._work)
+        out = handle.result()  # BAD: blocks the loop on a worker
+        fut = asyncio.run_coroutine_threadsafe(coro, loop)
+        val = fut.result()  # BAD: the canonical hybrid deadlock
+        direct = asyncio.run_coroutine_threadsafe(coro, loop).result()  # BAD
+        return out, val, direct
+
+    def _work(self):
+        pass
+
+
+class OkSinkActuation:
+    def __init__(self):
+        self._enc_lock = threading.Lock()
+
+    async def apply_profile(self, profile):
+        loop = asyncio.get_running_loop()
+        # ok: the lock is taken on a worker, off the loop
+        await loop.run_in_executor(None, self._actuate, profile)
+
+    async def await_cross_thread(self, pool):
+        loop = asyncio.get_running_loop()
+        handle = loop.run_in_executor(None, self._work)
+        return await handle  # ok: awaited, never .result()
+
+    def _actuate(self, profile):
+        with self._enc_lock:  # ok: sync executor-side code may lock
+            self._work()
+
+    def _work(self):
+        pass

@@ -1,0 +1,175 @@
+"""Known-bad fixture for the task-lifecycle checker.
+
+``BadInlineBatch.submit`` reproduces the shape of the PR 9 shipped bug:
+the inline fast path resolved a pending future selected by SLOT ORDER
+(pop the oldest) and assumed it was the submitter's own — when it was
+not, the future the caller actually awaited was abandoned unresolved and
+the fetch hung for the full 120 s timeout.  The fixed spelling
+identifies the submitter's entry by PENDING IDENTITY and resolves a
+future on every path (``ok_submit``).
+
+The orphan-task shapes are the PR 13 review class ("_on_cleanup cancels
+pending pulls"): a spawn whose result is discarded, a bound task that an
+early return abandons before the registry add, a task attribute no
+method of the class ever cancels, and a rebind that drops a still-unowned
+task.  The ok_* spellings are the repo's real disciplines: registry add
++ done-callback (server/events.py), self._task with cancel in stop()
+(every tick loop), await/return/gather handoffs.
+"""
+
+import asyncio
+from concurrent.futures import Future
+
+
+class BadSpawner:
+    def kick(self):
+        asyncio.ensure_future(self._pull())  # BAD: discarded task
+
+    def kick_on_loop(self, loop):
+        loop.create_task(self._pull())  # BAD: discarded task
+
+    def kick_conditional(self):
+        self._started or asyncio.ensure_future(self._pull())  # BAD
+
+    def kick_ternary(self, fast):
+        asyncio.ensure_future(self._pull()) if fast else None  # BAD
+
+    def kick_comprehension(self, coros):
+        [asyncio.ensure_future(c) for c in coros]  # BAD: list discarded
+
+    def pull_fast_path(self, fast):
+        t = asyncio.create_task(self._pull())
+        if fast:
+            return None  # BAD: t orphaned on the early-return path
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return t
+
+    def double_kick(self):
+        t = asyncio.create_task(self._pull())
+        t = asyncio.create_task(self._pull())  # BAD: first t still unowned
+        self._tasks.add(t)
+
+    def start(self):
+        # BAD: no method of BadSpawner ever cancels/awaits _poll_task
+        self._poll_task = asyncio.create_task(self._poll())
+
+    async def _pull(self):
+        pass
+
+    async def _poll(self):
+        pass
+
+
+class BadInlineBatch:
+    """The PR 9 inline-batch hang, in shape: resolve-by-slot-order."""
+
+    def submit(self, frame):
+        fut = Future()
+        if self._batch_ready():
+            # inline fast path: the submit that completes the batch
+            # dispatches it and resolves the slot's OLDEST pending entry,
+            # ASSUMING it was this submitter's own — the future the
+            # caller will actually block on is dropped unresolved
+            self._resolve_oldest(self._step(frame))
+            return self._last_out  # BAD: fut never resolved/enqueued
+        self._enqueue(frame, fut)
+        return fut
+
+    def _batch_ready(self):
+        return True
+
+    def _resolve_oldest(self, out):
+        pass
+
+    def _step(self, frame):
+        return frame
+
+    def _enqueue(self, frame, fut):
+        pass
+
+
+class OkSpawner:
+    def __init__(self):
+        self._tasks: set = set()
+        self._task = None
+
+    def kick(self):
+        task = asyncio.ensure_future(self._pull())
+        self._tasks.add(task)  # ok: registry owns it
+        task.add_done_callback(self._tasks.discard)
+
+    def start(self):
+        self._task = asyncio.create_task(self._poll())
+
+    def stop(self):
+        if self._task is not None:
+            self._task.cancel()  # ok: the class owns its loop
+
+    async def run_once(self):
+        await asyncio.create_task(self._pull())  # ok: awaited
+
+    def handoff(self):
+        return asyncio.create_task(self._pull())  # ok: caller owns it
+
+    async def fan_out(self):
+        a = asyncio.create_task(self._pull())
+        b = asyncio.create_task(self._pull())
+        await asyncio.gather(a, b)  # ok: both escape into gather
+
+    async def _pull(self):
+        pass
+
+    async def _poll(self):
+        pass
+
+
+class OkGroup:
+    """Structured concurrency: a TaskGroup owns, awaits and cancels its
+    children — ``tg.create_task`` is never a source."""
+
+    async def run(self):
+        async with asyncio.TaskGroup() as tg:
+            tg.create_task(self._pull())  # ok: the group owns it
+            last = tg.create_task(self._pull())  # ok: same, bound or not
+        return last
+
+    async def _pull(self):
+        pass
+
+
+class OkInlineBatch:
+    """Pending-identity discipline: a future is resolved or handed off on
+    EVERY path."""
+
+    def submit(self, frame):
+        fut = Future()
+        if self._batch_ready():
+            out = self._step(frame)
+            entry = self._pop_pending()
+            if entry is not None and entry.fut is not fut:
+                entry.fut.set_result(out)  # the rider's own future
+            fut.set_result(out)  # ok: the submitter's future resolves too
+            return fut
+        self._enqueue(frame, fut)  # ok: escapes into the pending queue
+        return fut
+
+    def cancel_all(self, exc):
+        fut = Future()
+        try:
+            self._enqueue(None, fut)
+        except RuntimeError:
+            fut.set_exception(exc)  # ok: resolved on the failure path
+        return fut
+
+    def _batch_ready(self):
+        return False
+
+    def _pop_pending(self):
+        return None
+
+    def _step(self, frame):
+        return frame
+
+    def _enqueue(self, frame, fut):
+        pass

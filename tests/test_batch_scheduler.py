@@ -66,6 +66,7 @@ def test_equivalence_bit_identical_subprocess():
         assert int(leg_lines[0].split()[1]) >= floor
 
 
+@pytest.mark.slow
 def test_sharded_equivalence_subprocess():
     """ISSUE 12 acceptance pin: the dp=2 mesh-sharded scheduler vs
     dedicated engines across join/leave spanning the shard boundary,
@@ -74,7 +75,11 @@ def test_sharded_equivalence_subprocess():
     a single uint8 rounding tie (the virtual-device flag changes XLA's
     CPU thread partitioning between the sharded batch-k and batch-1
     graphs — PR 7's documented tie class; the driver reports the count,
-    observed 0 on this box)."""
+    observed 0 on this box).
+
+    Slow tier (ISSUE 14 budget shave): the dp COMPOSITION leg — tier-1
+    keeps the single-device equivalence driver, the dp churn/retrace pin
+    and the shard-aware key coverage as the lighter siblings."""
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)
     env.pop("XLA_FLAGS", None)  # the driver forces its own 8-device flag

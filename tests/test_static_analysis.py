@@ -358,6 +358,123 @@ def test_metric_cardinality_exempts_operator_tooling(tmp_path):
     assert [f.path for f in fs] == ["ai_rtc_agent_tpu/exp.py"]
 
 
+# -- the concurrency-discipline trio (ISSUE 14) ------------------------------
+
+def test_task_lifecycle_catches_orphans_and_the_pr9_hang():
+    """Fire-and-forget spawns, early-return orphans, rebind-while-unowned,
+    never-cancelled task attributes — and the PR 9 inline-batch shape: a
+    pending future abandoned unresolved on the fast path (resolve-by-slot
+    instead of pending identity; the 120 s fetch hang)."""
+    fs = run_on(["task_lifecycle_bad.py"], ("task-lifecycle",))
+    by_scope = {}
+    for f in fs:
+        by_scope.setdefault(f.scope, []).append(f)
+    msgs = " | ".join(f.message for f in fs)
+    assert "BadSpawner.kick" in by_scope  # discarded ensure_future
+    assert "BadSpawner.kick_on_loop" in by_scope  # discarded loop.create_task
+    # value-discarded nested spellings flag too: `x or spawn`, ternary,
+    # bare-statement comprehension
+    assert "BadSpawner.kick_conditional" in by_scope
+    assert "BadSpawner.kick_ternary" in by_scope
+    assert "BadSpawner.kick_comprehension" in by_scope
+    assert "BadSpawner.pull_fast_path" in by_scope  # early-return orphan
+    assert "BadSpawner.double_kick" in by_scope  # rebind while unowned
+    assert "BadSpawner.start" in by_scope  # attr never cancelled
+    assert "BadInlineBatch.submit" in by_scope  # the PR 9 hang shape
+    assert "fire-and-forget" in msgs
+    assert "no method of BadSpawner ever cancels" in msgs
+    assert "unresolved on this path" in msgs  # the future family
+    assert len(fs) == 9, "\n".join(f.render() for f in fs)
+    # precision: registry+done-callback, stop() cancel, await/return/
+    # gather handoffs, and pending-identity resolution all stay clean
+    assert not any(f.scope.startswith("Ok") for f in fs), by_scope
+
+
+def test_loop_affinity_catches_thread_and_loop_sides():
+    """Thread-tainted code touching loop-bound objects (asyncio Queue/
+    Event/future, call_later/create_task) and async-def code blocking on
+    threads (.result() on a cross-thread future, a threading lock on the
+    loop — the PR 6 _enc_lock incident, flagged bare AND across-await)."""
+    fs = run_on(["loop_affinity_bad.py"], ("loop-affinity",))
+    scopes = {f.scope for f in fs}
+    msgs = " | ".join(f.message for f in fs)
+    assert "BadDispatcher._drive" in scopes  # the thread side
+    assert "asyncio.Queue" in msgs and "asyncio.Event" in msgs
+    assert "asyncio future set_result" in msgs
+    assert "loop-only API" in msgs
+    assert "BadSinkActuation.apply_profile" in scopes  # PR 6 shape
+    assert "BadSinkActuation.apply_profile_worse" in scopes
+    assert "ACROSS an await" in msgs
+    assert "BadResultWait.fetch" in scopes
+    assert "blocking .result()" in msgs
+    # renamed imports resolve to the canonical asyncio origin (the
+    # bounded-queue alias discipline)
+    assert "BadAliasDispatcher._drive" in scopes
+    assert len(fs) == 12, "\n".join(f.render() for f in fs)
+    # precision: call_soon_threadsafe / run_coroutine_threadsafe
+    # crossings, queue.Queue / threading.Event / concurrent Future
+    # handoffs, and run_in_executor actuation all stay clean
+    assert not any(f.scope.startswith("Ok") for f in fs), scopes
+
+
+def test_lock_discipline_catches_mixed_writes():
+    """The PR 5 shared-flag shape: an attribute written under the submit
+    lock in one place and lock-free in another — both stray writes
+    flagged; guarded writes, __init__, the *_locked caller-holds idiom
+    and a reasoned single-thread-phase suppression stay clean."""
+    fs = run_on(["lock_discipline_bad.py"], ("lock-discipline",))
+    got = {(f.scope, f.name) for f in fs}
+    assert ("BadSharedEngine.submit", "last_submit_was_skip") in got
+    assert ("BadSharedEngine.reset", "_skip_count") in got
+    assert len(fs) == 2, "\n".join(f.render() for f in fs)
+    assert not any(f.scope.startswith("OkEngine") for f in fs), got
+    assert all("mixed lock discipline" in f.message for f in fs)
+
+
+def test_concurrency_trio_passes_the_fixed_repo_code():
+    """The three incidents' REAL (post-fix) sites scan clean: the
+    analyzers demonstrably separate the shipped bugs from their fixes."""
+    files = [
+        str(REPO / "ai_rtc_agent_tpu" / "stream" / "engine.py"),  # PR 5
+        str(REPO / "ai_rtc_agent_tpu" / "stream" / "scheduler.py"),  # PR 9
+        str(REPO / "ai_rtc_agent_tpu" / "server" / "rtc_native.py"),  # PR 6
+        str(REPO / "ai_rtc_agent_tpu" / "resilience" / "supervisor.py"),
+        str(REPO / "ai_rtc_agent_tpu" / "utils" / "dispatch.py"),
+    ]
+    project, errs = load_project(REPO, files=files)
+    assert not errs
+    fs = run_checkers(
+        project, ("task-lifecycle", "loop-affinity", "lock-discipline")
+    )
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_concurrency_trio_exempts_operator_tooling(tmp_path):
+    """scripts/, examples/ and bench.py drive short-lived processes, not
+    the serving hybrid — same carve-out as bounded-queue."""
+    root = tmp_path
+    (root / "scripts").mkdir()
+    (root / "ai_rtc_agent_tpu").mkdir()
+    body = "import asyncio\n\n\ndef f(c):\n    asyncio.ensure_future(c)\n"
+    (root / "scripts" / "tool.py").write_text(body)
+    (root / "bench.py").write_text(body)
+    (root / "ai_rtc_agent_tpu" / "serving.py").write_text(body)
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("task-lifecycle",))
+    assert [f.path for f in fs] == ["ai_rtc_agent_tpu/serving.py"]
+
+
+def test_span_pairing_unchanged_on_the_shared_paths_engine():
+    """ISSUE 14 tentpole refactor: span-pairing now rides analysis/paths
+    — same findings, and the engine is genuinely shared (not a copy)."""
+    from ai_rtc_agent_tpu.analysis import span_pairing, task_lifecycle
+    from ai_rtc_agent_tpu.analysis.paths import PathWalker
+
+    assert span_pairing.PathWalker is PathWalker
+    assert task_lifecycle.PathWalker is PathWalker
+
+
 # -- shipped-bug reproductions (ROADMAP open items 2 and 3) ------------------
 
 def test_retry_4xx_reproduces_shipped_worker_bug():
