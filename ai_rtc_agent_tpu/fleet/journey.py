@@ -55,6 +55,10 @@ JOURNEY_EVENTS = (
     "started",       # StreamStarted webhook arrived (first-frame proxy)
     "degraded",      # StreamDegraded-family breach webhook arrived
     "agent_dead",    # the serving agent was declared DEAD
+    "migrated",      # state moved to another agent (drain-as-move /
+                     # crash restore) — the re-offer continues as leg+1
+    "migrate_failed",  # a migration attempt aborted; the source keeps
+                       # serving (kill-drain semantics take over)
     "ended",         # StreamEnded webhook arrived
     "evidence",      # an agent-side capture was stored
     "bundle",        # the journey was sealed into the incident store

@@ -281,11 +281,13 @@ class FleetRegistry:
 
     # -- placement ------------------------------------------------------------
 
-    def pick(self, exclude=()) -> AgentRecord | None:
+    def pick(self, exclude=(), healthy_only: bool = False) -> AgentRecord | None:
         """The least-loaded agent a new session should land on, or None.
         HEALTHY agents strictly first; DEGRADED ones only when no
         healthy agent can take the session (degraded still serves —
         refuse the fleet over it only when nothing better exists).
+        ``healthy_only`` drops the DEGRADED fallback — a migration
+        TARGET must be a box worth moving to, not one already alerting.
         Least-loaded = most effective free capacity (unbounded sorts
         first), ties broken by fewest live sessions."""
         now = self._clock()
@@ -293,7 +295,7 @@ class FleetRegistry:
             r for r in self.agents.values()
             if r.agent_id not in exclude and r.available(now)
         ]
-        for tier in ("HEALTHY", "DEGRADED"):
+        for tier in ("HEALTHY",) if healthy_only else ("HEALTHY", "DEGRADED"):
             tier_recs = [r for r in candidates if r.state == tier]
             if not tier_recs:
                 continue

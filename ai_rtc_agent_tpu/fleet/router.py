@@ -44,11 +44,15 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import collections
 import json
 import logging
+import time
+import uuid
 
 from aiohttp import web
 
+from ..resilience.retry import RetryError, RetryPolicy
 from ..server.events import StreamEventHandler
 from ..utils import env
 from ..utils.profiling import FrameStats
@@ -108,6 +112,15 @@ class _SessionTable:
         entry = self._m.get(stream_id)
         return entry["agent"] if entry else None
 
+    def sessions_of(self, agent_id: str) -> list[tuple[str, dict]]:
+        """Non-destructive twin of :meth:`pop_agent_sessions` — the
+        migrate-drain sweep reads the worklist while the SOURCE keeps
+        every mapping until its sessions actually move or end."""
+        return [
+            (sid, dict(e)) for sid, e in self._m.items()
+            if e["agent"] == agent_id
+        ]
+
     def forget(self, stream_id: str):
         self._m.pop(stream_id, None)
 
@@ -154,11 +167,26 @@ async def _place_and_proxy(request: web.Request, path: str,
     journeys: JourneyLog | None = app["journeys"]
     journey_id = None
     leg = 1
+    pinned = None
     if journeys is not None:
         echoed = request.headers.get("X-Journey-Id")
         if journeys.known(echoed):
             journey_id = echoed
             leg = journeys.next_leg(echoed)
+            # a migrated journey's re-offer is PINNED to the agent that
+            # already holds its imported stream state: the adoption
+            # token rides the forwarded headers and the agent resumes
+            # the session mid-stream instead of claiming fresh.  The pin
+            # is one-shot — consumed here whether or not the attempt
+            # lands (the target's unadopted import expires on its TTL).
+            mig = app["migrations"].pop(echoed, None)
+            if mig is not None and (
+                time.monotonic() - mig["ts"] <= _PIN_TTL_S
+            ):
+                cand = reg.agents.get(mig["target"])
+                if cand is not None and cand.state != "DEAD":
+                    pinned = cand
+                    headers["X-Migrated-Session"] = mig["token"]
         else:
             journey_id = journeys.mint()
         headers["X-Journey-Id"] = journey_id
@@ -167,7 +195,13 @@ async def _place_and_proxy(request: web.Request, path: str,
     tried: set = set()
     hint: float | None = None
     for _ in range(app["place_attempts"]):
-        rec = reg.pick(exclude=tried)
+        if pinned is not None:
+            rec, pinned = pinned, None
+        else:
+            # only the pinned target holds the imported state — every
+            # fallback placement must claim fresh, not adopt
+            headers.pop("X-Migrated-Session", None)
+            rec = reg.pick(exclude=tried)
         if rec is None:
             break
         tried.add(rec.agent_id)
@@ -342,6 +376,9 @@ async def fleet_events(request):
         # send spurious AGENT_DEAD re-points to long-idle clients and
         # crowd live sessions out of the bounded table under churn
         request.app["session_table"].forget(stream_id)
+        # its banked migration snapshot is dead weight too (and must
+        # never crash-restore a stream the client already ended)
+        request.app["snapshot_bank"].pop(stream_id, None)
     return web.Response(text="OK")
 
 
@@ -456,12 +493,321 @@ def _capture_evidence(app, journey_id: str, agent_id: str,
     task.add_done_callback(_done)
 
 
+# ---------------------------------------------------------------------------
+# live session migration (ISSUE 15): drain-as-move + crash restore
+# ---------------------------------------------------------------------------
+
+# how long a banked snapshot stays "recent" for the crash-restore path
+# (not an operator knob: it tracks the migration sweep's own lifetime,
+# and a stale stream state is worse than a clean keyframe re-prime)
+_SNAPSHOT_BANK_TTL_S = 120.0
+_BOUNDED_MAP = 256  # migrations pin table + snapshot bank bound
+# a re-offer pin is only honored while the target's parked import can
+# still be adopted (server/agent.py _IMPORTED_TTL_S): a stale pin would
+# bypass placement's load/health checks to chase a token that already
+# expired
+_PIN_TTL_S = 30.0
+
+
+class _MigrateRefused(Exception):
+    """4xx from a migration peer — terminal after ONE attempt (the
+    retry-4xx rule: a schema/fingerprint refusal cannot succeed on
+    retry, and hammering it re-ships the PR 3 publish bug)."""
+
+
+class _MigrateTransient(Exception):
+    """5xx / connection trouble from a migration peer — retryable."""
+
+
+def _remember_bounded(d: dict, key, value, bound: int = _BOUNDED_MAP):
+    """Insertion-ordered bounded map (the _SessionTable discipline):
+    oldest-first eviction so a burst cannot grow router memory."""
+    d.pop(key, None)
+    while len(d) >= bound:
+        d.pop(next(iter(d)))
+    d[key] = value
+
+
+async def _migrate_call(app, method: str, rec, path: str, *,
+                        params=None, json_body=None):
+    """One migration HTTP exchange riding the shared RetryPolicy:
+    bounded per-attempt timeout (the proxy timeout), full-jitter backoff
+    on transient trouble, and a 4xx TERMINAL after one attempt.
+    -> (body dict | None, error string | None)."""
+    import aiohttp
+
+    policy = RetryPolicy(
+        attempts=3, base_delay_s=0.2, max_delay_s=1.0, full_jitter=True
+    )
+
+    async def attempt():
+        try:
+            async with app["http"].request(
+                method, rec.base_url + path, params=params, json=json_body,
+                timeout=aiohttp.ClientTimeout(total=app["proxy_timeout_s"]),
+            ) as resp:
+                if 200 <= resp.status < 300:
+                    try:
+                        body = await resp.json()
+                    except ValueError as e:
+                        raise _MigrateTransient(f"bad JSON body: {e}") from e
+                    if not isinstance(body, dict):
+                        raise _MigrateRefused("non-object body")
+                    return body
+                text = (await resp.text())[:200]
+                if 400 <= resp.status < 500:
+                    raise _MigrateRefused(f"HTTP {resp.status}: {text}")
+                raise _MigrateTransient(f"HTTP {resp.status}: {text}")
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            raise _MigrateTransient(str(e)) from e
+
+    try:
+        body = await policy.arun(
+            attempt, retry_on=(_MigrateTransient,),
+            label=f"migrate {path}",
+        )
+        return body, None
+    except _MigrateRefused as e:
+        return None, f"refused: {e}"
+    except RetryError as e:
+        return None, str(e.last or e)
+
+
+
+
+async def _import_and_repoint(app, sid: str, entry: dict, snapshot: dict,
+                              source_id: str, reason: str) -> bool:
+    """The shared tail of drain-as-move and crash restore: land the
+    snapshot on the least-loaded HEALTHY target, pin the journey's next
+    re-offer to it (the adoption token), and only then tell the client
+    to move (StreamMigrated).  False leaves the source — when it still
+    exists — serving untouched."""
+    journeys: JourneyLog | None = app["journeys"]
+    stats: FrameStats = app["stats"]
+    jid = entry.get("journey_id")
+    if journeys is None or not journeys.known(jid):
+        # without a live journey record the client's re-offer can never
+        # be pinned to the target — the import would park unadopted,
+        # burning a slot + reservation while the client re-primes fresh.
+        # Refuse up front; kill-drain semantics keep the session alive.
+        return _migrate_failed(
+            app, sid, entry, source_id,
+            "no journey correlation — the re-offer cannot be pinned",
+        )
+    target = app["fleet"].pick(exclude={source_id}, healthy_only=True)
+    if target is None:
+        return _migrate_failed(
+            app, sid, entry, source_id, "no healthy migration target"
+        )
+    token = f"mig-{uuid.uuid4().hex[:12]}"
+    body, err = await _migrate_call(
+        app, "POST", target, "/migrate/import",
+        json_body={"token": token, "snapshot": snapshot},
+    )
+    if body is None:
+        return _migrate_failed(
+            app, sid, entry, source_id,
+            f"import on {target.agent_id} failed: {err}",
+        )
+    # the journey's next re-offer lands on the target holding the
+    # imported state — the adoption handshake the agent completes
+    _remember_bounded(app["migrations"], jid, {
+        "target": target.agent_id, "token": token,
+        "ts": time.monotonic(),
+    })
+    journeys.note(
+        jid, "migrated", source=source_id,
+        target=target.agent_id, stream_id=sid, reason=reason,
+    )
+    # the session moved: its banked export must never crash-restore a
+    # SECOND copy if the (now-empty) source dies inside the bank TTL
+    app["snapshot_bank"].pop(sid, None)
+    stats.count("migrations")
+    handler: StreamEventHandler = app["fleet_events"]
+    journey = (
+        {"journey_id": jid, "leg": entry.get("leg", 1)} if jid else None
+    )
+    handler.handle_stream_migrated(
+        sid, entry.get("room_id", ""), source_id, target.agent_id,
+        reason=reason, journey=journey,
+    )
+    return True
+
+
+def _migrate_failed(app, sid: str, entry: dict, source_id: str,
+                    why: str) -> bool:
+    """One migration giving up: counted, ringed, and — when the journey
+    plane is on — the SOURCE's evidence captured now (the failure may be
+    the first symptom of the incident that kills it next)."""
+    journeys: JourneyLog | None = app["journeys"]
+    app["stats"].count("migrations_failed")
+    logger.warning("migration of %s aborted: %s", sid, why)
+    jid = entry.get("journey_id")
+    if journeys is not None and journeys.known(jid):
+        journeys.note(jid, "migrate_failed", stream_id=sid, why=why[:200])
+        src = app["fleet"].agents.get(source_id)
+        if src is not None and src.state != "DEAD":
+            # a corpse answers no pulls (the crash-restore path's source)
+            # — don't burn a bounded capture-task slot on it
+            _capture_evidence(app, jid, source_id)
+    return False
+
+
+async def _migrate_session(app, rec, sid: str, entry: dict) -> bool:
+    """Move ONE session off a draining agent — export, then the shared
+    import/re-point tail.  Every failure is abort-safe: the source keeps
+    serving and the kill-drain finishes the job."""
+    snapshot, err = await _migrate_call(
+        app, "GET", rec, "/migrate/export", params={"session": sid},
+    )
+    if snapshot is None:
+        if app["session_table"].owner(sid) != rec.agent_id:
+            # the session ended naturally while queued in the sweep
+            # (StreamEnded pruned the table, the agent 404s the export):
+            # the drain got what it wanted — this is NOT a failed
+            # migration and must not pollute the failure metrics or
+            # capture incident evidence
+            logger.info(
+                "migration of %s skipped: session ended mid-sweep", sid
+            )
+            return False
+        return _migrate_failed(
+            app, sid, entry, rec.agent_id, f"export failed: {err}"
+        )
+    # bank the freshest export per stream (bounded, TTL'd): the
+    # AGENT_DEAD crash path restores from here when the source dies
+    # after exporting but before the client moved
+    _remember_bounded(app["snapshot_bank"], sid, {
+        "snapshot": snapshot, "ts": time.monotonic(),
+    })
+    return await _import_and_repoint(
+        app, sid, entry, snapshot, rec.agent_id, reason="drain"
+    )
+
+
+async def _run_migrate_drain(app, rec, sessions, gen: int):
+    """The drain-as-move sweep: every live session on the draining agent
+    is exported, imported on a healthy target and re-pointed — at most
+    MIGRATE_MAX_PARALLEL in flight, the whole sweep bounded by
+    MIGRATE_TIMEOUT_S.  On timeout (or per-session failure) the
+    remaining sessions simply keep the existing kill-drain semantics:
+    admission stays frozen and they finish naturally.  ``gen`` is this
+    sweep's drain generation: cancel (and any restart) bumps it, so a
+    stale sweep's queued work can never run concurrently with — or
+    instead of — the sweep the operator actually asked for."""
+    t0 = time.monotonic()
+    sem = asyncio.Semaphore(app["migrate_max_parallel"])
+    moved = 0
+
+    async def one(sid, entry):
+        nonlocal moved
+        async with sem:
+            if not rec.draining or app["drain_gen"].get(
+                rec.agent_id
+            ) != gen:
+                # action=cancel mid-sweep (or a cancel/restart cycle that
+                # superseded this sweep): in-flight moves finish, but no
+                # NEW session leaves under a drain the operator revoked
+                return
+            t_sess = time.monotonic()
+            if await _migrate_session(app, rec, sid, entry):
+                moved += 1
+                # per-SESSION export-to-re-point latency (the semaphore
+                # queue wait is not migration time)
+                app["migration_ms"].append(
+                    round(1e3 * (time.monotonic() - t_sess), 3)
+                )
+
+    try:
+        results = await asyncio.wait_for(
+            asyncio.gather(
+                *[one(s, e) for s, e in sessions], return_exceptions=True
+            ),
+            timeout=app["migrate_timeout_s"],
+        )
+        for r in results:
+            if isinstance(r, BaseException):
+                # an unexpected per-session error (outside _migrate_call's
+                # handled set) must not abort the sweep's bookkeeping or
+                # die unretrieved — that session simply keeps kill-drain
+                # semantics
+                logger.exception(
+                    "migrate-drain move raised", exc_info=r
+                )
+    except asyncio.TimeoutError:
+        app["stats"].count("migration_fallbacks")
+        logger.warning(
+            "migrate-drain of %s hit MIGRATE_TIMEOUT_S with %d/%d moved "
+            "— falling back to kill-drain for the rest",
+            rec.agent_id, moved, len(sessions),
+        )
+    logger.info(
+        "migrate-drain of %s: %d/%d sessions moved in %.1fs",
+        rec.agent_id, moved, len(sessions), time.monotonic() - t0,
+    )
+
+
+async def _crash_restore(app, rec, sid: str, entry: dict, banked: dict):
+    """AGENT_DEAD with a recent snapshot banked: reuse the migration
+    restore surface so the client resumes MID-STREAM instead of
+    re-priming from a keyframe.  Any failure falls back to the plain
+    AGENT_DEAD re-point — the client still learns to re-offer."""
+    ok = False
+    try:
+        ok = await _import_and_repoint(
+            app, sid, entry, banked["snapshot"], rec.agent_id,
+            reason="agent_dead",
+        )
+    except Exception:
+        logger.exception("crash restore of %s failed", sid)
+    if not ok:
+        app["fleet_events"].handle_session_state(
+            sid, entry.get("room_id", ""), "AGENT_DEAD",
+            f"agent {rec.agent_id} is unreachable — re-offer through "
+            f"the router to land on a replacement",
+            journey=(
+                {"journey_id": entry.get("journey_id"),
+                 "leg": entry.get("leg", 1)}
+                if entry.get("journey_id") else None
+            ),
+        )
+
+
+def _next_drain_gen(app, agent_id: str) -> int:
+    """Mint this agent's next drain generation from ONE router-wide
+    monotonic counter: generation numbers are unique forever, so even if
+    the bounded per-agent map evicts an entry under pathological churn,
+    a later drain/cancel can never re-mint a number a stale sweep still
+    holds (eviction then only STOPS a sweep early — the safe direction —
+    never resurrects a cancelled one)."""
+    gen = app["drain_gen_next"]
+    app["drain_gen_next"] = gen + 1
+    _remember_bounded(app["drain_gen"], agent_id, gen)
+    return gen
+
+
+def _spawn_migrate_task(app, coro):
+    """Migration background work: strong-ref'd in the bounded task set,
+    reaped by done-callback (the task-lifecycle discipline)."""
+    tasks: set = app["migrate_tasks"]
+    task = asyncio.get_running_loop().create_task(coro)
+    tasks.add(task)
+    task.add_done_callback(tasks.discard)
+    return task
+
+
 async def fleet_drain(request):
-    """POST /fleet/drain?agent=ID[&action=start|cancel]: stop routing to
-    the agent AND flip its own admission-freeze rung (the agent stops
-    admitting locally — sessions arriving around the router are refused
-    too), then let live sessions finish; /fleet/health flips
-    ``recyclable`` at zero.  ``cancel`` reverts both sides."""
+    """POST /fleet/drain?agent=ID[&action=start|cancel][&mode=kill|migrate]:
+    stop routing to the agent AND flip its own admission-freeze rung (the
+    agent stops admitting locally — sessions arriving around the router
+    are refused too).  ``mode=kill`` (default) then lets live sessions
+    finish; ``mode=migrate`` MOVES them — each session's stream state is
+    exported, imported on the least-loaded healthy target, and the client
+    re-pointed (StreamMigrated), falling back to kill-drain semantics per
+    session on any failure and wholesale after MIGRATE_TIMEOUT_S.
+    /fleet/health flips ``recyclable`` at zero.  ``cancel`` reverts both
+    sides (in-flight moves finish but no new ones start... their targets'
+    unadopted imports expire on their own TTL)."""
     import aiohttp
 
     app = request.app
@@ -474,9 +820,69 @@ async def fleet_drain(request):
     action = request.query.get("action", "start")
     if action not in ("start", "cancel"):
         return web.Response(status=400, text="action must be start|cancel")
+    mode = request.query.get("mode", "kill")
+    if mode not in ("kill", "migrate"):
+        return web.Response(status=400, text="mode must be kill|migrate")
     starting = action == "start"
-    if starting and not rec.draining:
+    was_draining = rec.draining
+    migrating = 0
+    if starting and mode == "migrate":
+        if not app["migrate_enabled"]:
+            return web.Response(
+                status=409,
+                text="session migration disabled (MIGRATE_ENABLE=0) — "
+                     "drain with mode=kill",
+            )
+        if app["journeys"] is None:
+            # migration rides the journey plane end to end (the pin that
+            # routes the re-offer to the imported state is keyed by
+            # journey id) — without it every "move" would silently
+            # degrade to a fresh re-prime while burning target slots
+            return web.Response(
+                status=409,
+                text="mode=migrate needs the journey plane "
+                     "(JOURNEY_ENABLE=0) — drain with mode=kill",
+            )
+        active_sweep = app["migrate_sweeps"].get(agent_id)
+        if active_sweep is not None and active_sweep == app[
+            "drain_gen"
+        ].get(agent_id):
+            # a CURRENT-generation sweep is active: an operator retry
+            # must not spawn a second concurrent one over the same
+            # sessions.  A SUPERSEDED sweep (cancel bumped the gen)
+            # merely finishing its in-flight moves does NOT block a
+            # restart — cancel-then-restart must migrate, not silently
+            # degrade to kill semantics.
+            sessions = []
+        else:
+            # no active sweep — this also upgrades a plain kill-drain to
+            # move-not-kill, and re-migrates whatever a timed-out sweep
+            # left behind (the re-assertion is visible as migrating=N)
+            sessions = app["session_table"].sessions_of(agent_id)
+        migrating = len(sessions)
+        if sessions:
+            rec.draining = True  # before the sweep: its cancel guard
+            gen = _next_drain_gen(app, agent_id)
+            _remember_bounded(app["migrate_sweeps"], agent_id, gen)
+            task = _spawn_migrate_task(
+                app, _run_migrate_drain(app, rec, sessions, gen)
+            )
+
+            def _sweep_done(_t, a=agent_id, g=gen):
+                # only THIS sweep's registration — a newer sweep that
+                # replaced the entry must not be unregistered by the
+                # old task finishing late
+                if app["migrate_sweeps"].get(a) == g:
+                    app["migrate_sweeps"].pop(a, None)
+
+            task.add_done_callback(_sweep_done)
+    if starting and not was_draining:
         app["stats"].count("fleet_drains")
+    if not starting:
+        # cancel supersedes any in-flight sweep: mint a fresh generation
+        # so its queued moves die even if a new drain re-flips
+        # rec.draining before they reach the semaphore
+        _next_drain_gen(app, agent_id)
     rec.draining = starting
     if starting:
         rec.state = "DRAINING" if rec.state != "DEAD" else rec.state
@@ -505,6 +911,8 @@ async def fleet_drain(request):
         "recyclable": rec.recyclable,
         "live_sessions": rec.live_sessions,
         "agent_ack": agent_ack,
+        "mode": mode if starting else "cancel",
+        "migrating": migrating,
     })
 
 
@@ -688,6 +1096,14 @@ async def metrics(request):
     out.update(app["fleet"].snapshot())
     out["fleet_sessions_tracked"] = len(app["session_table"])
     out["fleet_session_table_evicted"] = app["session_table"].evicted
+    # live-migration rollup (aggregate only — no per-session/per-agent
+    # labels ever; migrations_total/_failed_total land via FrameStats)
+    out["migration_snapshots_banked"] = len(app["snapshot_bank"])
+    samples = sorted(app["migration_ms"])
+    if samples:
+        n = len(samples)
+        out["migration_ms_p50"] = round(samples[n // 2], 3)
+        out["migration_ms_p99"] = round(samples[min(n - 1, int(n * 0.99))], 3)
     if app["journeys"] is not None:
         # journey rollup (fleet/journey.py): aggregate counters + the
         # placement→first-frame percentiles — the journey id itself is
@@ -720,6 +1136,7 @@ def _on_agent_dead(app):
         handler: StreamEventHandler = app["fleet_events"]
         stats: FrameStats = app["stats"]
         journeys: JourneyLog | None = app["journeys"]
+        now = time.monotonic()
         for sid, entry in app["session_table"].pop_agent_sessions(
             rec.agent_id
         ):
@@ -733,6 +1150,22 @@ def _on_agent_dead(app):
                 # bundle is whatever evidence the breach path banked
                 journeys.seal_bundle(jid, f"AGENT_DEAD {rec.agent_id}")
                 journey = {"journey_id": jid, "leg": entry.get("leg", 1)}
+            banked = (
+                app["snapshot_bank"].get(sid)
+                if app["migrate_enabled"] else None
+            )
+            if banked is not None and (
+                now - banked["ts"] <= _SNAPSHOT_BANK_TTL_S
+            ):
+                # a recent snapshot exists (an interrupted drain-as-move
+                # exported it before the agent died): reuse the restore
+                # surface — the client resumes MID-STREAM instead of
+                # re-priming from a keyframe.  Failure inside falls back
+                # to the plain AGENT_DEAD re-point below.
+                _spawn_migrate_task(
+                    app, _crash_restore(app, rec, sid, entry, banked)
+                )
+                continue
             handler.handle_session_state(
                 sid, entry.get("room_id", ""), "AGENT_DEAD",
                 f"agent {rec.agent_id} is unreachable — re-offer through "
@@ -758,10 +1191,13 @@ async def _on_cleanup(app):
     poller = app.get("poller")
     if poller is not None:
         await poller.stop()
-    # cancel pending evidence pulls BEFORE closing their shared session
-    # — a queued task touching a closed ClientSession dies with an
-    # unretrieved RuntimeError instead of a clean cancellation
-    tasks = list(app.get("journey_tasks", ()))
+    # cancel pending evidence pulls + migration sweeps BEFORE closing
+    # their shared session — a queued task touching a closed
+    # ClientSession dies with an unretrieved RuntimeError instead of a
+    # clean cancellation
+    tasks = list(app.get("journey_tasks", ())) + list(
+        app.get("migrate_tasks", ())
+    )
     for task in tasks:
         task.cancel()
     if tasks:
@@ -799,6 +1235,20 @@ def build_router_app(
     )
     app["journey_tasks"] = set()
     app["journey_inflight"] = set()  # (journey_id, agent_id) pull dedup
+    # live session migration (docs/fleet.md "Drain runbook"): drain-as-
+    # move + crash restore; MIGRATE_ENABLE=0 kills the whole surface
+    app["migrate_enabled"] = env.migrate_enabled()
+    app["migrate_timeout_s"] = env.get_float("MIGRATE_TIMEOUT_S", 30.0)
+    app["migrate_max_parallel"] = max(
+        1, env.get_int("MIGRATE_MAX_PARALLEL", 2)
+    )
+    app["migrations"] = {}     # journey_id -> re-offer pin (bounded)
+    app["snapshot_bank"] = {}  # stream_id -> freshest export (bounded)
+    app["drain_gen"] = {}      # agent_id -> sweep generation (bounded)
+    app["drain_gen_next"] = 1  # router-wide monotonic generation mint
+    app["migrate_sweeps"] = {}  # agent_id -> gen of its ACTIVE sweep task
+    app["migrate_tasks"] = set()
+    app["migration_ms"] = collections.deque(maxlen=512)
     app["fleet"].on_dead = _on_agent_dead(app)
 
     app.on_startup.append(_on_startup)

@@ -76,6 +76,14 @@ _HELP = {
     "journey_place_to_start_ms_p50": "placement-to-first-frame latency, median (bounded reservoir)",
     "journey_place_to_start_ms_p95": "placement-to-first-frame latency, p95",
     "journey_place_to_start_ms_p99": "placement-to-first-frame latency, p99",
+    # live session migration (fleet/router.py drain-as-move + crash
+    # restore): aggregate-only — never a per-session/per-agent label
+    "migrations_total": "sessions moved to another agent (drain-as-move + crash restore)",
+    "migrations_failed_total": "migration attempts aborted (source kept serving; kill-drain semantics)",
+    "migration_fallbacks_total": "migrate-drains that hit MIGRATE_TIMEOUT_S and fell back to kill-drain",
+    "migration_snapshots_banked": "recent session exports held for the crash-restore path (bounded, TTL'd)",
+    "migration_ms_p50": "export-to-re-point migration latency, median (bounded reservoir)",
+    "migration_ms_p99": "export-to-re-point migration latency, p99",
 }
 
 

@@ -1,26 +1,178 @@
-"""Trainer checkpoint/resume — the training-side persistence tier.
+"""Checkpoint/serialize pytrees — the persistence tier.
 
-The reference is inference-only; its "checkpoints" are weight/engine caches
-(SURVEY.md section 5).  The TPU rebuild ships a real sharded trainer
-(parallel/trainer.py), so it also ships real checkpointing: orbax-backed
-save/restore of the full train state (params + optimizer + step), correct
-under dp/tp/sp sharding — restore places leaves back onto the SAME mesh
-shardings the trainer computed, so a resumed run is bitwise-continuous.
+Two independent tiers share this module:
 
-Layout: ``<dir>/step_<N>/`` orbax PyTree checkpoints, latest-step resolution
-mirrors the HF-snapshot convention used by the inference caches.
+* **Trainer checkpoints** (orbax-backed, directory-shaped): save/restore
+  of the full train state (params + optimizer + step), correct under
+  dp/tp/sp sharding — restore places leaves back onto the SAME mesh
+  shardings the trainer computed, so a resumed run is bitwise-continuous.
+  Layout: ``<dir>/step_<N>/`` orbax PyTree checkpoints, latest-step
+  resolution mirrors the HF-snapshot convention of the inference caches.
+
+* **Wire-shaped pytree blobs** (:func:`serialize_pytree` /
+  :func:`deserialize_pytree`): one self-describing byte string per
+  pytree, BIT-EXACT for every leaf kind the serving state actually
+  carries (f32/bf16 state rows, uint8 frame buffers, uint32 PRNG key
+  arrays) — the live-session-migration payload (stream/scheduler.py
+  ``snapshot_session``/``restore_session``) rides exactly this.  The
+  format is versioned and checksummed per leaf, and deserialization
+  REFUSES corrupt or truncated blobs instead of installing garbage into
+  a serving state row.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import re
+import struct
+import zlib
 
 import jax
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+# -- wire-shaped pytree blobs ------------------------------------------------
+
+# magic + format version in one: bump on ANY layout change so an old
+# reader refuses a new blob loudly (the migration surface layers its own
+# session-schema version on top — this one guards the byte layout)
+_PYTREE_MAGIC = b"TPRTPT01"
+
+
+def _dtype_of(name: str) -> np.dtype:
+    """dtype-by-name lookup covering the ml_dtypes extension types
+    (bfloat16 & friends) numpy alone cannot spell."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import ml_dtypes  # jax dependency — always importable next to it
+
+    try:
+        return np.dtype(getattr(ml_dtypes, name))
+    except (AttributeError, TypeError) as e:
+        raise ValueError(f"pytree blob names unknown dtype {name!r}") from e
+
+
+def _encode_node(node, leaves: list, buffers: list):
+    """Recursive structure spec for JSON-able containers of arrays.
+    Dict keys sort-stable (sorted), list/tuple order preserved; python
+    scalars ride the spec itself.  Leaves append to ``leaves``/``buffers``
+    and the spec references them by index."""
+    if isinstance(node, dict):
+        return {
+            "t": "dict",
+            "k": {str(k): _encode_node(node[k], leaves, buffers)
+                  for k in sorted(node)},
+        }
+    if isinstance(node, (list, tuple)):
+        return {
+            "t": "list" if isinstance(node, list) else "tuple",
+            "v": [_encode_node(x, leaves, buffers) for x in node],
+        }
+    if node is None or isinstance(node, (bool, int, float, str)):
+        return {"t": "py", "v": node}
+    arr = np.asarray(node)
+    raw = arr.tobytes()  # C-order, bit-exact for every fixed-width dtype
+    idx = len(leaves)
+    leaves.append({
+        "dtype": arr.dtype.name,
+        "shape": list(arr.shape),
+        "nbytes": len(raw),
+        "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+    })
+    buffers.append(raw)
+    return {"t": "leaf", "i": idx}
+
+
+def _decode_node(spec, arrays):
+    t = spec.get("t")
+    if t == "dict":
+        return {k: _decode_node(v, arrays) for k, v in spec["k"].items()}
+    if t in ("list", "tuple"):
+        seq = [_decode_node(v, arrays) for v in spec["v"]]
+        return seq if t == "list" else tuple(seq)
+    if t == "py":
+        return spec.get("v")
+    if t == "leaf":
+        return arrays[spec["i"]]
+    raise ValueError(f"pytree blob spec carries unknown node type {t!r}")
+
+
+def serialize_pytree(tree) -> bytes:
+    """One self-describing blob for a nested dict/list/tuple pytree of
+    arrays and python scalars.  Bit-exact round trip for every
+    fixed-width dtype (incl. the ml_dtypes bfloat16 family): each leaf
+    is raw C-order bytes with dtype/shape/crc32 recorded in the header.
+    Device arrays are pulled to host here — callers snapshotting live
+    serving state do this OUTSIDE their dispatch locks."""
+    leaves: list = []
+    buffers: list = []
+    spec = _encode_node(tree, leaves, buffers)
+    offset = 0
+    for leaf, raw in zip(leaves, buffers):
+        leaf["offset"] = offset
+        offset += len(raw)
+    header = json.dumps(
+        {"version": 1, "tree": spec, "leaves": leaves},
+        separators=(",", ":"),
+    ).encode("utf-8")
+    return b"".join(
+        [_PYTREE_MAGIC, struct.pack("<I", len(header)), header] + buffers
+    )
+
+
+def deserialize_pytree(data: bytes):
+    """Inverse of :func:`serialize_pytree`; leaves come back as numpy
+    arrays (callers re-place onto devices/shardings themselves).
+    Raises ``ValueError`` on ANY corruption: bad magic, truncated
+    header or payload, undecodable spec, per-leaf checksum mismatch —
+    a migration restore must refuse, never install garbage."""
+    data = bytes(data)
+    if len(data) < len(_PYTREE_MAGIC) + 4:
+        raise ValueError("pytree blob truncated (no header)")
+    if data[: len(_PYTREE_MAGIC)] != _PYTREE_MAGIC:
+        raise ValueError("pytree blob has wrong magic/version")
+    hlen = struct.unpack_from("<I", data, len(_PYTREE_MAGIC))[0]
+    hstart = len(_PYTREE_MAGIC) + 4
+    if hstart + hlen > len(data):
+        raise ValueError("pytree blob truncated (header extends past end)")
+    try:
+        header = json.loads(data[hstart: hstart + hlen].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"pytree blob header undecodable: {e}") from e
+    if not isinstance(header, dict) or header.get("version") != 1:
+        raise ValueError(
+            f"pytree blob header version {header.get('version')!r} "
+            "unsupported (this build reads version 1)"
+        )
+    payload = data[hstart + hlen:]
+    arrays = []
+    for i, leaf in enumerate(header.get("leaves", [])):
+        try:
+            dt = _dtype_of(str(leaf["dtype"]))
+            shape = tuple(int(s) for s in leaf["shape"])
+            off, nbytes = int(leaf["offset"]), int(leaf["nbytes"])
+            crc = int(leaf["crc32"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"pytree blob leaf {i} header invalid: {e}") from e
+        raw = payload[off: off + nbytes]
+        if len(raw) != nbytes:
+            raise ValueError(
+                f"pytree blob truncated (leaf {i} wants {nbytes} bytes, "
+                f"{len(raw)} present)"
+            )
+        if (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
+            raise ValueError(f"pytree blob corrupt (leaf {i} checksum mismatch)")
+        arr = np.frombuffer(raw, dtype=dt).reshape(shape)
+        arrays.append(arr.copy())  # writable, detached from the blob
+    try:
+        return _decode_node(header["tree"], arrays)
+    except (KeyError, IndexError, TypeError) as e:
+        raise ValueError(f"pytree blob structure invalid: {e}") from e
 
 
 def save_train_state(ckpt_dir: str, state, step: int | None = None) -> str:

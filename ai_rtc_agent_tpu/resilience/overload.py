@@ -759,6 +759,21 @@ class OverloadControlPlane:
         (and therefore its ladder) ever existed."""
         self._pending.pop(key, None)
 
+    def adopt_reservation(self, old_key: str, new_key: str) -> bool:
+        """Transfer an admission reservation to a new session key — the
+        migration handshake (server/agent.py): /migrate/import reserved
+        under its token BEFORE any state landed; the adopting re-offer
+        serves under a freshly minted stream id.  The original deadline
+        rides along (adoption must not extend a stale hold).  False when
+        the reservation already expired — the caller runs the normal
+        admission gate instead."""
+        self._expire_pending()
+        deadline = self._pending.pop(old_key, None)
+        if deadline is None:
+            return False
+        self._pending[new_key] = deadline
+        return True
+
     def capacity(self, free_slots: int | None = None) -> dict:
         """/capacity body: admission view of remaining headroom, with
         pending reservations counted as live so a burst of in-flight

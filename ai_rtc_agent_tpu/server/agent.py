@@ -401,7 +401,8 @@ def _slots_full_text(app) -> str:
     return "all batch-scheduler session slots in use"
 
 
-async def _claim_pipeline(app, session_key: str | None = None):
+async def _claim_pipeline(app, session_key: str | None = None,
+                          imported=None):
     """-> (pipeline, release_fn).  In --multipeer mode each connection
     claims a slot of the batched engine (503 via CapacityError when full);
     with the continuous batch scheduler active (the default single-device
@@ -410,9 +411,26 @@ async def _claim_pipeline(app, session_key: str | None = None):
     connection shares the single pipeline (reference semantics,
     agent.py:423).  Claim runs a prepare() (text-encode + UNet stock
     pass), so it is pushed off the event loop; the returned release_fn is
-    loop-safe (schedules its work on a thread)."""
+    loop-safe (schedules its work on a thread).
+
+    ``imported``: a restored ScheduledSession parked by /migrate/import —
+    adopted AS the claim (renamed to this connection's session key, no
+    fresh prepare: the migrated stream resumes exactly where the source
+    froze it)."""
     mp = app.get("multipeer_pipeline")
     sched = app.get("batch_scheduler")
+    if imported is not None:
+        imported.session_key = session_key
+        ov = app.get("overload")
+        if ov is not None and session_key is not None:
+            ov.register_queue(
+                f"batchwin:{session_key}", imported.window_queue
+            )
+
+        def release_imported():
+            spawn(asyncio.to_thread(imported.release))
+
+        return imported, release_imported
     if mp is None and sched is None:
         return app["pipeline"], lambda: None
     from .multipeer_serving import CapacityError
@@ -447,6 +465,226 @@ async def _claim_pipeline(app, session_key: str | None = None):
 
 
 # ---------------------------------------------------------------------------
+# live session migration (ISSUE 15, docs/fleet.md "Drain runbook"):
+# export/import of one session's stream state, plus the adoption handshake
+# a migrated client's re-offer completes
+# ---------------------------------------------------------------------------
+
+_IMPORTED_TTL_S = 30.0  # setup-sized, matches the admission reservation TTL
+
+# control-plane-only snapshots (serving tiers without a scheduler state
+# row to move — the target re-primes like a fresh offer); scheduler
+# snapshots carry stream/scheduler.SESSION_SNAPSHOT_SCHEMA instead
+_CONTROL_SNAPSHOT_SCHEMA = 1
+
+
+def _expire_imported(app, token: str | None = None):
+    """Drop stale parked imports (or one specific token whose timer
+    fired): release the restored scheduler slot and the admission
+    reservation the import took — a client that never re-offers must not
+    leak capacity."""
+    imp = app.setdefault("imported_sessions", {})
+    if token is not None:
+        keys = [token] if token in imp else []
+    else:
+        now = time.monotonic()
+        keys = [
+            k for k, e in imp.items() if now - e["ts"] >= _IMPORTED_TTL_S
+        ]
+    for k in keys:
+        entry = imp.pop(k, None)
+        if entry is None:
+            continue
+        sess = entry.get("session")
+        if sess is not None:
+            spawn(asyncio.to_thread(sess.release))
+        _release_admission(app, k)
+        logger.warning("imported session %s expired unadopted", k)
+
+
+def _admit_or_adopt(app, request, stream_id: str):
+    """Admission for the session-creating endpoints, migration-aware: a
+    re-offer carrying ``X-Migrated-Session`` claims the parked import —
+    its admission reservation transfers to the minted stream id (the
+    import already paid the counted gate) and, when the import restored
+    scheduler state, that session is adopted instead of a fresh claim.
+    -> (imported session | None, rejection response | None)."""
+    token = request.headers.get("X-Migrated-Session")
+    entry = None
+    if token:
+        _expire_imported(app)
+        entry = app.setdefault("imported_sessions", {}).pop(token, None)
+    ov = app.get("overload")
+    adopted = False
+    if entry is not None:
+        adopted = (
+            ov.adopt_reservation(token, stream_id)
+            if ov is not None else True
+        )
+    if not adopted:
+        rejected = _admission_gate(app, stream_id)
+        if rejected is not None:
+            if entry is not None and entry.get("session") is not None:
+                # the import's reservation lapsed AND the box refuses:
+                # release the restored slot — a refused adoption must
+                # not leak capacity
+                sess = entry["session"]
+                spawn(asyncio.to_thread(sess.release))
+            return None, rejected
+    return (entry or {}).get("session"), None
+
+
+async def migrate_export(request):
+    """``GET /migrate/export?session=<stream-id>``: serialize one live
+    session for migration.  Batch-scheduler sessions export their full
+    stream state (stream/scheduler.snapshot_session — versioned schema,
+    bit-exact state row, control plane, similarity-filter state); other
+    serving tiers export a control-plane-only snapshot (the target
+    re-primes like a fresh offer).  Exporting leaves the session serving
+    untouched — the source keeps stepping until the client moves."""
+    app = request.app
+    if not env.migrate_enabled():
+        return _debug_error(
+            404, "session migration disabled (MIGRATE_ENABLE=0)"
+        )
+    sid = request.query.get("session")
+    if not sid:
+        return _debug_error(400, "session= query required")
+    sched = app.get("batch_scheduler")
+    if (
+        sched is not None
+        and hasattr(sched, "snapshot_session")
+        and getattr(sched, "session", lambda _k: None)(sid) is not None
+    ):
+        try:
+            # the row read takes the scheduler's step lock — never on
+            # the loop
+            snap = await asyncio.to_thread(sched.snapshot_session, sid)
+        except KeyError:
+            # released between the existence check and the read: a gone
+            # session is a terminal 404, not a 500 the router's policy
+            # would retry three times for nothing
+            return _debug_error(404, f"unknown session {sid!r}")
+        snap.setdefault("kind", "scheduler")
+        snap["session"] = sid
+        return web.json_response(snap)
+    if sid not in app.get("supervisors", {}):
+        return _debug_error(404, f"unknown session {sid!r}")
+    return web.json_response({
+        "schema": _CONTROL_SNAPSHOT_SCHEMA,
+        "kind": "control-plane",
+        "session": sid,
+    })
+
+
+async def migrate_import(request):
+    """``POST /migrate/import {"token", "snapshot"}``: land a migrated
+    session.  The admission gate takes a COUNTED reservation under the
+    token BEFORE any state lands (the same ledger a fresh offer pays, so
+    concurrent imports and offers see each other at the cap); a
+    scheduler snapshot then restores into a claimed slot, parked until
+    the client's re-offer arrives carrying ``X-Migrated-Session``
+    (unadopted imports expire with the reservation and release
+    everything).  A versioned-schema/fingerprint mismatch is 409 —
+    terminal for the router's retry policy (the retry-4xx rule); slot or
+    admission exhaustion is 503 + Retry-After."""
+    app = request.app
+    if not env.migrate_enabled():
+        return _debug_error(
+            404, "session migration disabled (MIGRATE_ENABLE=0)"
+        )
+    try:
+        body = await request.json()
+    except (ValueError, LookupError):
+        return _debug_error(400, "invalid JSON body")
+    if not isinstance(body, dict):
+        return _debug_error(400, "body must be an object")
+    token = str(body.get("token") or "")
+    snap = body.get("snapshot")
+    if not token or not isinstance(snap, dict):
+        return _debug_error(400, "token and snapshot object required")
+    _expire_imported(app)
+    parked = app.setdefault("imported_sessions", {}).get(token)
+    if parked is not None:
+        # idempotent retry (the router re-POSTs when a response is lost
+        # mid-restore): the first import already landed and holds its
+        # reservation — restoring AGAIN would orphan the parked session's
+        # slot behind the overwritten entry
+        return web.json_response({
+            "ok": True, "token": token,
+            "restored": parked.get("session") is not None,
+        })
+    importing: set = app.setdefault("importing_tokens", set())
+    if token in importing:
+        # a retry racing a FIRST import still inside its restore (the
+        # check-then-park spans the to_thread await): refuse transiently
+        # — the router backs off and the next attempt hits the parked
+        # idempotent path above instead of restoring a second slot
+        return _overloaded_response(app, "import already in progress")
+    rejected = _admission_gate(app, token)  # the reservation comes FIRST
+    if rejected is not None:
+        return rejected
+    kind = snap.get("kind")
+    sess = None
+    importing.add(token)
+    try:
+        if kind == "scheduler":
+            sched = app.get("batch_scheduler")
+            if sched is None or not hasattr(sched, "restore_session"):
+                _release_admission(app, token)
+                return _debug_error(
+                    409, "no batch scheduler on this agent to restore into"
+                )
+            from ..stream.scheduler import SnapshotMismatch
+            from .multipeer_serving import CapacityError
+
+            try:
+                sess = await asyncio.to_thread(
+                    sched.restore_session, snap, token
+                )
+            except SnapshotMismatch as e:
+                _release_admission(app, token)
+                return _debug_error(409, f"snapshot refused: {e}")
+            except CapacityError:
+                _release_admission(app, token)
+                return _overloaded_response(app, _slots_full_text(app))
+            except BaseException:
+                # anything unexpected (XLA OOM, runtime error inside the
+                # install): the 500 the router will retry must not strand
+                # the counted reservation for its full TTL
+                _release_admission(app, token)
+                raise
+        elif kind == "control-plane":
+            if snap.get("schema") != _CONTROL_SNAPSHOT_SCHEMA:
+                _release_admission(app, token)
+                return _debug_error(
+                    409,
+                    f"control-plane snapshot schema {snap.get('schema')!r} "
+                    f"unsupported (this build speaks "
+                    f"{_CONTROL_SNAPSHOT_SCHEMA})",
+                )
+        else:
+            _release_admission(app, token)
+            return _debug_error(400, f"unknown snapshot kind {kind!r}")
+        # parked BEFORE the in-flight mark clears: a racing retry sees
+        # either "importing" (503, backs off) or the parked entry
+        app.setdefault("imported_sessions", {})[token] = {
+            "session": sess, "ts": time.monotonic(),
+        }
+    finally:
+        importing.discard(token)
+    # the expiry timer mirrors the reservation TTL; an adopted (popped)
+    # token makes the callback a no-op
+    asyncio.get_running_loop().call_later(
+        _IMPORTED_TTL_S + 1.0, _expire_imported, app, token
+    )
+    app["stats"].count("migrate_imports")
+    return web.json_response(
+        {"ok": True, "token": token, "restored": sess is not None}
+    )
+
+
+# ---------------------------------------------------------------------------
 # endpoints
 # ---------------------------------------------------------------------------
 
@@ -464,10 +702,12 @@ async def offer(request):
     except (ValueError, LookupError) as e:  # LookupError covers KeyError +
         return web.Response(status=400, text=f"invalid offer request: {e}")  # unknown charset=
     stream_id = str(uuid.uuid4())
-    rejected = _admission_gate(app, stream_id)
+    imported, rejected = _admit_or_adopt(app, request, stream_id)
     if rejected is not None:
         return rejected
-    pipeline, release_pipeline = await _claim_pipeline(app, stream_id)
+    pipeline, release_pipeline = await _claim_pipeline(
+        app, stream_id, imported=imported
+    )
     if pipeline is None:
         _release_admission(app, stream_id)
         return _overloaded_response(app, _slots_full_text(app))
@@ -731,10 +971,12 @@ async def whip(request):
     provider = app["provider"]
     stats: FrameStats = app["stats"]
     session_id = str(uuid.uuid4())
-    rejected = _admission_gate(app, session_id)
+    imported, rejected = _admit_or_adopt(app, request, session_id)
     if rejected is not None:
         return rejected
-    pipeline, release_pipeline = await _claim_pipeline(app, session_id)
+    pipeline, release_pipeline = await _claim_pipeline(
+        app, session_id, imported=imported
+    )
     if pipeline is None:
         _release_admission(app, session_id)
         return _overloaded_response(app, _slots_full_text(app))
@@ -1611,6 +1853,15 @@ async def on_shutdown(app):
         mp.close()
     sched = app.get("batch_scheduler")
     if sched is not None:
+        for entry in app.get("imported_sessions", {}).values():
+            # unadopted migrated-in sessions die with the scheduler
+            sess = entry.get("session")
+            if sess is not None:
+                try:
+                    sess.release()
+                except Exception:
+                    logger.exception("releasing imported session failed")
+        app.get("imported_sessions", {}).clear()
         sched.close()
 
 
@@ -1650,6 +1901,10 @@ def build_app(
     # makes the agent ignore the headers entirely
     app["journey_enabled"] = env.journey_enabled()
     app["journey_map"] = {}
+    # migrated-in sessions parked by /migrate/import until the client's
+    # re-offer adopts them (X-Migrated-Session); TTL'd with their
+    # admission reservations
+    app["imported_sessions"] = {}
 
     app.on_startup.append(on_startup)
     app.on_shutdown.append(on_shutdown)
@@ -1666,6 +1921,8 @@ def build_app(
     app.router.add_get("/health", health_detail)
     app.router.add_get("/capacity", capacity)
     app.router.add_post("/drain", drain)
+    app.router.add_get("/migrate/export", migrate_export)
+    app.router.add_post("/migrate/import", migrate_import)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/debug/trace", debug_trace)

@@ -70,6 +70,19 @@ class StreamRecoveredEvent(WebhookEvent):
     reason: str = ""
 
 
+class StreamMigratedEvent(WebhookEvent):
+    """The fleet moved this session to another agent (drain-as-move or
+    crash restore, docs/fleet.md): its stream state is already imported
+    on ``target_agent`` — the client re-offers through the router echoing
+    ``journey_id`` and resumes mid-stream (no keyframe re-prime).
+    ``reason`` says why the move happened (drain | agent_dead)."""
+
+    event: str = "StreamMigrated"
+    source_agent: str = ""
+    target_agent: str = ""
+    reason: str = ""
+
+
 class StreamEventHandler:
     def __init__(self, session_factory=None, webhook_url=None, token=None):
         # explicit ctor values override the env config: the fleet router
@@ -96,6 +109,7 @@ class StreamEventHandler:
             "StreamEnded": StreamEndedEvent,
             "StreamDegraded": StreamDegradedEvent,
             "StreamRecovered": StreamRecoveredEvent,
+            "StreamMigrated": StreamMigratedEvent,
         }.get(event_name)
         if cls is None:
             raise ValueError(f"unknown event: {event_name}")
@@ -175,6 +189,24 @@ class StreamEventHandler:
                             journey: dict | None = None):
         return self.send_request("StreamEnded", stream_id, room_id,
                                  **self._journey_extra(journey))
+
+    def handle_stream_migrated(
+        self,
+        stream_id: str,
+        room_id: str,
+        source_agent: str,
+        target_agent: str,
+        reason: str = "",
+        journey: dict | None = None,
+    ):
+        """The fleet router's move notification (drain-as-move / crash
+        restore): the client re-offers echoing the journey id and lands
+        on ``target_agent``, where its stream state already waits."""
+        return self.send_request(
+            "StreamMigrated", stream_id, room_id,
+            source_agent=source_agent, target_agent=target_agent,
+            reason=reason, **self._journey_extra(journey),
+        )
 
     def handle_session_state(
         self,

@@ -609,6 +609,46 @@ class SimilarityFilter:
         self._skip_count = 0
         return False
 
+    def export_state(self) -> dict:
+        """JSON-able snapshot of the filter's decision state (live
+        session migration, stream/scheduler.py): the subsampled previous
+        frame, the skip streak, and the RNG position — a restored filter
+        makes exactly the stochastic skip choices this one would have."""
+        import base64
+
+        prev = self._prev_small
+        return {
+            "skip_count": int(self._skip_count),
+            "rng_state": self._rng.bit_generator.state,
+            "prev_small": None if prev is None else {
+                "shape": list(prev.shape),
+                "b64": base64.b64encode(
+                    np.ascontiguousarray(prev, dtype=np.float32).tobytes()
+                ).decode("ascii"),
+            },
+        }
+
+    def restore_state(self, state: dict):
+        """Inverse of :meth:`export_state`; bad payloads raise ValueError
+        (the migration surface refuses rather than resuming with a
+        half-restored filter)."""
+        import base64
+        import binascii
+
+        try:
+            self._skip_count = int(state["skip_count"])
+            self._rng.bit_generator.state = state["rng_state"]
+            prev = state.get("prev_small")
+            if prev is None:
+                self._prev_small = None
+            else:
+                raw = base64.b64decode(prev["b64"])
+                self._prev_small = np.frombuffer(
+                    raw, dtype=np.float32
+                ).reshape([int(s) for s in prev["shape"]]).copy()
+        except (KeyError, TypeError, ValueError, binascii.Error) as e:
+            raise ValueError(f"similarity-filter state unusable: {e}") from e
+
 
 def _annotate(img01_nhwc, cfg: StreamConfig, params=None):
     """In-graph conditioning annotator.

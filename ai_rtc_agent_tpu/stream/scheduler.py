@@ -112,7 +112,25 @@ from .engine import (
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["BatchScheduler", "ScheduledSession", "CapacityError"]
+__all__ = [
+    "BatchScheduler", "ScheduledSession", "CapacityError",
+    "SnapshotMismatch", "SESSION_SNAPSHOT_SCHEMA",
+]
+
+# session-snapshot schema version (live migration, ISSUE 15): the payload
+# layout of snapshot_session()/restore_session().  Bump on ANY field or
+# semantic change — restore REFUSES a mismatched version instead of
+# guessing, because a misread row becomes silently wrong pixels on
+# another agent (the blob itself carries a second, byte-layout version
+# inside parallel/checkpoint.serialize_pytree).
+SESSION_SNAPSHOT_SCHEMA = 1
+
+
+class SnapshotMismatch(ValueError):
+    """A session snapshot does not fit this scheduler — wrong schema
+    version, wrong model/geometry/variant fingerprint, or a state row
+    whose structure/shape/dtype differs from the compiled bucket steps'
+    operand.  Restore refuses; the source keeps serving."""
 
 
 class _DispatchedBatch:
@@ -727,28 +745,7 @@ class BatchScheduler:
         build (text-encode + prepare) runs OUTSIDE the step lock so live
         sessions keep batching while someone joins."""
         with self._lock:
-            try:
-                if self.dp > 1:
-                    # shard-balanced placement: claim a free slot on the
-                    # LEAST-LOADED shard (ties -> lowest slot), so partial
-                    # occupancy spreads rows across chips — each session's
-                    # bucket row then computes on its OWN shard (no
-                    # per-dispatch cross-device hops) and the idle-shard
-                    # parallelism the dp-multiple buckets promise is real
-                    loads = [0] * self.dp
-                    for s, live in enumerate(self.active):
-                        if live:
-                            loads[self._slot_shard(s)] += 1
-                    slot = min(
-                        (s for s, live in enumerate(self.active) if not live),
-                        key=lambda s: (loads[self._slot_shard(s)], s),
-                    )
-                else:
-                    slot = self.active.index(False)
-            except ValueError:
-                raise CapacityError(
-                    f"all {self.max_sessions} scheduler session slots in use"
-                ) from None
+            slot = self._pick_slot_locked()
             self.active[slot] = True
         prompt = self.prompt if prompt is None else prompt
         seed = slot if seed is None else seed
@@ -778,6 +775,31 @@ class BatchScheduler:
         logger.info("batchsched session claimed -> slot %d", slot)
         return sess
 
+    def _pick_slot_locked(self) -> int:
+        """The next slot a new session lands on (caller holds the lock;
+        raises CapacityError when full)."""
+        try:
+            if self.dp > 1:
+                # shard-balanced placement: claim a free slot on the
+                # LEAST-LOADED shard (ties -> lowest slot), so partial
+                # occupancy spreads rows across chips — each session's
+                # bucket row then computes on its OWN shard (no
+                # per-dispatch cross-device hops) and the idle-shard
+                # parallelism the dp-multiple buckets promise is real
+                loads = [0] * self.dp
+                for s, live in enumerate(self.active):
+                    if live:
+                        loads[self._slot_shard(s)] += 1
+                return min(
+                    (s for s, live in enumerate(self.active) if not live),
+                    key=lambda s: (loads[self._slot_shard(s)], s),
+                )
+            return self.active.index(False)
+        except ValueError:
+            raise CapacityError(
+                f"all {self.max_sessions} scheduler session slots in use"
+            ) from None
+
     def release(self, slot: int):
         if not (0 <= slot < self.max_sessions):
             raise ValueError(
@@ -795,6 +817,221 @@ class BatchScheduler:
                 break
             got[0].future.cancel()
         logger.info("batchsched session released <- slot %d", slot)
+
+    # -- live session migration (snapshot/restore — ISSUE 15) ------------------
+
+    def session(self, session_key: str) -> "ScheduledSession | None":
+        """The live session claimed under ``session_key`` (lock-free
+        scan, the /health read discipline), or None."""
+        for sess in safe_list(self._sessions.values()):
+            if sess.session_key == session_key:
+                return sess
+        return None
+
+    def snapshot_fingerprint(self) -> dict:
+        """What must MATCH for a snapshot to restore here: the model, the
+        frame geometry, the batching shape and the params variant — the
+        things the compiled bucket steps bake in.  A mismatch is a
+        refused restore, never a reshape."""
+        qextra = params_variant_extra(self.params)
+        return {
+            "model_id": self.model_id,
+            "height": self.height,
+            "width": self.width,
+            "fbs": self.fbs,
+            "n_stages": int(self.cfg.n_stages),
+            "dtype": np.dtype(self.cfg.jdtype).name,
+            "unet_cache": int(self._cache_interval),
+            "similar_filter": bool(self.cfg.similar_image_filter),
+            "quant": str(qextra.get("quant", "")),
+        }
+
+    def snapshot_session(self, session_key: str) -> dict:
+        """Serialize one live session for migration: its state row of the
+        stacked pytree (bit-exact, parallel/checkpoint.serialize_pytree)
+        plus the full control plane restart() already reconstructs —
+        prompt, guidance/delta, t-index list, similarity-filter state,
+        DeepCache tick alignment — under the versioned schema
+        restore_session() enforces.  The row is read under the step lock
+        (never mid-dispatch); in-flight window frames stay behind and are
+        delivered by THIS agent, which keeps serving until the client
+        actually moves."""
+        import base64
+
+        from ..parallel.checkpoint import serialize_pytree
+
+        sess = self.session(session_key)
+        if sess is None:
+            raise KeyError(f"no live scheduler session {session_key!r}")
+        with self._lock:
+            if self._sessions.get(sess.slot) is not sess:
+                # the session released (and its slot may already be
+                # REUSED) between the lock-free lookup and this lock:
+                # exporting would pair THIS session's control plane with
+                # another session's state row — a cross-session leak
+                raise KeyError(
+                    f"session {session_key!r} released mid-export"
+                )
+            # DEVICE-side row slices under the lock (cheap ops — each
+            # x[slot] is a fresh buffer, so the later donation of the
+            # stacked states cannot invalidate them); the blocking D2H
+            # pull happens OUTSIDE the lock so one export never stalls
+            # the other live sessions' dispatches
+            row_dev = jax.tree.map(
+                lambda x, slot=sess.slot: x[slot], self.states
+            )
+            cache_tick = self._tick
+            cache_uncaptured = sess.slot in self._uncaptured
+        row = jax.tree.map(np.asarray, row_dev)
+        snap = {
+            "schema": SESSION_SNAPSHOT_SCHEMA,
+            "kind": "scheduler",
+            "fingerprint": self.snapshot_fingerprint(),
+            "session": session_key,
+            "prompt": sess.prompt,
+            "guidance_scale": float(sess.guidance_scale),
+            "delta": float(sess.delta),
+            "t_index_list": [int(t) for t in sess.t_index_list],
+            "seed": int(sess._seed),
+            "had_output": bool(sess._had_output),
+            "frames_submitted": int(sess.frames_submitted),
+            "frames_skipped_similar": int(sess.frames_skipped_similar),
+            # DeepCache alignment: the restore marks the slot uncaptured
+            # (forced capture on its first ride — the install discipline),
+            # so these ride along for observability, not for replay
+            "cache_tick": int(cache_tick),
+            "cache_uncaptured": bool(cache_uncaptured),
+            "state_b64": base64.b64encode(serialize_pytree(row)).decode(
+                "ascii"
+            ),
+        }
+        if sess._sim is not None:
+            snap["similarity"] = sess._sim.export_state()
+        return snap
+
+    def _check_row(self, row):
+        """Refuse a restored row whose structure/shape/dtype differs from
+        the stacked template — the compiled bucket steps would
+        misinterpret it (or XLA would crash mid-serve, which is worse)."""
+        flat_row, td_row = jax.tree.flatten(row)
+        flat_tmpl, td_tmpl = jax.tree.flatten(self.states)
+        if td_row != td_tmpl:
+            raise SnapshotMismatch(
+                "state-row structure differs from this scheduler's "
+                f"stacked pytree ({td_row} vs {td_tmpl})"
+            )
+        for got, want in zip(flat_row, flat_tmpl):
+            wshape, wdtype = tuple(want.shape[1:]), np.dtype(want.dtype)
+            if tuple(np.shape(got)) != wshape or np.dtype(
+                np.asarray(got).dtype
+            ) != wdtype:
+                raise SnapshotMismatch(
+                    f"state-row leaf {np.shape(got)}/{np.asarray(got).dtype}"
+                    f" does not match the compiled {wshape}/{wdtype}"
+                )
+
+    def restore_session(
+        self, snapshot: dict, session_key: str | None = None
+    ) -> ScheduledSession:
+        """Install a migrated session: claim a slot and set its state row
+        to the snapshot's BYTES (no prepare, no re-prime — the stream
+        resumes exactly where the source froze it).  REFUSES mismatched
+        schema/fingerprint/row shapes (SnapshotMismatch) and full slot
+        pools (CapacityError) BEFORE touching any state, so a refused
+        restore leaves this scheduler — and the source, which still holds
+        the live session — completely untouched."""
+        import base64
+        import binascii
+
+        from ..parallel.checkpoint import deserialize_pytree
+
+        if not isinstance(snapshot, dict):
+            raise SnapshotMismatch("session snapshot must be an object")
+        schema = snapshot.get("schema")
+        if schema != SESSION_SNAPSHOT_SCHEMA:
+            raise SnapshotMismatch(
+                f"session-snapshot schema {schema!r} unsupported (this "
+                f"build speaks {SESSION_SNAPSHOT_SCHEMA})"
+            )
+        fp, want = snapshot.get("fingerprint"), self.snapshot_fingerprint()
+        if fp != want:
+            diffs = sorted(
+                k for k in set(want) | set(fp or {})
+                if (fp or {}).get(k) != want.get(k)
+            )
+            raise SnapshotMismatch(
+                f"snapshot fingerprint mismatch on {diffs} "
+                f"(snapshot {fp!r}, this scheduler {want!r})"
+            )
+        from .engine import _coeff_state
+
+        try:
+            row = deserialize_pytree(
+                base64.b64decode(snapshot["state_b64"], validate=True)
+            )
+            prompt = str(snapshot["prompt"])
+            guidance = float(snapshot["guidance_scale"])
+            delta = float(snapshot["delta"])
+            t_index_list = [int(t) for t in snapshot["t_index_list"]]
+            seed = int(snapshot.get("seed", 0))
+            if len(t_index_list) != self.cfg.n_stages:
+                raise ValueError(
+                    f"t_index_list length {len(t_index_list)} != compiled "
+                    f"n_stages {self.cfg.n_stages}"
+                )
+            # value validation NOW (the update_t_index_list contract): a
+            # bad list must refuse the restore, not detonate the first
+            # supervisor restart()'s _build_state
+            _coeff_state(self.cfg, self._template.schedule,
+                         tuple(t_index_list))
+        except (KeyError, IndexError, TypeError, ValueError,
+                binascii.Error) as e:
+            raise SnapshotMismatch(f"session snapshot unusable: {e}") from e
+        self._check_row(row)
+        with self._lock:
+            slot = self._pick_slot_locked()
+            self.active[slot] = True
+        sess = ScheduledSession(
+            self, slot, session_key or snapshot.get("session")
+            or f"slot-{slot}", prompt, seed,
+        )
+        sess.guidance_scale = guidance
+        sess.delta = delta
+        sess.t_index_list = t_index_list
+        sess._had_output = bool(snapshot.get("had_output", False))
+        sess.frames_submitted = int(snapshot.get("frames_submitted", 0))
+        sess.frames_skipped_similar = int(
+            snapshot.get("frames_skipped_similar", 0)
+        )
+        sim_state = snapshot.get("similarity")
+        if sess._sim is not None and sim_state is not None:
+            try:
+                sess._sim.restore_state(sim_state)
+            except ValueError as e:
+                with self._lock:
+                    self.active[slot] = False
+                raise SnapshotMismatch(str(e)) from e
+        try:
+            with self._has_work:
+                # _install_locked keeps the whole install discipline: the
+                # sharded placement rides .at[slot].set on the stacked
+                # states, and a DeepCache slot is marked uncaptured so its
+                # first ride FORCES a capture batch (the migrated deep-
+                # feature row is stale by definition — the snapshot's
+                # cadence phase cannot graft onto this scheduler's global
+                # tick without perturbing its existing riders)
+                self._install_locked(slot, row)
+                self._sessions[slot] = sess
+        except Exception:
+            with self._lock:
+                self.active[slot] = False
+                self._sessions.pop(slot, None)
+            raise
+        logger.info(
+            "batchsched session restored from snapshot -> slot %d (%s)",
+            slot, sess.session_key,
+        )
+        return sess
 
     # -- heavy/cheap state plumbing -------------------------------------------
 

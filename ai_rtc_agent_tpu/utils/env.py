@@ -156,6 +156,17 @@ def journey_enabled() -> bool:
     return get_bool("JOURNEY_ENABLE", True)
 
 
+def migrate_enabled() -> bool:
+    """Live session migration (docs/fleet.md "Drain runbook"):
+    snapshot/restore of stream state between agents — the agent's
+    /migrate/export//migrate/import endpoints and the router's
+    ``POST /fleet/drain?mode=migrate`` + crash-restore paths.
+    ``MIGRATE_ENABLE=0`` kills the whole surface: the agent endpoints
+    404, the router refuses mode=migrate (409) and the crash path falls
+    back to the plain AGENT_DEAD re-point."""
+    return get_bool("MIGRATE_ENABLE", True)
+
+
 def batchsched_enabled() -> bool:
     """Continuous cross-session batch scheduler (stream/scheduler.py) —
     the default single-device serving path.  BATCHSCHED=0 restores the

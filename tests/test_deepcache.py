@@ -205,6 +205,11 @@ def test_cadence_with_frame_batching():
     assert eng._tick == 4
 
 
+@pytest.mark.slow  # AOT pair build x fresh-adoption composition (~8s;
+# ISSUE 15 budget pairing): test_engine_cadence_and_flops keeps the
+# cadence pin in tier-1, the scheduler's pair-key discipline rides
+# test_refuses_incompatible_configs, and test_multipeer_aot_cache_
+# roundtrip keeps an AOT build+adopt roundtrip in tier-1
 def test_aot_pair_build_and_fresh_adoption(tmp_path):
     """The TRT-engine-cache analog covers DeepCache: build_engines-style
     pair build (capture + cached executables, distinct keys), then a fresh

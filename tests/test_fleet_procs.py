@@ -403,3 +403,163 @@ def test_three_process_fleet(monkeypatch):
         asyncio.run(go())
     finally:
         _kill(procs)
+
+
+def test_three_process_migrate_drain(monkeypatch):
+    """ISSUE 15 acceptance: ``POST /fleet/drain?mode=migrate`` drains a
+    REAL agent process to zero by MOVING its session — export off the
+    source, counted-reservation import on a healthy target, a
+    StreamMigrated webhook re-points the client, whose echoed re-offer
+    is pinned to the target and adopted as journey leg 2 — with every
+    pumped frame delivered (before on the source, after on the target)
+    and the journey ring showing the ``migrated`` leg.  (The SIGKILL
+    fallback path is the previous test, unchanged.)"""
+    monkeypatch.setenv("FLEET_POLL_S", "0.15")
+    monkeypatch.setenv("FLEET_POLL_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("FLEET_DEAD_AFTER", "2")
+    procs, ports = _spawn_agents(3)
+    names = [f"agent{i}" for i in range(3)]
+    by_name = dict(zip(names, zip(procs, ports)))
+    posted = []
+
+    class FakeResp:
+        status = 200
+
+    class FakeSession:
+        async def post(self, url, headers=None, json=None):
+            posted.append(json)
+            return FakeResp()
+
+    async def go():
+        import aiohttp
+
+        events = StreamEventHandler(
+            session_factory=FakeSession,
+            webhook_url="http://client-notify.example/hook", token="t",
+        )
+        reg = FleetRegistry(dead_after=2)
+        app = build_router_app(registry=reg, events_handler=events,
+                               poll=True)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=15)
+        )
+
+        async def agent_get(port, path):
+            async with http.get(f"http://127.0.0.1:{port}{path}") as r:
+                return await r.json()
+
+        async def agent_post(port, path, body):
+            async with http.post(
+                f"http://127.0.0.1:{port}{path}", json=body
+            ) as r:
+                return await r.json()
+
+        try:
+            for name, (_p, port) in by_name.items():
+                r = await client.post("/fleet/register", json={
+                    "worker_id": name, "public_ip": "127.0.0.1",
+                    "public_port": str(port), "status": "ready",
+                    "capacity": 2,
+                })
+                assert r.status == 200
+
+            async def first_poll():
+                return all(
+                    rec.last_ok is not None for rec in reg.agents.values()
+                )
+
+            await _wait_for(first_poll, 10, "first poll round")
+
+            # one session per agent; every pumped frame delivered
+            sids, jids = [], {}
+            for _ in range(3):
+                r = await client.post("/offer", json=_OFFER)
+                assert r.status == 200, await r.text()
+                sid = r.headers["X-Stream-Id"]
+                sids.append(sid)
+                jids[sid] = r.headers["X-Journey-Id"]
+            for name in names:
+                pumped = await agent_post(
+                    by_name[name][1], "/_test/pump", {"frames": 10}
+                )
+                assert list(pumped["sessions"].values()) == [10], pumped
+
+            # move-not-kill: drain the owner of sids[0] with mode=migrate
+            victim = app["session_table"].owner(sids[0])
+            vic_port = by_name[victim][1]
+            r = await client.post(
+                f"/fleet/drain?agent={victim}&mode=migrate"
+            )
+            body = await r.json()
+            assert body["draining"] and body["mode"] == "migrate"
+            assert body["migrating"] == 1, body
+
+            async def migrated():
+                return [e for e in posted
+                        if e.get("event") == "StreamMigrated"]
+
+            events_seen = await _wait_for(
+                migrated, 15, "StreamMigrated webhook"
+            )
+            ev = events_seen[0]
+            assert ev["stream_id"] == sids[0]
+            assert ev["source_agent"] == victim
+            assert ev["journey_id"] == jids[sids[0]]
+            assert ev["reason"] == "drain"
+            target = ev["target_agent"]
+            assert target in names and target != victim
+
+            # the re-pointed client re-offers echoing the journey id:
+            # pinned to the TARGET (which holds the import), leg 2
+            r = await client.post(
+                "/offer", json=_OFFER,
+                headers={"X-Journey-Id": ev["journey_id"]},
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Journey-Id"] == ev["journey_id"]
+            assert r.headers["X-Journey-Leg"] == "2"
+            new_sid = r.headers["X-Stream-Id"]
+            assert app["session_table"].owner(new_sid) == target
+
+            # ...and streams: every post-migration frame delivered on
+            # the target (its own session + the adopted one)
+            pumped = await agent_post(
+                by_name[target][1], "/_test/pump", {"frames": 8}
+            )
+            assert sum(pumped["sessions"].values()) == 8 * len(
+                pumped["sessions"]
+            )
+            assert len(pumped["sessions"]) == 2
+
+            # the client hangs up its OLD connection -> source drains to
+            # zero and flips recyclable
+            await agent_post(vic_port, "/_test/close", {})
+
+            async def drained():
+                h = await (await client.get("/fleet/health")).json()
+                a = h["agents"][victim]
+                return a["state"] == "DRAINING" and a["recyclable"]
+
+            await _wait_for(drained, 15, "drain to zero")
+
+            # the journey ring tells the move story end to end
+            record = app["journeys"].get(ev["journey_id"])
+            kinds = [e["kind"] for e in record["events"]]
+            assert "migrated" in kinds, kinds
+            assert [leg["agent"] for leg in record["legs"]] == [
+                victim, target,
+            ]
+            m = await (await client.get("/metrics")).json()
+            assert m["migrations_total"] == 1
+            assert m.get("migrations_failed_total", 0) == 0
+            assert m["fleet_drains_total"] == 1
+        finally:
+            await http.close()
+            await client.close()
+
+    try:
+        asyncio.run(go())
+    finally:
+        _kill(procs)

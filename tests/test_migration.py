@@ -1,0 +1,1026 @@
+"""Live session migration (ISSUE 15) — snapshot/restore stream state.
+
+Three layers, hermetic:
+
+1. **Bit-identity between two loopback agents** (real tiny schedulers):
+   a session migrated MID-STREAM resumes with frame continuity (no gap,
+   no keyframe re-prime — its first post-migration frame equals an
+   unmigrated control's) and every post-migration step is bit-identical
+   to the control; the abort-safety regressions ride the same builds —
+   a schema/fingerprint/corrupt-blob restore REFUSES and the source
+   session keeps serving bit-identically.
+2. **Checkpoint blob round-trip property** (parallel/checkpoint.py):
+   dtype/shape/bit-exactness across every leaf kind the session pytree
+   actually carries (f32, bf16, uint8 frames, uint32 PRNG key arrays),
+   plus corrupt/truncated-blob refusal.
+3. **HTTP orchestration** (real agent apps + real router, fake
+   schedulers): POST /fleet/drain?mode=migrate runs export -> counted-
+   reservation import -> StreamMigrated webhook -> pinned re-offer
+   adoption (leg+1, ``migrated`` journey ring kind); a 4xx import is
+   terminal after exactly ONE attempt (the retry-4xx rule) and leaves
+   the source serving; MIGRATE_TIMEOUT_S falls back to kill-drain.
+"""
+
+import asyncio
+import base64
+import time
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.models import registry
+from ai_rtc_agent_tpu.parallel.checkpoint import (
+    deserialize_pytree,
+    serialize_pytree,
+)
+from ai_rtc_agent_tpu.stream.scheduler import (
+    SESSION_SNAPSHOT_SCHEMA,
+    BatchScheduler,
+    CapacityError,
+    SnapshotMismatch,
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return registry.load_model_bundle("tiny-test")
+
+
+@pytest.fixture(scope="module")
+def cfg32():
+    # TWO denoising stages: the latent ring then carries real cross-frame
+    # state, so "the migrated state mattered" is assertable (a 1-stage
+    # turbo config is a pure function of the input frame)
+    return registry.default_stream_config(
+        "tiny-test", t_index_list=(0, 1), num_inference_steps=2,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        height=32, width=32,
+    )
+
+
+def _mk_sched(bundle, cfg, **kw):
+    kw.setdefault("max_sessions", 2)
+    kw.setdefault("window_ms", 10_000.0)
+    kw.setdefault("prewarm", False)
+    return BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt, **kw
+    )
+
+
+def _tick(sess, frame):
+    return np.asarray(sess.fetch(sess.submit(frame)))
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identity between two loopback agents (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_migrate_mid_stream_bit_identical_and_abort_safe(bundle, cfg32):
+    """Agent A serves a session for 6 frames; its snapshot restores on
+    agent B; frames 6..11 on B are BIT-IDENTICAL to an unmigrated
+    control — and the first post-migration frame proves continuity (no
+    re-prime: a fresh session's output differs).  The source session on
+    A keeps serving bit-identically after the export AND after refused
+    restores (schema / fingerprint / corrupt blob / full pool)."""
+    A = _mk_sched(bundle, cfg32)
+    B = _mk_sched(bundle, cfg32)
+    C = _mk_sched(bundle, cfg32)  # the unmigrated control plane
+    rng = np.random.default_rng(11)
+    frames = [
+        rng.integers(0, 256, (32, 32, 3), np.uint8) for _ in range(12)
+    ]
+    try:
+        sa = A.claim("sa", prompt="migration prompt", seed=5)
+        sc = C.claim("sc", prompt="migration prompt", seed=5)
+        # live control-plane updates must ride the snapshot too
+        sa.update_guidance(guidance_scale=1.4, delta=0.8)
+        sc.update_guidance(guidance_scale=1.4, delta=0.8)
+        for f in frames[:6]:
+            assert np.array_equal(_tick(sa, f), _tick(sc, f))
+
+        snap = A.snapshot_session("sa")
+        assert snap["schema"] == SESSION_SNAPSHOT_SCHEMA
+        assert snap["prompt"] == "migration prompt"
+        assert snap["guidance_scale"] == pytest.approx(1.4)
+
+        # -- abort-safety: every refused restore leaves B untouched ----
+        bad = dict(snap)
+        bad["schema"] = SESSION_SNAPSHOT_SCHEMA + 1
+        with pytest.raises(SnapshotMismatch, match="schema"):
+            B.restore_session(bad, "x")
+        bad = dict(snap)
+        bad["fingerprint"] = dict(snap["fingerprint"], height=64)
+        with pytest.raises(SnapshotMismatch, match="fingerprint"):
+            B.restore_session(bad, "x")
+        bad = dict(snap)
+        blob = bytearray(base64.b64decode(snap["state_b64"]))
+        blob[len(blob) // 2] ^= 0xFF  # flip one payload bit
+        bad["state_b64"] = base64.b64encode(bytes(blob)).decode()
+        with pytest.raises(SnapshotMismatch, match="unusable|checksum"):
+            B.restore_session(bad, "x")
+        bad = dict(snap)
+        bad["state_b64"] = snap["state_b64"][: len(snap["state_b64"]) // 2]
+        with pytest.raises(SnapshotMismatch):
+            B.restore_session(bad, "x")
+        bad = dict(snap)
+        bad["t_index_list"] = [0]  # wrong length for the compiled steps
+        with pytest.raises(SnapshotMismatch, match="t_index_list"):
+            B.restore_session(bad, "x")
+        assert B.live_sessions == 0  # nothing landed
+
+        # -- the move -------------------------------------------------
+        sb = B.restore_session(snap, "sb")
+        assert sb.prompt == "migration prompt"
+        assert sb.guidance_scale == pytest.approx(1.4)
+        out_first = _tick(sb, frames[6])
+        ctrl_first = _tick(sc, frames[6])
+        # frame continuity: the migrated session continues the control's
+        # stream exactly...
+        assert np.array_equal(out_first, ctrl_first)
+        for f in frames[7:]:
+            assert np.array_equal(_tick(sb, f), _tick(sc, f))
+
+        # ...while the SOURCE was never touched by the export or the
+        # refused restores: its state is still parked after frame 5, so
+        # stepping frame 6 NOW reproduces the control's frame-6 output
+        assert np.array_equal(_tick(sa, frames[6]), ctrl_first)
+
+        # ...and a FRESH session does NOT reproduce the control's frame
+        # (the migrated state genuinely mattered — no keyframe re-prime)
+        sb.release()
+        fresh = B.claim("fresh", prompt="migration prompt", seed=5)
+        fresh.update_guidance(guidance_scale=1.4, delta=0.8)
+        assert not np.array_equal(_tick(fresh, frames[6]), ctrl_first)
+
+        # full pool refuses with CapacityError (the 503 path), state
+        # intact
+        B.claim("filler")
+        with pytest.raises(CapacityError):
+            B.restore_session(snap, "overflow")
+    finally:
+        for s in (A, B, C):
+            s.close()
+
+
+def test_snapshot_unknown_session_and_fingerprint_shape(bundle, cfg32):
+    sched = _mk_sched(bundle, cfg32)
+    try:
+        with pytest.raises(KeyError):
+            sched.snapshot_session("nobody")
+        fp = sched.snapshot_fingerprint()
+        assert fp["model_id"] == ""  # built without a model id
+        assert fp["height"] == 32 and fp["width"] == 32
+        assert fp["fbs"] == 1
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# 2. checkpoint blob round-trip property (satellite)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_blob_roundtrip_every_leaf_kind():
+    """Every leaf kind the session pytree actually carries survives the
+    blob bit-exactly: f32/bf16 state rows, uint8 frames, uint32 PRNG key
+    arrays, 0-d scalars, nested dict/list/tuple structure and python
+    scalars."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    tree = {
+        "x_buf": rng.standard_normal((2, 4, 4, 4)).astype(np.float32),
+        "noise_bf16": jnp.asarray(
+            rng.standard_normal((3, 8)), jnp.bfloat16
+        ),
+        "frame_u8": rng.integers(0, 256, (32, 32, 3)).astype(np.uint8),
+        "prng_key": jax.random.PRNGKey(123),
+        "coeffs": {
+            "timesteps": np.asarray([999], np.int32),
+            "scalar0d": np.float32(0.125),
+        },
+        "meta": ["prompt", 1.5, None, True, (np.int64(7),)],
+    }
+    back = deserialize_pytree(serialize_pytree(tree))
+    flat_a, td_a = jax.tree.flatten(tree)
+    flat_b, td_b = jax.tree.flatten(back)
+    assert td_a == td_b
+    for a, b in zip(flat_a, flat_b):
+        aa, bb = np.asarray(a), np.asarray(b)
+        assert aa.dtype == bb.dtype
+        assert aa.shape == bb.shape
+        assert aa.tobytes() == bb.tobytes()  # BIT exact, not just close
+
+
+def test_checkpoint_blob_refuses_corrupt_and_truncated():
+    blob = serialize_pytree({"a": np.arange(16, dtype=np.float32)})
+    with pytest.raises(ValueError, match="magic|version"):
+        deserialize_pytree(b"NOTMAGIC" + blob[8:])
+    with pytest.raises(ValueError, match="truncated"):
+        deserialize_pytree(blob[:6])
+    with pytest.raises(ValueError, match="truncated"):
+        deserialize_pytree(blob[:-4])  # payload cut short
+    flipped = bytearray(blob)
+    flipped[-1] ^= 0x01  # corrupt the last payload byte
+    with pytest.raises(ValueError, match="checksum"):
+        deserialize_pytree(bytes(flipped))
+    # a header-length field pointing past the end is truncation, not a
+    # crash
+    import struct
+
+    bad = blob[:8] + struct.pack("<I", 10_000_000) + blob[12:]
+    with pytest.raises(ValueError, match="truncated"):
+        deserialize_pytree(bad)
+
+
+def test_similarity_filter_state_roundtrip():
+    """The filter's stochastic decisions replay exactly after
+    export/restore (RNG position + previous-frame digest + streak)."""
+    from ai_rtc_agent_tpu.stream.engine import SimilarityFilter
+
+    rng = np.random.default_rng(2)
+    a = SimilarityFilter(0.5, 3, seed=9)
+    frames = [
+        rng.integers(0, 256, (32, 32, 3), np.uint8) for _ in range(4)
+    ] + [np.full((32, 32, 3), 7, np.uint8)] * 6
+    for f in frames[:5]:
+        a.should_skip(f, have_output=True)
+    b = SimilarityFilter(0.5, 3, seed=0)  # wrong seed on purpose
+    b.restore_state(a.export_state())
+    for f in frames[5:]:
+        assert a.should_skip(f, have_output=True) == b.should_skip(
+            f, have_output=True
+        )
+    with pytest.raises(ValueError):
+        b.restore_state({"skip_count": "x"})
+
+
+# ---------------------------------------------------------------------------
+# 3. HTTP orchestration: two real agent apps + the real router
+# ---------------------------------------------------------------------------
+
+class _MigSession:
+    """Duck-typed scheduler session whose identity is a state counter —
+    adoption continuity is assertable without a model."""
+
+    owns_step_signal = True
+
+    def __init__(self, owner, slot, key, counter=0):
+        from ai_rtc_agent_tpu.resilience.overload import DeadlineQueue
+
+        self._owner = owner
+        self.slot = slot
+        self.session_key = key
+        self.counter = counter
+        self.prompt = "p"
+        self.window_queue = DeadlineQueue(2)
+
+    def __call__(self, frame):
+        self.counter += 1
+        arr = frame if isinstance(frame, np.ndarray) else frame.to_ndarray()
+        return 255 - arr
+
+    def update_prompt(self, p):
+        self.prompt = p
+
+    def update_t_index_list(self, t):
+        pass
+
+    def release(self):
+        self._owner.released.append(self.session_key)
+
+
+class _MigScheduler:
+    """Fake batch scheduler speaking the migration surface: snapshot
+    carries the session counter, restore recreates it (or refuses —
+    ``refuse_restores`` models a mismatched target; ``explode_restores``
+    models an unexpected runtime failure inside the install)."""
+
+    def __init__(self, max_sessions=2, refuse_restores=False,
+                 restore_delay_s=0.0, explode_restores=False):
+        self.max_sessions = max_sessions
+        self.sessions = {}
+        self.released = []
+        self.restores = 0
+        self.refuse_restores = refuse_restores
+        self.restore_delay_s = restore_delay_s
+        self.explode_restores = explode_restores
+        self.on_step = None
+
+    @property
+    def free_slots(self):
+        return self.max_sessions - len(
+            [s for s in self.sessions.values()
+             if s.session_key not in self.released]
+        )
+
+    def claim(self, session_key=None, prompt=None, seed=None):
+        if self.free_slots <= 0:
+            raise CapacityError("full")
+        sess = _MigSession(self, len(self.sessions), session_key)
+        self.sessions[session_key] = sess
+        return sess
+
+    def session(self, key):
+        # scan by the session_key ATTRIBUTE (the real scheduler's
+        # semantics): adoption renames a restored session to the freshly
+        # minted stream id
+        for s in self.sessions.values():
+            if s.session_key == key and key not in self.released:
+                return s
+        return None
+
+    def snapshot_session(self, key):
+        sess = self.sessions.get(key)
+        if sess is None:
+            raise KeyError(key)
+        return {
+            "schema": SESSION_SNAPSHOT_SCHEMA,
+            "kind": "scheduler",
+            "counter": sess.counter,
+            "prompt": sess.prompt,
+        }
+
+    def restore_session(self, snap, key=None):
+        self.restores += 1
+        if self.restore_delay_s:
+            time.sleep(self.restore_delay_s)
+        if self.explode_restores:
+            raise RuntimeError("injected install failure")
+        if self.refuse_restores or snap.get("schema") != (
+            SESSION_SNAPSHOT_SCHEMA
+        ):
+            raise SnapshotMismatch("refused by test target")
+        if self.free_slots <= 0:
+            raise CapacityError("full")
+        sess = _MigSession(
+            self, len(self.sessions), key, counter=int(snap["counter"])
+        )
+        sess.prompt = snap.get("prompt", "p")
+        self.sessions[key] = sess
+        return sess
+
+    def update_prompt(self, p):
+        pass
+
+    def update_t_index_list(self, t):
+        pass
+
+    def snapshot(self):
+        return {"batchsched_sessions": self.max_sessions - self.free_slots,
+                "batchsched_max_sessions": self.max_sessions}
+
+    def session_snapshots(self):
+        return {
+            s.session_key: {"slot": s.slot}
+            for s in self.sessions.values()
+            if s.session_key not in self.released
+        }
+
+    def close(self):
+        pass
+
+
+async def _spawn_agent(sched):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.signaling import LoopbackProvider
+
+    class _Stub:
+        def __call__(self, frame):
+            return frame
+
+    app = build_app(
+        pipeline=_Stub(), provider=LoopbackProvider(), batch_scheduler=sched
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return app, client
+
+
+def _offer_body():
+    from ai_rtc_agent_tpu.server.signaling import make_loopback_offer
+
+    return {
+        "room_id": "mig-room",
+        "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+    }
+
+
+async def _fleet_harness(scheds):
+    """Real router + one real agent app per fake scheduler, registered
+    and polled once.  -> (router_client, router_app, agents, posted)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ai_rtc_agent_tpu.fleet.registry import FleetRegistry
+    from ai_rtc_agent_tpu.fleet.router import build_router_app
+    from ai_rtc_agent_tpu.server.events import StreamEventHandler
+
+    posted = []
+
+    class _Resp:
+        status = 200
+
+    class _CaptureSession:
+        async def post(self, url, headers=None, json=None):
+            posted.append(json)
+            return _Resp()
+
+    events = StreamEventHandler(
+        session_factory=_CaptureSession,
+        webhook_url="http://client-notify.example/hook", token="t",
+    )
+    reg = FleetRegistry(dead_after=2)
+    router_app = build_router_app(
+        registry=reg, events_handler=events, poll=True
+    )
+    router = TestClient(TestServer(router_app))
+    await router.start_server()
+    agents = []
+    for i, sched in enumerate(scheds):
+        app, client = await _spawn_agent(sched)
+        agents.append((app, client))
+        r = await router.post("/fleet/register", json={
+            "worker_id": f"m-agent{i}", "public_ip": "127.0.0.1",
+            "public_port": str(client.server.port), "status": "ready",
+            "capacity": sched.max_sessions,
+        })
+        assert r.status == 200
+    await router_app["poller"].poll_once()
+    return router, router_app, agents, posted
+
+
+async def _wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        r = predicate()
+        if r:
+            return r
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        await asyncio.sleep(0.05)
+
+
+def test_http_migrate_drain_moves_session_and_repins_reoffer():
+    """The full wire story: drain?mode=migrate exports off the source,
+    imports on the target under a counted reservation, fires
+    StreamMigrated, and the client's echoed re-offer is PINNED to the
+    target where the imported session is ADOPTED as journey leg 2 with
+    its state counter intact (mid-stream resume, not a fresh claim)."""
+    src_sched = _MigScheduler()
+    dst_sched = _MigScheduler()
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        try:
+            r = await router.post("/offer", json=_offer_body())
+            assert r.status == 200, await r.text()
+            sid = r.headers["X-Stream-Id"]
+            jid = r.headers["X-Journey-Id"]
+            assert router_app["session_table"].owner(sid) == "m-agent0"
+            # stream a little: the counter IS the mid-stream state
+            sess = src_sched.session(sid)
+            for _ in range(5):
+                sess(np.zeros((4, 4, 3), np.uint8))
+            assert sess.counter == 5
+
+            r = await router.post(
+                "/fleet/drain?agent=m-agent0&mode=migrate"
+            )
+            body = await r.json()
+            assert body["draining"] and body["mode"] == "migrate"
+            assert body["migrating"] == 1
+
+            migrated = await _wait_for(
+                lambda: [e for e in posted
+                         if e.get("event") == "StreamMigrated"],
+                10, "StreamMigrated webhook",
+            )
+            ev = migrated[0]
+            assert ev["stream_id"] == sid
+            assert ev["journey_id"] == jid
+            assert ev["source_agent"] == "m-agent0"
+            assert ev["target_agent"] == "m-agent1"
+            assert ev["reason"] == "drain"
+            assert dst_sched.restores == 1
+            # the source kept serving the whole time
+            assert src_sched.released == []
+
+            # the client re-offers echoing its journey id -> pinned to
+            # the target, adopted, leg 2
+            r = await router.post(
+                "/offer", json=_offer_body(),
+                headers={"X-Journey-Id": jid},
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Journey-Id"] == jid
+            assert r.headers["X-Journey-Leg"] == "2"
+            new_sid = r.headers["X-Stream-Id"]
+            assert router_app["session_table"].owner(new_sid) == "m-agent1"
+            adopted = dst_sched.session(new_sid)
+            assert adopted is not None
+            assert adopted.counter == 5  # mid-stream state, not a fresh claim
+            # adoption consumed the parked import (no double-adopt)
+            dst_app = agents[1][0]
+            assert dst_app["imported_sessions"] == {}
+            # one journey, both legs; the ring tells the move story
+            record = router_app["journeys"].get(jid)
+            kinds = [e["kind"] for e in record["events"]]
+            assert "migrated" in kinds
+            assert [leg["agent"] for leg in record["legs"]] == [
+                "m-agent0", "m-agent1",
+            ]
+            m = await (await router.get("/metrics")).json()
+            assert m["migrations_total"] == 1
+            assert m.get("migrations_failed_total", 0) == 0
+            assert m["migration_ms_p50"] > 0
+            # a moved session's banked export is dropped — the source
+            # dying later must not crash-restore a SECOND copy
+            assert m["migration_snapshots_banked"] == 0
+            # prom rendering stays label-free and conformant
+            r = await router.get("/metrics", params={"format": "prom"})
+            text = await r.text()
+            assert "# TYPE migrations_total counter" in text
+            assert "migration_ms_p50" in text
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_migrate_abort_safety_and_retry_4xx_terminal():
+    """A target that REFUSES the restore (schema-mismatch 409) gets
+    exactly ONE import attempt (the retry-4xx rule) and the source keeps
+    serving — the drain degrades to kill semantics with a
+    ``migrate_failed`` ring entry and captured evidence."""
+    src_sched = _MigScheduler()
+    dst_sched = _MigScheduler(refuse_restores=True)
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        try:
+            r = await router.post("/offer", json=_offer_body())
+            assert r.status == 200
+            sid = r.headers["X-Stream-Id"]
+            jid = r.headers["X-Journey-Id"]
+            r = await router.post(
+                "/fleet/drain?agent=m-agent0&mode=migrate"
+            )
+            assert (await r.json())["migrating"] == 1
+
+            def failed():
+                rec = router_app["journeys"].get(jid)
+                return [e for e in rec["events"]
+                        if e["kind"] == "migrate_failed"]
+
+            await _wait_for(failed, 10, "migrate_failed ring entry")
+            assert dst_sched.restores == 1  # 409 was TERMINAL: one attempt
+            assert src_sched.released == []  # source serving untouched
+            assert not [e for e in posted
+                        if e.get("event") == "StreamMigrated"]
+            m = await (await router.get("/metrics")).json()
+            assert m["migrations_failed_total"] == 1
+            assert m.get("migrations_total", 0) == 0
+            # the banked export still serves the crash path
+            assert m["migration_snapshots_banked"] == 1
+            # kill-drain semantics continue: agent frozen, recyclable
+            # once the client eventually leaves
+            rec = router_app["fleet"].agents["m-agent0"]
+            assert rec.draining
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_crash_restore_reuses_banked_snapshot():
+    """AGENT_DEAD with a recent snapshot banked (an interrupted
+    drain-as-move exported it before the source died): the crash path
+    reuses the restore surface — import on a survivor + StreamMigrated
+    (reason=agent_dead) instead of the plain AGENT_DEAD re-point — and
+    the client resumes mid-stream."""
+    src_sched = _MigScheduler()
+    dst_sched = _MigScheduler(refuse_restores=True)
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        try:
+            r = await router.post("/offer", json=_offer_body())
+            assert r.status == 200
+            sid = r.headers["X-Stream-Id"]
+            jid = r.headers["X-Journey-Id"]
+            sess = src_sched.session(sid)
+            for _ in range(7):
+                sess(np.zeros((4, 4, 3), np.uint8))
+            # a migrate-drain whose import FAILS still banks the export
+            await router.post("/fleet/drain?agent=m-agent0&mode=migrate")
+            await _wait_for(
+                lambda: router_app["snapshot_bank"].get(sid), 10,
+                "banked snapshot",
+            )
+            assert not [e for e in posted
+                        if e.get("event") == "StreamMigrated"]
+
+            # the target recovers; then the SOURCE dies (SIGKILL shape:
+            # consecutive poll failures) -> crash restore from the bank
+            dst_sched.refuse_restores = False
+            reg = router_app["fleet"]
+            rec = reg.agents["m-agent0"]
+            reg.note_poll_fail(rec)
+            reg.note_poll_fail(rec)
+            assert rec.state == "DEAD"
+
+            migrated = await _wait_for(
+                lambda: [e for e in posted
+                         if e.get("event") == "StreamMigrated"],
+                10, "crash-restore StreamMigrated",
+            )
+            ev = migrated[0]
+            assert ev["reason"] == "agent_dead"
+            assert ev["target_agent"] == "m-agent1"
+            assert ev["journey_id"] == jid
+            # no plain AGENT_DEAD re-point for this stream — the restore
+            # superseded it
+            assert not [e for e in posted
+                        if e.get("state") == "AGENT_DEAD"]
+            # the echoed re-offer adopts the restored mid-stream state
+            r = await router.post(
+                "/offer", json=_offer_body(),
+                headers={"X-Journey-Id": jid},
+            )
+            assert r.status == 200
+            new_sid = r.headers["X-Stream-Id"]
+            assert router_app["session_table"].owner(new_sid) == "m-agent1"
+            adopted = dst_sched.session(new_sid)
+            assert adopted is not None and adopted.counter == 7
+            m = await (await router.get("/metrics")).json()
+            assert m["migrations_total"] == 1
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_migrate_timeout_falls_back_to_kill_drain():
+    """A hung target trips MIGRATE_TIMEOUT_S: the sweep is abandoned
+    (migration_fallbacks_total), the source keeps serving, and the drain
+    keeps its ordinary kill semantics."""
+    src_sched = _MigScheduler()
+    dst_sched = _MigScheduler(restore_delay_s=1.5)
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        router_app["migrate_timeout_s"] = 0.2
+        try:
+            r = await router.post("/offer", json=_offer_body())
+            assert r.status == 200
+            r = await router.post(
+                "/fleet/drain?agent=m-agent0&mode=migrate"
+            )
+            assert (await r.json())["migrating"] == 1
+
+            await _wait_for(
+                lambda: not router_app["migrate_tasks"], 10,
+                "migrate sweep to finish",
+            )
+            m = await (await router.get("/metrics")).json()
+            assert m["migration_fallbacks_total"] == 1
+            assert m.get("migrations_total", 0) == 0
+            assert src_sched.released == []
+            assert router_app["fleet"].agents["m-agent0"].draining
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_migrate_drain_idempotent_and_cancel_stops_new_moves():
+    """Code-review regressions: (a) re-asserting an already-draining
+    migrate drain must NOT spawn a second sweep over the same sessions;
+    (b) action=cancel stops NEW moves mid-sweep (in-flight ones finish)."""
+    # the SOURCE advertises the most capacity so both offers land on it
+    src_sched = _MigScheduler(max_sessions=4)
+    dst_sched = _MigScheduler(max_sessions=2, restore_delay_s=0.3)
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        router_app["migrate_max_parallel"] = 1
+        try:
+            for _ in range(2):
+                r = await router.post("/offer", json=_offer_body())
+                assert r.status == 200
+            r = await router.post("/fleet/drain?agent=m-agent0&mode=migrate")
+            assert (await r.json())["migrating"] == 2
+            # an operator retry of the same drain: no second sweep
+            r = await router.post("/fleet/drain?agent=m-agent0&mode=migrate")
+            assert (await r.json())["migrating"] == 0
+            # cancel while the FIRST move's import is still sleeping:
+            # the superseded sweep's QUEUED session must never leave
+            await router.post(
+                "/fleet/drain?agent=m-agent0&action=cancel"
+            )
+            stale_restores = dst_sched.restores
+            # ...and an IMMEDIATE restart must start a FRESH sweep (the
+            # superseded sweep finishing its in-flight move does not
+            # block it — cancel-then-restart migrates, it does not
+            # silently degrade to kill semantics)
+            r = await router.post(
+                "/fleet/drain?agent=m-agent0&mode=migrate"
+            )
+            assert (await r.json())["migrating"] >= 1
+            await _wait_for(
+                lambda: not router_app["migrate_tasks"], 10,
+                "sweeps to finish",
+            )
+            assert dst_sched.restores > stale_restores  # fresh sweep ran
+            # a retry of the RUNNING fresh sweep still no-ops
+            r = await router.post(
+                "/fleet/drain?agent=m-agent0&mode=migrate"
+            )
+            # (sweep just finished, so this may re-sweep leftovers —
+            # both outcomes are valid; the invariant is no CONCURRENT
+            # duplicate, pinned by the stale_restores check above)
+            await _wait_for(
+                lambda: not router_app["migrate_tasks"], 10,
+                "trailing sweep to finish",
+            )
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_import_releases_reservation_on_unexpected_failure():
+    """An install blowing up with an unexpected error (not a refusal,
+    not capacity) answers 500 — and must NOT strand the counted
+    admission reservation for its TTL (the router retries 5xx; a
+    phantom reservation per episode would 503 real offers)."""
+    sched = _MigScheduler(explode_restores=True)
+
+    async def go():
+        app, client = await _spawn_agent(sched)
+        try:
+            cap0 = (await (await client.get("/capacity")).json())["capacity"]
+            r = await client.post("/migrate/import", json={
+                "token": "boom",
+                "snapshot": {
+                    "kind": "scheduler",
+                    "schema": SESSION_SNAPSHOT_SCHEMA,
+                    "counter": 1,
+                },
+            })
+            assert r.status == 500
+            cap1 = (await (await client.get("/capacity")).json())["capacity"]
+            assert cap1 == cap0  # reservation released, not stranded
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_http_ended_session_mid_sweep_is_not_a_failed_migration():
+    """A client hanging up while its session waits in the sweep queue is
+    a SUCCESSFUL drain outcome: no migrations_failed count, no
+    migrate_failed ring entry, no evidence pull."""
+    # the SOURCE advertises the most capacity so both offers land on it
+    src_sched = _MigScheduler(max_sessions=4)
+    dst_sched = _MigScheduler(max_sessions=2, restore_delay_s=0.3)
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        router_app["migrate_max_parallel"] = 1
+        src_app = agents[0][0]
+        try:
+            sids = []
+            for _ in range(2):
+                r = await router.post("/offer", json=_offer_body())
+                assert r.status == 200
+                sids.append(r.headers["X-Stream-Id"])
+            r = await router.post("/fleet/drain?agent=m-agent0&mode=migrate")
+            assert (await r.json())["migrating"] == 2
+            # while the first move's import sleeps, the SECOND session
+            # ends naturally: StreamEnded prunes the table and the agent
+            # stops exporting it
+            router_app["session_table"].forget(sids[1])
+            src_app["supervisors"].pop(sids[1], None)
+            src_sched.released.append(sids[1])
+            await _wait_for(
+                lambda: not router_app["migrate_tasks"], 10,
+                "sweep to finish",
+            )
+            m = await (await router.get("/metrics")).json()
+            assert m.get("migrations_failed_total", 0) == 0
+            assert m["migrations_total"] == 1  # the live one moved
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_concurrent_import_same_token_restores_once():
+    """A retry racing a FIRST import still inside its restore must not
+    land a second slot: one request restores, the other answers 503 (or
+    the idempotent parked result) — never two restores."""
+    sched = _MigScheduler(restore_delay_s=0.3)
+
+    async def go():
+        app, client = await _spawn_agent(sched)
+        try:
+            body = {
+                "token": "race",
+                "snapshot": {
+                    "kind": "scheduler",
+                    "schema": SESSION_SNAPSHOT_SCHEMA,
+                    "counter": 1,
+                },
+            }
+            r1, r2 = await asyncio.gather(
+                client.post("/migrate/import", json=body),
+                client.post("/migrate/import", json=body),
+            )
+            statuses = sorted([r1.status, r2.status])
+            assert statuses in ([200, 200], [200, 503]), statuses
+            assert sched.restores == 1
+            assert len(app["imported_sessions"]) == 1
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_http_stale_pin_is_ignored():
+    """A migration pin older than the target's import TTL is dead (the
+    parked session expired): the re-offer must fall back to ordinary
+    placement instead of chasing the old target with a dead token."""
+    src_sched = _MigScheduler()
+    dst_sched = _MigScheduler()
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness(
+            [src_sched, dst_sched]
+        )
+        try:
+            r = await router.post("/offer", json=_offer_body())
+            assert r.status == 200
+            jid = r.headers["X-Journey-Id"]
+            await router.post("/fleet/drain?agent=m-agent0&mode=migrate")
+            await _wait_for(
+                lambda: jid in router_app["migrations"], 10, "pin"
+            )
+            router_app["migrations"][jid]["ts"] -= 60.0  # age past TTL
+            r = await router.post(
+                "/offer", json=_offer_body(),
+                headers={"X-Journey-Id": jid},
+            )
+            assert r.status == 200
+            new_sid = r.headers["X-Stream-Id"]
+            # not adopted: wherever it landed, it is a FRESH claim (the
+            # restored counter never surfaces) and the stale pin is gone
+            adopted = dst_sched.session(new_sid)
+            assert adopted is None or adopted.counter == 0
+            assert jid not in router_app["migrations"]
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())
+
+
+def test_http_migrate_requires_journey_plane():
+    """mode=migrate without the journey plane would silently degrade
+    every move to a fresh re-prime (the re-offer pin is keyed by journey
+    id) — the router refuses with 409 instead."""
+    import os
+
+    sched = _MigScheduler()
+
+    async def go():
+        router, router_app, agents, posted = await _fleet_harness([sched])
+        try:
+            assert router_app["journeys"] is None
+            r = await router.post("/fleet/drain?agent=m-agent0&mode=migrate")
+            assert r.status == 409
+            assert "journey" in (await r.text())
+            # plain kill-drain still works
+            r = await router.post("/fleet/drain?agent=m-agent0")
+            assert r.status == 200
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    os.environ["JOURNEY_ENABLE"] = "0"
+    try:
+        asyncio.run(go())
+    finally:
+        os.environ.pop("JOURNEY_ENABLE", None)
+
+
+def test_http_migrate_kill_switch_and_agent_surface():
+    """MIGRATE_ENABLE=0 removes the surface end to end: the agent's
+    export/import endpoints 404 and the router refuses mode=migrate with
+    409 (drain itself still works).  With it on, the agent endpoints
+    enforce the reservation-first + schema-refusal contract directly."""
+    sched = _MigScheduler()
+
+    async def go_disabled():
+        app, client = await _spawn_agent(sched)
+        try:
+            r = await client.get("/migrate/export?session=x")
+            assert r.status == 404
+            r = await client.post("/migrate/import", json={})
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    async def go_enabled():
+        app, client = await _spawn_agent(sched)
+        try:
+            # unknown session -> 404; missing selector -> 400
+            r = await client.get("/migrate/export")
+            assert r.status == 400
+            r = await client.get("/migrate/export?session=nobody")
+            assert r.status == 404
+            # import: schema mismatch -> 409 AND the reservation it took
+            # is released (capacity unchanged)
+            cap0 = (await (await client.get("/capacity")).json())["capacity"]
+            r = await client.post("/migrate/import", json={
+                "token": "t1",
+                "snapshot": {"kind": "scheduler", "schema": 999},
+            })
+            assert r.status == 409
+            cap1 = (await (await client.get("/capacity")).json())["capacity"]
+            assert cap0 == cap1
+            # a good import parks the session AND holds a reservation
+            r = await client.post("/migrate/import", json={
+                "token": "t2",
+                "snapshot": {
+                    "kind": "scheduler",
+                    "schema": SESSION_SNAPSHOT_SCHEMA,
+                    "counter": 3,
+                },
+            })
+            assert r.status == 200
+            body = await r.json()
+            assert body["restored"] is True
+            assert "t2" in app["imported_sessions"]
+            cap2 = (await (await client.get("/capacity")).json())["capacity"]
+            assert cap2 == cap1 - 1  # reservation counted, not double-sold
+            # a RETRIED import under the same token (lost response) is
+            # idempotent: no second restore, no second slot, the parked
+            # session stays reachable
+            restores_before = sched.restores
+            r = await client.post("/migrate/import", json={
+                "token": "t2",
+                "snapshot": {
+                    "kind": "scheduler",
+                    "schema": SESSION_SNAPSHOT_SCHEMA,
+                    "counter": 3,
+                },
+            })
+            assert r.status == 200
+            assert (await r.json())["restored"] is True
+            assert sched.restores == restores_before
+            cap3 = (await (await client.get("/capacity")).json())["capacity"]
+            assert cap3 == cap2
+            # unknown kind -> 400
+            r = await client.post("/migrate/import", json={
+                "token": "t3", "snapshot": {"kind": "??", "schema": 1},
+            })
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    import os
+
+    os.environ["MIGRATE_ENABLE"] = "0"
+    try:
+        asyncio.run(go_disabled())
+    finally:
+        os.environ.pop("MIGRATE_ENABLE", None)
+    asyncio.run(go_enabled())

@@ -206,6 +206,11 @@ def test_bucket_step_flops_scale_with_occupancy(bundle):
     assert f1 < 0.5 * ffull, (f1, ffull)
 
 
+@pytest.mark.slow  # prewarm x AOT-adoption composition (~8s; ISSUE 15
+# budget pairing): test_multipeer_aot_cache_roundtrip keeps the AOT
+# surface and test_bucket_step_matches_full_step the bucket math in
+# tier-1; the scheduler twin (prewarm-ready executables, zero serving
+# retraces) is pinned by test_sharded_churn_never_retraces
 def test_prewarm_buckets_compiles_and_survives_aot(bundle, tmp_path):
     """prewarm_buckets must produce READY executables (jax.jit alone is
     lazy) and re-enable buckets on the AOT-adopted path."""
