@@ -59,6 +59,11 @@ JOURNEY_EVENTS = (
                      # crash restore) — the re-offer continues as leg+1
     "migrate_failed",  # a migration attempt aborted; the source keeps
                        # serving (kill-drain semantics take over)
+    "recycled",      # the serving agent restarted in place: state parked
+                     # on the SAME box, the re-offer re-adopts as leg+1
+    "upgraded",      # the session moved as a rolling-upgrade sweep step
+    "scaled",        # the session moved because the autoscaler retired
+                     # its (emptiest) agent
     "ended",         # StreamEnded webhook arrived
     "evidence",      # an agent-side capture was stored
     "bundle",        # the journey was sealed into the incident store

@@ -25,7 +25,14 @@ State machine per agent::
        +-- drain ---+--> DRAINING --(live sessions reach 0)--> recyclable
 
 DEAD is terminal until the worker re-registers (a recycled replacement
-publishing the same worker_id revives the record fresh).  DRAINING rides
+publishing the same worker_id revives the record fresh).  Every record
+carries an **epoch** (ISSUE 16): a revival — DEAD re-publish, address
+change, or a new process ``boot_id`` behind the same address (the
+restart-in-place recycle) — bumps it, and anything minted by the old
+process (a webhook attributed through the session table, a poll answer
+that was in flight across the swap, a ghost worker republish carrying a
+retired boot id) is dropped with the ``fleet_stale_epoch_dropped``
+counter instead of being read as evidence about the new one.  DRAINING rides
 the agent's admission-freeze rung (``POST /drain`` on the agent): the
 agent itself stops admitting, live sessions finish naturally, and the
 registry flips ``recyclable`` when its session count reaches zero.
@@ -40,6 +47,7 @@ already absorbed the placements by then.
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import time
 
@@ -62,13 +70,16 @@ class AgentRecord:
     __slots__ = (
         "agent_id", "base_url", "state", "capacity", "saturated",
         "retry_after_s", "live_sessions", "draining", "recyclable",
-        "fail_count", "placed", "not_before", "last_ok",
+        "fail_count", "placed", "not_before", "last_ok", "epoch",
+        "boot_id",
     )
 
     def __init__(self, agent_id: str, base_url: str):
         self.agent_id = agent_id
         self.base_url = base_url.rstrip("/")
         self.state = "HEALTHY"
+        self.epoch = 1  # bumped on every revival/replacement of this id
+        self.boot_id = ""  # the process nonce behind this record (if known)
         self.capacity = -1  # agent-advertised remaining sessions; -1 = unbounded
         self.saturated = False
         self.retry_after_s = 0.0
@@ -108,6 +119,7 @@ class AgentRecord:
         return {
             "state": self.state,
             "base_url": self.base_url,
+            "epoch": self.epoch,
             "capacity": self.capacity,
             "saturated": self.saturated,
             "live_sessions": self.live_sessions,
@@ -149,6 +161,12 @@ class FleetRegistry:
         self.stats = stats
         self.on_dead = on_dead
         self.agents: dict[str, AgentRecord] = {}
+        # boot ids this registry has superseded, per agent id: a worker
+        # republish carrying one is a ghost (the pre-recycle process's
+        # sidecar racing its own death) and must not touch the record.
+        # Both dimensions bounded: ids evict oldest-first past the
+        # membership cap, each id keeps only its last few boots.
+        self._retired_boots: dict[str, collections.deque] = {}
 
     def now(self) -> float:
         return self._clock()
@@ -160,14 +178,26 @@ class FleetRegistry:
         Returns the record, or None when the registry is full (bounded
         membership — a rogue publisher cannot grow it without limit).
         A publish for a known id refreshes it; publishing over a DEAD
-        record is the recycle path and revives it fresh."""
+        record — or under a new ``boot_id`` (a recycled replacement on
+        the same address) — is the recycle path and revives it fresh
+        with the epoch bumped.  A publish carrying a RETIRED boot id is
+        the old process's ghost and is dropped (counted, record
+        untouched)."""
         agent_id = str(info.get("worker_id") or "")
         port = str(info.get("public_port") or "")
         if not agent_id or not port:
             raise ValueError("publish needs worker_id and public_port")
         host = str(info.get("public_ip") or "127.0.0.1")
         base_url = f"http://{host}:{port}"
+        boot_id = str(info.get("boot_id") or "")
         rec = self.agents.get(agent_id)
+        if (rec is not None and boot_id
+                and boot_id in self._retired_boots.get(agent_id, ())):
+            # old-process ghost: its worker sidecar republishing after
+            # the replacement already registered — ingesting this would
+            # hand the NEW process the old one's capacity view
+            self._count("fleet_stale_epoch_dropped")
+            return rec
         if rec is None:
             if len(self.agents) >= self.max_agents:
                 # corpses must not lock out replacements: orchestrators
@@ -184,11 +214,22 @@ class FleetRegistry:
                 self._count("fleet_registers_refused")
                 return None
             rec = AgentRecord(agent_id, base_url)
+            rec.boot_id = boot_id
             self.agents[agent_id] = rec
-        elif rec.state == "DEAD" or rec.base_url != base_url.rstrip("/"):
-            # replacement (same id re-published, possibly at a new
-            # address): forget the corpse's history entirely
+        elif (rec.state == "DEAD" or rec.base_url != base_url.rstrip("/")
+                or (boot_id and rec.boot_id and boot_id != rec.boot_id)):
+            # replacement (same id re-published: revival, a new address,
+            # or a NEW process behind the same address — the
+            # restart-in-place recycle): forget the old history entirely
+            # but BUMP the epoch and retire the old boot id, so nothing
+            # the old process minted can read as the new one's evidence
+            old_epoch = rec.epoch
+            self._retire_boot(agent_id, rec.boot_id)
             self.agents[agent_id] = rec = AgentRecord(agent_id, base_url)
+            rec.epoch = old_epoch + 1
+            rec.boot_id = boot_id
+        elif boot_id and not rec.boot_id:
+            rec.boot_id = boot_id  # first publish that carries a nonce
         if "capacity" in info:
             try:
                 rec.capacity = int(info["capacity"])
@@ -200,6 +241,24 @@ class FleetRegistry:
 
     def remove(self, agent_id: str) -> bool:
         return self.agents.pop(agent_id, None) is not None
+
+    def _retire_boot(self, agent_id: str, boot_id: str):
+        if not boot_id:
+            return
+        seen = self._retired_boots.get(agent_id)
+        if seen is None:
+            while len(self._retired_boots) >= self.max_agents * 4:
+                self._retired_boots.pop(next(iter(self._retired_boots)))
+            seen = self._retired_boots[agent_id] = collections.deque(
+                maxlen=8)
+        seen.append(boot_id)
+
+    def note_stale_epoch(self):
+        """One stale-epoch artifact dropped by a caller that resolved
+        attribution itself (a webhook whose session-table epoch no
+        longer matches the record, a poll answer that landed after the
+        record it was fetched for was superseded)."""
+        self._count("fleet_stale_epoch_dropped")
 
     # -- health feeds ---------------------------------------------------------
 
@@ -366,7 +425,7 @@ class FleetRegistry:
 
     def _count(self, name: str, n: int = 1):
         if self.stats is not None:
-            # tpurtc: allow[metrics-registry] -- closed set: every name this registry counts is a literal at its call sites (fleet_registers, fleet_registers_refused, fleet_polls_failed, fleet_agents_died, fleet_events_ingested, fleet_breaches, fleet_placements)
+            # tpurtc: allow[metrics-registry] -- closed set: every name this registry counts is a literal at its call sites (fleet_registers, fleet_registers_refused, fleet_polls_failed, fleet_agents_died, fleet_events_ingested, fleet_breaches, fleet_placements, fleet_stale_epoch_dropped)
             self.stats.count(name, n)
 
 
@@ -428,6 +487,7 @@ class FleetPoller:
     async def _poll_agent(self, rec: AgentRecord):
         import aiohttp
 
+        epoch = rec.epoch
         try:
             cap, health = await asyncio.gather(
                 self._get_json(rec.base_url + "/capacity"),
@@ -435,7 +495,22 @@ class FleetPoller:
             )
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             logger.debug("poll of %s failed: %s", rec.agent_id, e)
+            if self.registry.agents.get(rec.agent_id) is not rec:
+                return  # superseded mid-poll: not the new record's failure
             self.registry.note_poll_fail(rec)
+            return
+        if (self.registry.agents.get(rec.agent_id) is not rec
+                or rec.epoch != epoch):
+            # the record was replaced while this HTTP was in flight —
+            # the bodies describe the OLD process, not the current one
+            self.registry.note_stale_epoch()
+            return
+        if (cap is not None and rec.boot_id
+                and str(cap.get("boot_id") or "")
+                and str(cap.get("boot_id")) != rec.boot_id):
+            # a different process answered this record's address (a
+            # recycled replacement bound before its worker re-registered)
+            self.registry.note_stale_epoch()
             return
         if cap is None and health is None:
             # 200s that carry no parseable agent surface (a reverse proxy
@@ -464,3 +539,161 @@ class FleetPoller:
         if self._session is not None:
             await self._session.close()
             self._session = None
+
+
+class AutoscaleController:
+    """Demand-driven fleet sizing (ISSUE 16): pure decision logic, no
+    I/O — the router's tick task samples, calls :meth:`tick`, and
+    executes what comes back ("up" = spawn one agent, "down" = retire
+    the emptiest via migrate-drain).
+
+    The pressure signal is the fraction of live (non-DEAD, non-draining)
+    agents that cannot take a session right now — saturated, inside a
+    Retry-After window, or at zero effective capacity — pushed to 1.0
+    for any tick in which the ROUTER itself refused a placement
+    (``fleet_rejects`` moved): a fleet-wide 503 is full pressure no
+    matter what the per-agent reads say.  The sample feeds an EWMA, and
+    the overload-ladder hysteresis discipline applies on top: "up" only
+    after ``up_ticks`` consecutive smoothed reads at/above ``high`` AND
+    the cooldown since the last action has elapsed; "down" only after
+    ``down_ticks`` consecutive reads at/below ``low``.  Every action
+    resets both streaks and re-arms the cooldown, so one spawn cannot
+    cascade into a flap.  ``min_agents``/``max_agents`` bound the fleet;
+    the controller is inert unless ``AUTOSCALE_ENABLE`` is on.
+    """
+
+    def __init__(
+        self,
+        registry: FleetRegistry,
+        *,
+        clock=time.monotonic,
+        enabled: bool | None = None,
+        high: float | None = None,
+        low: float | None = None,
+        alpha: float | None = None,
+        up_ticks: int | None = None,
+        down_ticks: int | None = None,
+        cooldown_s: float | None = None,
+        min_agents: int | None = None,
+        max_agents: int | None = None,
+    ):
+        self.registry = registry
+        self._clock = clock
+        self.enabled = (
+            env.get_bool("AUTOSCALE_ENABLE", False)
+            if enabled is None else enabled
+        )
+        self.high = (
+            env.get_float("AUTOSCALE_HIGH", 0.8) if high is None else high
+        )
+        self.low = env.get_float("AUTOSCALE_LOW", 0.2) if low is None else low
+        self.alpha = (
+            env.get_float("AUTOSCALE_ALPHA", 0.3) if alpha is None else alpha
+        )
+        self.up_ticks = max(1, (
+            env.get_int("AUTOSCALE_UP_TICKS", 3)
+            if up_ticks is None else up_ticks
+        ))
+        self.down_ticks = max(1, (
+            env.get_int("AUTOSCALE_DOWN_TICKS", 10)
+            if down_ticks is None else down_ticks
+        ))
+        self.cooldown_s = (
+            env.get_float("AUTOSCALE_COOLDOWN_S", 30.0)
+            if cooldown_s is None else cooldown_s
+        )
+        self.min_agents = max(1, (
+            env.get_int("AUTOSCALE_MIN_AGENTS", 1)
+            if min_agents is None else min_agents
+        ))
+        self.max_agents = (
+            env.get_int("AUTOSCALE_MAX_AGENTS", 16)
+            if max_agents is None else max_agents
+        )
+        self.ewma = 0.0
+        self._above = 0
+        self._below = 0
+        self._last_action_at: float | None = None
+        self._last_rejects = 0
+
+    def _live(self) -> list[AgentRecord]:
+        return [
+            r for r in self.registry.agents.values()
+            if r.state != "DEAD" and not r.draining
+        ]
+
+    def sample(self, rejects_total: int = 0) -> float:
+        """One raw pressure observation in [0, 1]."""
+        rejected = rejects_total > self._last_rejects
+        self._last_rejects = max(self._last_rejects, rejects_total)
+        live = self._live()
+        if not live:
+            # an empty (or fully draining) fleet refusing traffic is the
+            # definition of under-provisioned; idle-and-empty is calm
+            return 1.0 if rejected else 0.0
+        if rejected:
+            return 1.0
+        now = self._clock()
+        pressed = sum(
+            1 for r in live
+            if r.saturated or not r.available(now)
+            or r.effective_capacity() == 0
+        )
+        return pressed / len(live)
+
+    def tick(self, rejects_total: int = 0) -> str | None:
+        """Fold one observation in; return "up", "down", or None.
+        Callers execute the decision — a returned action re-arms the
+        cooldown even if execution later fails (failed spawns must not
+        retry at tick cadence)."""
+        if not self.enabled:
+            return None
+        p = self.sample(rejects_total)
+        self.ewma += self.alpha * (p - self.ewma)
+        if self.ewma >= self.high:
+            self._above += 1
+            self._below = 0
+        elif self.ewma <= self.low:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = 0
+            self._below = 0
+        now = self._clock()
+        if (self._last_action_at is not None
+                and now - self._last_action_at < self.cooldown_s):
+            return None
+        n_live = len(self._live())
+        if self._above >= self.up_ticks and n_live < self.max_agents:
+            self._mark_action(now)
+            return "up"
+        if (self._below >= self.down_ticks and n_live > self.min_agents
+                and self.retire_candidate() is not None):
+            self._mark_action(now)
+            return "down"
+        return None
+
+    def _mark_action(self, now: float):
+        self._last_action_at = now
+        self._above = 0
+        self._below = 0
+
+    def retire_candidate(self) -> AgentRecord | None:
+        """The emptiest HEALTHY agent, or None when shrinking would
+        break the floor (migration makes retirement free, but only a
+        box in good standing is worth paying a sweep for)."""
+        live = self._live()
+        if len(live) <= self.min_agents:
+            return None
+        healthy = [r for r in live if r.state == "HEALTHY"]
+        if not healthy:
+            return None
+        return min(healthy, key=lambda r: (r.live_sessions + r.placed))
+
+    def snapshot(self) -> dict:
+        """Rollup gauges (zero-cardinality: no agent identity)."""
+        return {
+            "autoscale_pressure_ewma": round(self.ewma, 4),
+            "autoscale_up_streak": self._above,
+            "autoscale_down_streak": self._below,
+        }

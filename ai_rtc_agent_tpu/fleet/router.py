@@ -16,6 +16,12 @@ An aiohttp application that makes N agent processes look like one:
                                  admission-freeze rung (&action=cancel
                                  reverts); /fleet/health shows
                                  ``recyclable`` once it reaches zero
+  POST /fleet/upgrade            rolling fleet upgrade (ISSUE 16): sweep
+                                 agents one at a time through
+                                 drain?mode=migrate → /admin/recycle →
+                                 re-register + prewarm; any failure
+                                 halts with the old agent serving
+                                 (&action=cancel aborts abort-safely)
   GET  /fleet/health             per-agent membership view (JSON only)
   GET  /metrics                  fleet rollup, aggregated across agents
                                  (?format=prom = Prometheus exposition)
@@ -57,7 +63,7 @@ from ..server.events import StreamEventHandler
 from ..utils import env
 from ..utils.profiling import FrameStats
 from .journey import JourneyLog
-from .registry import FleetPoller, FleetRegistry
+from .registry import AutoscaleController, FleetPoller, FleetRegistry
 
 logger = logging.getLogger(__name__)
 
@@ -98,7 +104,8 @@ class _SessionTable:
         self.evicted = 0
 
     def remember(self, stream_id: str, agent_id: str, room_id: str,
-                 kind: str, journey_id: str | None = None, leg: int = 1):
+                 kind: str, journey_id: str | None = None, leg: int = 1,
+                 epoch: int | None = None):
         self._m.pop(stream_id, None)
         while len(self._m) >= self.bound:
             self._m.pop(next(iter(self._m)))
@@ -106,11 +113,18 @@ class _SessionTable:
         self._m[stream_id] = {
             "agent": agent_id, "room_id": room_id, "kind": kind,
             "journey_id": journey_id, "leg": leg,
+            # the owning record's epoch AT PLACEMENT: a webhook whose
+            # entry epoch no longer matches the record is the OLD
+            # process talking about a superseded placement
+            "epoch": epoch,
         }
 
     def owner(self, stream_id: str) -> str | None:
         entry = self._m.get(stream_id)
         return entry["agent"] if entry else None
+
+    def entry(self, stream_id: str) -> dict | None:
+        return self._m.get(stream_id)
 
     def sessions_of(self, agent_id: str) -> list[tuple[str, dict]]:
         """Non-destructive twin of :meth:`pop_agent_sessions` — the
@@ -239,6 +253,7 @@ async def _place_and_proxy(request: web.Request, path: str,
                         app["session_table"].remember(
                             sid, rec.agent_id, room_id, kind,
                             journey_id=journey_id, leg=leg,
+                            epoch=rec.epoch,
                         )
                         if journeys is not None:
                             # the SAME leg number the agent was told in
@@ -368,9 +383,27 @@ async def fleet_events(request):
     if not isinstance(event, dict):
         return web.Response(status=400, text="event must be an object")
     stream_id = str(event.get("stream_id", ""))
-    agent_id = request.app["session_table"].owner(stream_id)
+    entry = request.app["session_table"].entry(stream_id)
+    agent_id = entry["agent"] if entry else None
+    state = str(event.get("state", ""))
+    recycled = (
+        event.get("event") == "StreamDegraded" and state == "AGENT_RECYCLED"
+    )
+    rec = request.app["fleet"].agents.get(agent_id) if agent_id else None
+    if (entry is not None and rec is not None
+            and entry.get("epoch") is not None
+            and entry["epoch"] != rec.epoch and not recycled):
+        # the placement predates the record's current epoch: this webhook
+        # was minted by the process the registry has since superseded
+        # (recycle/revival) — reading it as the NEW process's evidence is
+        # the old-process-ghost shape.  AGENT_RECYCLED is exempt: only
+        # the NEW process ever announces the swap itself.
+        request.app["fleet"].note_stale_epoch()
+        return web.Response(text="OK")
     breach_state = request.app["fleet"].ingest_event(event, agent_id)
     _journey_ingest(request.app, event, stream_id, agent_id, breach_state)
+    if recycled:
+        _recycled_ingest(request.app, event, stream_id, agent_id, entry)
     if event.get("event") == "StreamEnded":
         # the session is gone on the agent — keeping the mapping would
         # send spurious AGENT_DEAD re-points to long-idle clients and
@@ -416,6 +449,48 @@ def _journey_ingest(app, event: dict, stream_id: str,
             _capture_evidence(
                 app, jid, owner, seal_reason=f"breach {breach_state}"
             )
+
+
+def _recycled_ingest(app, event: dict, stream_id: str,
+                     agent_id: str | None, entry: dict | None):
+    """An AGENT_RECYCLED announce from a restart-in-place replacement
+    (server/agent.py ``_import_handoff``): the predecessor's session is
+    parked on the SAME box under the deterministic token
+    ``rcy-<stream-id>``.  Pin the journey's next re-offer there with
+    that token, ring the ``recycled`` kind, re-point the client
+    (AGENT_RECYCLED rides the same StreamDegraded webhook plane as
+    AGENT_DEAD — deliberately NOT a breach: recycling is not an
+    incident), and drop the old placement row (the re-offer mints a
+    fresh stream id)."""
+    journeys: JourneyLog | None = app["journeys"]
+    jid = str(event.get("journey_id") or "")
+    if journeys is not None and not journeys.known(jid):
+        jid = journeys.journey_for_stream(stream_id)
+    owner = agent_id
+    if owner is None and journeys is not None and journeys.known(jid):
+        owner = journeys.last_agent(jid)
+    if journeys is not None and journeys.known(jid):
+        if owner is not None:
+            _remember_bounded(app["migrations"], jid, {
+                "target": owner, "token": f"rcy-{stream_id}",
+                "ts": time.monotonic(),
+            })
+        journeys.note(jid, "recycled", agent=owner or "",
+                      stream_id=stream_id)
+    app["stats"].count("fleet_recycled_sessions")
+    leg = entry.get("leg", 1) if entry else 1
+    room_id = entry.get("room_id", "") if entry else ""
+    app["fleet_events"].handle_session_state(
+        stream_id, room_id, "AGENT_RECYCLED",
+        "agent recycled in place — re-offer through the router to "
+        "resume on the same box",
+        journey=({"journey_id": jid, "leg": leg} if jid else None),
+    )
+    # the replacement parked the session under a NEW adoption token; the
+    # re-offer creates a fresh placement row, so the old one is done
+    # (keeping it would feed spurious AGENT_DEAD re-points later)
+    app["session_table"].forget(stream_id)
+    app["snapshot_bank"].pop(stream_id, None)
 
 
 async def _pull_fragment(app, rec, journey_id: str):
@@ -619,6 +694,17 @@ async def _import_and_repoint(app, sid: str, entry: dict, snapshot: dict,
         jid, "migrated", source=source_id,
         target=target.agent_id, stream_id=sid, reason=reason,
     )
+    # lifecycle-driven moves get their own ring kind on top of the
+    # mechanical "migrated": an operator reading a journey should see
+    # WHY the session moved, not just that it did
+    lifecycle_kind = {"upgrade": "upgraded", "autoscale": "scaled"}.get(
+        reason
+    )
+    if lifecycle_kind is not None:
+        journeys.note(
+            jid, lifecycle_kind, source=source_id,
+            target=target.agent_id, stream_id=sid,
+        )
     # the session moved: its banked export must never crash-restore a
     # SECOND copy if the (now-empty) source dies inside the bank TTL
     app["snapshot_bank"].pop(sid, None)
@@ -653,7 +739,8 @@ def _migrate_failed(app, sid: str, entry: dict, source_id: str,
     return False
 
 
-async def _migrate_session(app, rec, sid: str, entry: dict) -> bool:
+async def _migrate_session(app, rec, sid: str, entry: dict,
+                           reason: str = "drain") -> bool:
     """Move ONE session off a draining agent — export, then the shared
     import/re-point tail.  Every failure is abort-safe: the source keeps
     serving and the kill-drain finishes the job."""
@@ -681,11 +768,12 @@ async def _migrate_session(app, rec, sid: str, entry: dict) -> bool:
         "snapshot": snapshot, "ts": time.monotonic(),
     })
     return await _import_and_repoint(
-        app, sid, entry, snapshot, rec.agent_id, reason="drain"
+        app, sid, entry, snapshot, rec.agent_id, reason=reason
     )
 
 
-async def _run_migrate_drain(app, rec, sessions, gen: int):
+async def _run_migrate_drain(app, rec, sessions, gen: int,
+                             reason: str = "drain"):
     """The drain-as-move sweep: every live session on the draining agent
     is exported, imported on a healthy target and re-pointed — at most
     MIGRATE_MAX_PARALLEL in flight, the whole sweep bounded by
@@ -710,13 +798,16 @@ async def _run_migrate_drain(app, rec, sessions, gen: int):
                 # NEW session leaves under a drain the operator revoked
                 return
             t_sess = time.monotonic()
-            if await _migrate_session(app, rec, sid, entry):
+            if await _migrate_session(app, rec, sid, entry, reason=reason):
                 moved += 1
                 # per-SESSION export-to-re-point latency (the semaphore
                 # queue wait is not migration time)
-                app["migration_ms"].append(
-                    round(1e3 * (time.monotonic() - t_sess), 3)
-                )
+                move_ms = round(1e3 * (time.monotonic() - t_sess), 3)
+                app["migration_ms"].append(move_ms)
+                if reason == "upgrade":
+                    # the rolling-upgrade acceptance metric: how long a
+                    # session was between boxes during a sweep step
+                    app["upgrade_move_ms"].append(move_ms)
 
     try:
         results = await asyncio.wait_for(
@@ -808,8 +899,6 @@ async def fleet_drain(request):
     /fleet/health flips ``recyclable`` at zero.  ``cancel`` reverts both
     sides (in-flight moves finish but no new ones start... their targets'
     unadopted imports expire on their own TTL)."""
-    import aiohttp
-
     app = request.app
     agent_id = request.query.get("agent")
     if not agent_id:
@@ -823,59 +912,89 @@ async def fleet_drain(request):
     mode = request.query.get("mode", "kill")
     if mode not in ("kill", "migrate"):
         return web.Response(status=400, text="mode must be kill|migrate")
-    starting = action == "start"
+    if action == "start" and mode == "migrate":
+        refusal = _migrate_mode_refusal(app)
+        if refusal is not None:
+            return refusal
+    result = await _apply_drain(app, rec, action == "start", mode)
+    return web.json_response(result)
+
+
+def _migrate_mode_refusal(app) -> web.Response | None:
+    """The mode=migrate preconditions shared by /fleet/drain and
+    /fleet/upgrade (the autoscaler's retire path checks the same flags
+    inline — it has no HTTP response to return)."""
+    if not app["migrate_enabled"]:
+        return web.Response(
+            status=409,
+            text="session migration disabled (MIGRATE_ENABLE=0) — "
+                 "drain with mode=kill",
+        )
+    if app["journeys"] is None:
+        # migration rides the journey plane end to end (the pin that
+        # routes the re-offer to the imported state is keyed by
+        # journey id) — without it every "move" would silently
+        # degrade to a fresh re-prime while burning target slots
+        return web.Response(
+            status=409,
+            text="mode=migrate needs the journey plane "
+                 "(JOURNEY_ENABLE=0) — drain with mode=kill",
+        )
+    return None
+
+
+def _start_migrate_sweep(app, rec, reason: str = "drain") -> int:
+    """Begin (or join) a migrate-drain sweep of ``rec``: flip its
+    draining guard, mint the drain generation, spawn the sweep task.
+    Returns how many sessions the sweep will move — 0 when a CURRENT-
+    generation sweep is already active (an operator retry must not spawn
+    a second concurrent sweep over the same sessions).  A SUPERSEDED
+    sweep (cancel bumped the gen) merely finishing its in-flight moves
+    does NOT block a restart — cancel-then-restart must migrate, not
+    silently degrade to kill semantics."""
+    agent_id = rec.agent_id
+    active_sweep = app["migrate_sweeps"].get(agent_id)
+    if active_sweep is not None and active_sweep == app["drain_gen"].get(
+        agent_id
+    ):
+        return 0
+    # no active sweep — this also upgrades a plain kill-drain to
+    # move-not-kill, and re-migrates whatever a timed-out sweep
+    # left behind (the re-assertion is visible as migrating=N)
+    sessions = app["session_table"].sessions_of(agent_id)
+    if sessions:
+        rec.draining = True  # before the sweep: its cancel guard
+        gen = _next_drain_gen(app, agent_id)
+        _remember_bounded(app["migrate_sweeps"], agent_id, gen)
+        task = _spawn_migrate_task(
+            app, _run_migrate_drain(app, rec, sessions, gen, reason=reason)
+        )
+
+        def _sweep_done(_t, a=agent_id, g=gen):
+            # only THIS sweep's registration — a newer sweep that
+            # replaced the entry must not be unregistered by the
+            # old task finishing late
+            if app["migrate_sweeps"].get(a) == g:
+                app["migrate_sweeps"].pop(a, None)
+
+        task.add_done_callback(_sweep_done)
+    return len(sessions)
+
+
+async def _apply_drain(app, rec, starting: bool, mode: str,
+                       reason: str = "drain") -> dict:
+    """The drain transition shared by /fleet/drain, the rolling-upgrade
+    sweep, and the autoscaler's retire path: registry flags + the
+    agent's own admission-freeze rung + (mode=migrate) the drain-as-move
+    sweep.  Validation — agent exists, migrate preconditions hold — is
+    the callers' job."""
+    import aiohttp
+
+    agent_id = rec.agent_id
     was_draining = rec.draining
     migrating = 0
     if starting and mode == "migrate":
-        if not app["migrate_enabled"]:
-            return web.Response(
-                status=409,
-                text="session migration disabled (MIGRATE_ENABLE=0) — "
-                     "drain with mode=kill",
-            )
-        if app["journeys"] is None:
-            # migration rides the journey plane end to end (the pin that
-            # routes the re-offer to the imported state is keyed by
-            # journey id) — without it every "move" would silently
-            # degrade to a fresh re-prime while burning target slots
-            return web.Response(
-                status=409,
-                text="mode=migrate needs the journey plane "
-                     "(JOURNEY_ENABLE=0) — drain with mode=kill",
-            )
-        active_sweep = app["migrate_sweeps"].get(agent_id)
-        if active_sweep is not None and active_sweep == app[
-            "drain_gen"
-        ].get(agent_id):
-            # a CURRENT-generation sweep is active: an operator retry
-            # must not spawn a second concurrent one over the same
-            # sessions.  A SUPERSEDED sweep (cancel bumped the gen)
-            # merely finishing its in-flight moves does NOT block a
-            # restart — cancel-then-restart must migrate, not silently
-            # degrade to kill semantics.
-            sessions = []
-        else:
-            # no active sweep — this also upgrades a plain kill-drain to
-            # move-not-kill, and re-migrates whatever a timed-out sweep
-            # left behind (the re-assertion is visible as migrating=N)
-            sessions = app["session_table"].sessions_of(agent_id)
-        migrating = len(sessions)
-        if sessions:
-            rec.draining = True  # before the sweep: its cancel guard
-            gen = _next_drain_gen(app, agent_id)
-            _remember_bounded(app["migrate_sweeps"], agent_id, gen)
-            task = _spawn_migrate_task(
-                app, _run_migrate_drain(app, rec, sessions, gen)
-            )
-
-            def _sweep_done(_t, a=agent_id, g=gen):
-                # only THIS sweep's registration — a newer sweep that
-                # replaced the entry must not be unregistered by the
-                # old task finishing late
-                if app["migrate_sweeps"].get(a) == g:
-                    app["migrate_sweeps"].pop(a, None)
-
-            task.add_done_callback(_sweep_done)
+        migrating = _start_migrate_sweep(app, rec, reason)
     if starting and not was_draining:
         app["stats"].count("fleet_drains")
     if not starting:
@@ -905,7 +1024,7 @@ async def fleet_drain(request):
             agent_ack = resp.status == 200
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
         logger.warning("drain call to %s failed: %s", agent_id, e)
-    return web.json_response({
+    return {
         "agent": agent_id,
         "draining": rec.draining,
         "recyclable": rec.recyclable,
@@ -913,7 +1032,252 @@ async def fleet_drain(request):
         "agent_ack": agent_ack,
         "mode": mode if starting else "cancel",
         "migrating": migrating,
+    }
+
+
+async def fleet_upgrade(request):
+    """POST /fleet/upgrade?action=start|cancel — rolling restart-in-place
+    of the whole fleet, one agent at a time (ISSUE 16): drain-as-move →
+    ``/admin/recycle`` → wait for the replacement to re-register at a
+    bumped epoch and pass the prewarm probe → next agent.  Any step's
+    failure HALTS the sweep with the current agent un-drained and
+    serving; ``cancel`` aborts between (and within) steps the same way.
+    Status rides /fleet/health under ``upgrade``."""
+    app = request.app
+    action = request.query.get("action", "start")
+    if action not in ("start", "cancel"):
+        return web.Response(status=400, text="action must be start|cancel")
+    up = app["upgrade"]
+    if action == "cancel":
+        if up["active"]:
+            up["cancel"] = True
+            current = up.get("current")
+            if current:
+                # abort-safe: supersede the in-flight target's sweep so
+                # queued moves die at the generation guard (PR 15
+                # drain-generation discipline), exactly like
+                # /fleet/drain?action=cancel
+                _next_drain_gen(app, current)
+        return web.json_response(dict(up))
+    if up["active"]:
+        return web.Response(status=409, text="upgrade already in progress")
+    refusal = _migrate_mode_refusal(app)
+    if refusal is not None:
+        return refusal
+    reg: FleetRegistry = app["fleet"]
+    targets = [aid for aid, rec in reg.agents.items() if rec.state != "DEAD"]
+    if not targets:
+        return web.Response(status=409, text="no live agents to upgrade")
+    up.update({
+        "active": True, "cancel": False, "current": None,
+        "done": [], "halted": None, "total": len(targets),
     })
+    _spawn_migrate_task(app, _run_upgrade(app, targets))
+    return web.json_response(dict(up), status=202)
+
+
+async def _run_upgrade(app, targets: list):
+    """The sweep driver: strictly one agent in flight at a time —
+    upgrading two at once halves serving capacity mid-sweep and can
+    strand the fleet if both replacements fail."""
+    up = app["upgrade"]
+    reg: FleetRegistry = app["fleet"]
+    try:
+        for agent_id in targets:
+            if up["cancel"]:
+                up["halted"] = "cancelled"
+                return
+            rec = reg.agents.get(agent_id)
+            if rec is None or rec.state == "DEAD":
+                # the crash path (AGENT_DEAD → crash-restore) owns this
+                # one; the sweep must not fight it
+                continue
+            up["current"] = agent_id
+            ok, why = await _upgrade_one(app, rec)
+            if not ok:
+                up["halted"] = f"{agent_id}: {why}"
+                app["stats"].count("fleet_upgrade_halts")
+                logger.warning("upgrade halted at %s: %s", agent_id, why)
+                return
+            up["done"].append(agent_id)
+        app["stats"].count("fleet_upgrades")
+        logger.info("rolling upgrade complete: %d agents", len(up["done"]))
+    finally:
+        up["active"] = False
+        up["current"] = None
+
+
+async def _upgrade_one(app, rec) -> tuple:
+    """One agent through the sweep: drain-to-zero (as moves), recycle,
+    wait for the higher-epoch replacement to prove itself.  Returns
+    (ok, why); every failure path leaves the OLD agent un-drained and
+    serving — a halted sweep never shrinks the fleet."""
+    up = app["upgrade"]
+    reg: FleetRegistry = app["fleet"]
+    agent_id = rec.agent_id
+    old_epoch = rec.epoch
+
+    async def _undrain():
+        if reg.agents.get(agent_id) is rec and rec.state != "DEAD":
+            await _apply_drain(app, rec, False, "kill", reason="upgrade")
+
+    await _apply_drain(app, rec, True, "migrate", reason="upgrade")
+    deadline = time.monotonic() + app["upgrade_step_timeout_s"]
+    while True:
+        if up["cancel"]:
+            await _undrain()
+            return False, "cancelled"
+        if reg.agents.get(agent_id) is not rec or rec.state == "DEAD":
+            # crash-restore owns its sessions now; halt rather than
+            # recycle a corpse
+            return False, "agent died mid-drain (crash-restore owns its sessions)"
+        if (
+            not app["session_table"].sessions_of(agent_id)
+            and rec.live_sessions == 0
+            # polled evidence only — live_sessions defaults to 0 before
+            # the first /health read, and recycling on that default
+            # would hard-drop whatever the box is actually serving
+            and rec.last_ok is not None
+            and app["migrate_sweeps"].get(agent_id) is None
+        ):
+            break
+        if time.monotonic() >= deadline:
+            await _undrain()
+            return False, "drain-to-zero timed out"
+        await asyncio.sleep(0.1)
+    _body, err = await _migrate_call(
+        app, "POST", rec, "/admin/recycle", json_body={"respawn": True}
+    )
+    if err is not None:
+        await _undrain()
+        return False, f"recycle refused: {err}"
+    # the old process is gone (or going); wait for the replacement to
+    # re-register at a bumped epoch AND answer the prewarm probe before
+    # moving on — a 200 /health from it means the handoff import already
+    # ran (on_startup precedes the socket bind)
+    deadline = time.monotonic() + app["upgrade_step_timeout_s"]
+    while True:
+        if up["cancel"]:
+            return False, "cancelled"
+        new_rec = reg.agents.get(agent_id)
+        if (
+            new_rec is not None and new_rec is not rec
+            and new_rec.epoch > old_epoch and new_rec.state != "DEAD"
+        ):
+            if await _prewarm_probe(app, new_rec):
+                return True, ""
+        if time.monotonic() >= deadline:
+            return False, "replacement never re-registered/prewarmed"
+        await asyncio.sleep(0.1)
+
+
+async def _prewarm_probe(app, rec) -> bool:
+    """Replacement readiness beyond registration: /health answers 200 AND
+    /capacity returns a coherent JSON body whose boot_id matches what the
+    record registered with (a stale old-process socket answering the
+    address must not pass the new process's gate)."""
+    import aiohttp
+
+    try:
+        async with app["http"].get(rec.base_url + "/health") as resp:
+            if resp.status != 200:
+                return False
+            await resp.read()
+        async with app["http"].get(rec.base_url + "/capacity") as resp:
+            if resp.status != 200:
+                return False
+            cap = await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError, ValueError):
+        return False
+    if not isinstance(cap, dict):
+        return False
+    bid = str(cap.get("boot_id") or "")
+    if rec.boot_id and bid and bid != rec.boot_id:
+        return False
+    return True
+
+
+def _default_autoscale_spawn() -> bool:
+    """Scale-up backend: fire AUTOSCALE_EXEC_HOOK (sync — the loop pushes
+    this off-thread).  The new box proves itself by registering."""
+    from ..server import lifecycle
+
+    return lifecycle.run_exec_hook(env.get_str("AUTOSCALE_EXEC_HOOK"))
+
+
+async def _run_retire(app, rec):
+    """Scale-down: migrate-drain the emptiest agent to zero, then recycle
+    it WITHOUT respawn and forget it.  Zero session loss by construction:
+    if the drain can't reach zero inside the step timeout the retire is
+    abandoned and the agent un-drained — the fleet never shrinks by
+    dropping a session."""
+    reg: FleetRegistry = app["fleet"]
+    agent_id = rec.agent_id
+    await _apply_drain(app, rec, True, "migrate", reason="autoscale")
+    deadline = time.monotonic() + app["upgrade_step_timeout_s"]
+    while True:
+        if reg.agents.get(agent_id) is not rec or rec.state == "DEAD":
+            return  # crash path owns it now
+        if (
+            not app["session_table"].sessions_of(agent_id)
+            and rec.live_sessions == 0
+            and rec.last_ok is not None
+            and app["migrate_sweeps"].get(agent_id) is None
+        ):
+            break
+        if time.monotonic() >= deadline:
+            logger.warning(
+                "autoscale retire of %s abandoned: drain-to-zero timed out",
+                agent_id,
+            )
+            await _apply_drain(app, rec, False, "kill", reason="autoscale")
+            return
+        await asyncio.sleep(0.1)
+    _body, err = await _migrate_call(
+        app, "POST", rec, "/admin/recycle", json_body={"respawn": False}
+    )
+    if err is not None:
+        # proceed anyway: the agent is drained and empty; if it lingers
+        # it just re-registers and the controller re-evaluates
+        logger.warning("retire recycle of %s failed: %s", agent_id, err)
+    reg.remove(agent_id)
+    app["stats"].count("autoscale_retires")
+    logger.info("autoscale retired %s", agent_id)
+
+
+async def _autoscale_loop(app):
+    """The demand controller's clock: fold fleet-wide pressure into the
+    EWMA each tick and execute the (rare, hysteresis- and cooldown-gated)
+    spawn/retire decisions."""
+    ctl = app["autoscale"]
+    try:
+        while True:
+            await asyncio.sleep(app["autoscale_tick_s"])
+            try:
+                rejects = int(
+                    app["stats"].snapshot().get("fleet_rejects_total", 0) or 0
+                )
+                decision = ctl.tick(rejects)
+                if decision == "up":
+                    ok = await asyncio.to_thread(app["autoscale_spawn"])
+                    if ok:
+                        app["stats"].count("autoscale_spawns")
+                    else:
+                        logger.warning(
+                            "autoscale wanted to spawn but no backend "
+                            "succeeded (AUTOSCALE_EXEC_HOOK unset?)"
+                        )
+                elif decision == "down":
+                    rec = ctl.retire_candidate()
+                    if (
+                        rec is not None and app["migrate_enabled"]
+                        and app["journeys"] is not None
+                    ):
+                        _spawn_migrate_task(app, _run_retire(app, rec))
+            except Exception:
+                logger.exception("autoscale tick failed")
+    except asyncio.CancelledError:
+        pass
 
 
 async def fleet_health(request):
@@ -930,6 +1294,7 @@ async def fleet_health(request):
         "status": worst,
         "agents": agents,
         "sessions_tracked": len(request.app["session_table"]),
+        "upgrade": dict(request.app["upgrade"]),
     })
 
 
@@ -1104,6 +1469,17 @@ async def metrics(request):
         n = len(samples)
         out["migration_ms_p50"] = round(samples[n // 2], 3)
         out["migration_ms_p99"] = round(samples[min(n - 1, int(n * 0.99))], 3)
+    # rolling-upgrade move latency (the subset of migrations driven by
+    # /fleet/upgrade — the zero-downtime SLO the upgrade bench fences)
+    moves = sorted(app["upgrade_move_ms"])
+    if moves:
+        n = len(moves)
+        out["upgrade_session_move_ms_p50"] = round(moves[n // 2], 3)
+        out["upgrade_session_move_ms_p99"] = round(
+            moves[min(n - 1, int(n * 0.99))], 3
+        )
+    if app["autoscale"].enabled:
+        out.update(app["autoscale"].snapshot())
     if app["journeys"] is not None:
         # journey rollup (fleet/journey.py): aggregate counters + the
         # placement→first-frame percentiles — the journey id itself is
@@ -1185,12 +1561,22 @@ async def _on_startup(app):
     if app["poll"]:
         app["poller"] = FleetPoller(app["fleet"])
         await app["poller"].start()
+    if app["poll"] and app["autoscale"].enabled:
+        # demand controller rides the same liveness plane as the poller:
+        # no poll, no trustworthy pressure signal, no autoscaling
+        app["autoscale_task"] = asyncio.get_running_loop().create_task(
+            _autoscale_loop(app)
+        )
 
 
 async def _on_cleanup(app):
     poller = app.get("poller")
     if poller is not None:
         await poller.stop()
+    auto = app.get("autoscale_task")
+    if auto is not None:
+        auto.cancel()
+        await asyncio.gather(auto, return_exceptions=True)
     # cancel pending evidence pulls + migration sweeps BEFORE closing
     # their shared session — a queued task touching a closed
     # ClientSession dies with an unretrieved RuntimeError instead of a
@@ -1249,6 +1635,20 @@ def build_router_app(
     app["migrate_sweeps"] = {}  # agent_id -> gen of its ACTIVE sweep task
     app["migrate_tasks"] = set()
     app["migration_ms"] = collections.deque(maxlen=512)
+    # fleet lifecycle (docs/fleet.md "Rolling upgrades & autoscaling"):
+    # the one-at-a-time upgrade sweep's status block + the move-latency
+    # ring the upgrade bench fences, and the demand controller
+    app["upgrade"] = {
+        "active": False, "cancel": False, "current": None,
+        "done": [], "halted": None, "total": 0,
+    }
+    app["upgrade_step_timeout_s"] = env.get_float(
+        "UPGRADE_STEP_TIMEOUT_S", 60.0
+    )
+    app["upgrade_move_ms"] = collections.deque(maxlen=512)
+    app["autoscale"] = AutoscaleController(app["fleet"])
+    app["autoscale_tick_s"] = env.get_float("AUTOSCALE_TICK_S", 1.0)
+    app["autoscale_spawn"] = _default_autoscale_spawn
     app["fleet"].on_dead = _on_agent_dead(app)
 
     app.on_startup.append(_on_startup)
@@ -1262,6 +1662,7 @@ def build_router_app(
     app.router.add_post("/fleet/register", fleet_register)
     app.router.add_post("/fleet/events", fleet_events)
     app.router.add_post("/fleet/drain", fleet_drain)
+    app.router.add_post("/fleet/upgrade", fleet_upgrade)
     app.router.add_get("/fleet/health", fleet_health)
     app.router.add_get("/fleet/debug/journeys", journey_index)
     app.router.add_get("/fleet/debug/journey/{id}", journey_bundle)

@@ -8,7 +8,11 @@ TX/RX paths (ISSUE 2):
 * ``BatchSender`` flushes a whole frame's packet batch in one call —
   ``sendmmsg(2)`` through ctypes where the libc has it (one syscall per
   frame), a tight non-blocking ``sock.sendto`` loop otherwise.  The
-  mmsghdr/iovec scaffolding is allocated once and reused every frame.
+  mmsghdr/iovec scaffolding is allocated once and reused every frame;
+  MTU-sized packets are staged through a contiguous copy pool whose
+  iovec base pointers are precomputed, so the per-frame cost is slot
+  memcpys plus one ``struct.pack_into`` per packet — per-packet ctypes
+  object churn only ever pays for oversized/exotic buffers.
 * ``DatagramDrain`` empties every ready datagram from a non-blocking
   socket into a rotating pool of preallocated buffers (``recvfrom_into``
   — no per-packet payload allocation), so the asyncio loop pays one
@@ -27,6 +31,7 @@ import errno
 import logging
 import os
 import socket
+import struct
 
 from ..utils import env
 
@@ -70,6 +75,22 @@ class _msghdr(ctypes.Structure):
 
 class _mmsghdr(ctypes.Structure):
     _fields_ = [("msg_hdr", _msghdr), ("msg_len", ctypes.c_uint)]
+
+
+# per-packet staging slot in BatchSender's contiguous copy pool — covers
+# MTU-sized media datagrams; anything larger rides the zero-copy pin path
+_POOL_SLOT = 2048
+_IOV_SIZE = ctypes.sizeof(_iovec)
+# iovec is {void *iov_base; size_t iov_len} — two native words, written
+# as indexed stores into a "Q"-cast view of the array's buffer (ctypes
+# attribute assignment goes through descriptor machinery that costs ~1µs
+# per field; a cast-memoryview store is an order of magnitude cheaper).
+# Only when the native word layout matches the ctypes one (any sane LP64
+# libc; a mismatch silently disables the copy-pool path, never corrupts)
+_FAST_IOV = (
+    struct.calcsize("QQ") == _IOV_SIZE
+    and ctypes.sizeof(ctypes.c_void_p) == 8
+)
 
 
 class _sockaddr_in(ctypes.Structure):
@@ -126,6 +147,7 @@ class BatchSender:
         if use_sendmmsg is None:
             use_sendmmsg = env.get_bool("HOST_PLANE_SENDMMSG", True)
         self._enabled = bool(use_sendmmsg) and sendmmsg_fn() is not None
+        self._fn = sendmmsg_fn() if self._enabled else None
         self._cap = 0
         self._hdrs = None
         self._iovs = None
@@ -133,6 +155,19 @@ class BatchSender:
         self._mhdr_list: list = []  # access materializes a new object —
         self._cap_addr = None  # cache them once per growth, not per frame)
         self._sa = _sockaddr_in()
+        # contiguous copy pool backing the fast path: iov_base targets are
+        # stable slot addresses, so a frame's flush is slot memcpys + one
+        # (base, len) pack per packet instead of per-packet ctypes objects
+        self._pool_ref = None  # keeps the from_buffer export alive
+        self._pool_base = 0
+        self._pool_mv: memoryview | None = None
+        self._iov_mv: memoryview | None = None
+        self._hdr0_ref = None  # byref(hdrs[0]), cached per growth
+        self._last_addr = None  # (host, port) the sockaddr currently holds
+        self._sa_ptr = ctypes.cast(
+            ctypes.byref(self._sa), ctypes.c_void_p
+        ).value  # stable for the object's lifetime
+        self._sa_len = ctypes.sizeof(self._sa)
 
     def _ensure(self, n: int, name_ptr, name_len) -> None:
         if n <= self._cap and name_ptr == self._cap_addr:
@@ -146,12 +181,46 @@ class BatchSender:
             for i, mh in enumerate(self._mhdr_list):
                 mh.msg_iov = ctypes.pointer(self._iov_list[i])
                 mh.msg_iovlen = 1
+            if _FAST_IOV:
+                pool = bytearray(cap * _POOL_SLOT)
+                self._pool_ref = (ctypes.c_char * len(pool)).from_buffer(pool)
+                self._pool_base = ctypes.addressof(self._pool_ref)
+                self._pool_mv = memoryview(pool)
+                self._iov_mv = memoryview(
+                    (ctypes.c_char * (cap * _IOV_SIZE)).from_buffer(self._iovs)
+                ).cast("B").cast("Q")
+            self._hdr0_ref = ctypes.byref(self._hdrs[0])
             self._cap = cap
         # destination rarely changes per sender: write msg_name once
         for mh in self._mhdr_list:
             mh.msg_name = name_ptr
             mh.msg_namelen = name_len
         self._cap_addr = name_ptr
+
+    def _fill_pool(self, pkts) -> bool:
+        """Fast-path frame staging: copy every packet into its pool slot
+        and pack its iovec in place.  False when any packet outgrows the
+        slot (caller falls back to the pin path for the whole frame — the
+        iovecs written so far are fully overwritten there)."""
+        if self._pool_mv is None:
+            return False
+        pool_mv, iov_mv, base = self._pool_mv, self._iov_mv, self._pool_base
+        slot = _POOL_SLOT
+        off = 0
+        q = 0  # word index into the "Q"-cast iovec view: 2 per entry
+        try:
+            for pkt in pkts:
+                ln = len(pkt)
+                if ln > slot:
+                    return False
+                pool_mv[off:off + ln] = pkt
+                iov_mv[q] = base + off
+                iov_mv[q + 1] = ln
+                off += slot
+                q += 2
+        except (TypeError, ValueError):  # non-contiguous/exotic buffer
+            return False
+        return True
 
     @staticmethod
     def _pin(pkt, refs):
@@ -173,37 +242,48 @@ class BatchSender:
         n = len(pkts)
         if n == 0:
             return 0
-        fn = sendmmsg_fn() if self._enabled else None
+        fn = self._fn
         if fn is None:
             return self._loop_send(sock, pkts, addr, fallback)
         name_ptr, name_len = None, 0
         if addr is not None:
-            try:
-                packed = socket.inet_aton(addr[0])
-            except OSError:
-                # non-IPv4 destination: the tight loop handles it
-                return self._loop_send(sock, pkts, addr, fallback)
-            sa = self._sa
-            sa.sin_family = socket.AF_INET
-            sa.sin_port = socket.htons(addr[1])
-            ctypes.memmove(sa.sin_addr, packed, 4)
+            if addr != self._last_addr:  # sockaddr reused until it changes
+                try:
+                    packed = socket.inet_aton(addr[0])
+                except OSError:
+                    # non-IPv4 destination: the tight loop handles it
+                    return self._loop_send(sock, pkts, addr, fallback)
+                sa = self._sa
+                sa.sin_family = socket.AF_INET
+                sa.sin_port = socket.htons(addr[1])
+                ctypes.memmove(sa.sin_addr, packed, 4)
+                self._last_addr = addr if isinstance(addr, tuple) else None
             # the struct is reused in place, so a changed addr needs no
             # msg_name rewrite — the pointer is stable
-            name_ptr = ctypes.cast(ctypes.byref(sa), ctypes.c_void_p).value
-            name_len = ctypes.sizeof(sa)
+            name_ptr = self._sa_ptr
+            name_len = self._sa_len
         self._ensure(n, name_ptr, name_len)
         refs: list = []
-        pin = self._pin
-        iovs = self._iov_list
-        for i, pkt in enumerate(pkts):
-            base, ln = pin(pkt, refs)
-            iov = iovs[i]
-            iov.iov_base = base
-            iov.iov_len = ln
+        if not self._fill_pool(pkts):
+            # oversized datagram (or exotic struct layout): the zero-copy
+            # pin path handles arbitrary sizes at per-packet ctypes cost
+            pin = self._pin
+            iovs = self._iov_list
+            for i, pkt in enumerate(pkts):
+                base, ln = pin(pkt, refs)
+                iov = iovs[i]
+                iov.iov_base = base
+                iov.iov_len = ln
         fd = sock.fileno()
         sent = 0
         while sent < n:
-            r = fn(fd, ctypes.byref(self._hdrs[sent]), n - sent, 0)
+            r = fn(
+                fd,
+                self._hdr0_ref if sent == 0
+                else ctypes.byref(self._hdrs[sent]),
+                n - sent,
+                0,
+            )
             if r < 0:
                 e = ctypes.get_errno()
                 if e == errno.EINTR:

@@ -171,6 +171,10 @@ def _supervise_session(app, pc, pipeline, session_key: str, room_id: str = ""):
     sup = SessionSupervisor(
         session_key, resync=resync, on_transition=on_transition
     )
+    # the recycle handoff's AGENT_RECYCLED re-announce needs each
+    # session's room — the supervisor context is the one per-session
+    # home every serving path already fills
+    sup.context["room_id"] = room_id
     jmeta = _journey_of(app, session_key)
     if jmeta is not None:
         # /health shows which journey this session is a leg of
@@ -685,6 +689,220 @@ async def migrate_import(request):
 
 
 # ---------------------------------------------------------------------------
+# restart-in-place (ISSUE 16, docs/fleet.md "Rolling upgrades"): export
+# every live session into a handoff file, respawn, exit; the replacement
+# adopts the handoff during startup — before its socket binds
+# ---------------------------------------------------------------------------
+
+
+async def _export_all_sessions(app) -> list:
+    """Every live session as a handoff entry: the migration snapshot
+    (scheduler state when the tier has it, control-plane otherwise) plus
+    the journey binding and room — everything the replacement needs to
+    park the session and re-announce it."""
+    sched = app.get("batch_scheduler")
+    sups = app.get("supervisors", {})
+    out = []
+    for sid in list(sups):
+        snap = None
+        if (
+            sched is not None
+            and hasattr(sched, "snapshot_session")
+            and getattr(sched, "session", lambda _k: None)(sid) is not None
+        ):
+            try:
+                snap = await asyncio.to_thread(sched.snapshot_session, sid)
+                snap.setdefault("kind", "scheduler")
+                snap["session"] = sid
+            except KeyError:
+                snap = None  # released mid-export: nothing left to move
+        if snap is None:
+            snap = {
+                "schema": _CONTROL_SNAPSHOT_SCHEMA,
+                "kind": "control-plane",
+                "session": sid,
+            }
+        sup = sups.get(sid)
+        out.append({
+            "session": sid,
+            "snapshot": snap,
+            "journey": _journey_of(app, sid),
+            "room_id": (
+                str(sup.context.get("room_id") or "")
+                if sup is not None and hasattr(sup, "context") else ""
+            ),
+        })
+    return out
+
+
+def _spawn_recycle_exit(app, respawn: bool, handoff: str):
+    """Background exit for a 202'd recycle: give the response (and any
+    in-flight webhook posts) a beat to flush, spawn the replacement off
+    the loop, then hard-exit — the replacement retry-binds the freed
+    port.  Strong-ref'd + reaped like every background task."""
+    from . import lifecycle
+
+    async def run():
+        await asyncio.sleep(env.get_float("RECYCLE_EXIT_DELAY_S", 0.2))
+        ok = True
+        if respawn:
+            ok = await asyncio.to_thread(lifecycle.spawn_replacement, handoff)
+        if not ok:
+            # no backend could spawn: aborting beats exiting into a hole
+            # — the sessions keep serving HERE and the sweep's prewarm
+            # wait times out cleanly on the router side
+            logger.error("recycle aborted: replacement spawn failed")
+            app["recycling"] = False
+            return
+        logger.info(
+            "recycling: exiting (respawn=%s, handoff=%s)", respawn, handoff
+        )
+        lifecycle.exit_process(0)
+
+    tasks = app.setdefault("recycle_tasks", set())
+    task = asyncio.get_running_loop().create_task(run())
+    tasks.add(task)
+    task.add_done_callback(tasks.discard)
+
+
+async def admin_recycle(request):
+    """``POST /admin/recycle {"respawn": true|false}``: restart (or
+    retire) this agent process in place.  Every live session is exported
+    through the migration snapshot path into a handoff file; the
+    replacement — spawned via ``RECYCLE_EXEC_HOOK`` or argv re-exec —
+    imports them during its startup, BEFORE its socket binds (so a 200
+    ``/health`` from the new process means the sessions are already
+    parked: that ordering is the upgrade sweep's prewarm gate), and
+    announces each with an AGENT_RECYCLED webhook that sends the client
+    back through the router as journey leg+1 on the SAME box.  Responds
+    202 immediately; the exit happens a beat later so the response
+    leaves first.  ``respawn: false`` (the autoscaler's retire path)
+    skips the spawn — the sessions were drained away already and the
+    process just exits."""
+    app = request.app
+    if not env.get_bool("RECYCLE_ENABLE", True):
+        return _debug_error(404, "recycle disabled (RECYCLE_ENABLE=0)")
+    if app.get("recycling"):
+        return _debug_error(409, "recycle already in progress")
+    try:
+        body = await request.json()
+    except (ValueError, LookupError):
+        body = {}
+    respawn = (
+        bool(body.get("respawn", True)) if isinstance(body, dict) else True
+    )
+    from . import lifecycle
+
+    app["recycling"] = True
+    sessions = await _export_all_sessions(app)
+    path = lifecycle.handoff_path()
+    if respawn:
+        handler = app.get("stream_event_handler")
+        meta = {
+            "worker_id": env.get_str("WORKER_ID") or "",
+            # webhook config survives the swap: in fleet tests it was set
+            # at runtime (/_test/webhook), and the replacement's
+            # AGENT_RECYCLED announces are the whole point of the handoff
+            "webhook": {
+                "url": getattr(handler, "webhook_url", None),
+                "token": getattr(handler, "token", None),
+            },
+        }
+        await asyncio.to_thread(lifecycle.write_handoff, path, sessions, meta)
+    _spawn_recycle_exit(app, respawn, path)
+    app["stats"].count("recycles")
+    return web.json_response(
+        {
+            "recycling": True,
+            "respawn": respawn,
+            "sessions": len(sessions),
+            "handoff": path if respawn else None,
+        },
+        status=202,
+    )
+
+
+async def _import_handoff(app):
+    """Recycled-replacement startup: adopt the predecessor's handoff
+    (``RECYCLE_HANDOFF``).  Every exported session takes a counted
+    admission reservation and parks exactly like a ``/migrate/import``
+    under the deterministic token ``rcy-<stream-id>`` (the router
+    self-constructs the same token from the AGENT_RECYCLED webhook and
+    pins the client's re-offer HERE with it); an AGENT_RECYCLED webhook
+    then sends each client back through the router.  Runs as the LAST
+    on_startup hook — after the serving planes exist, still before the
+    socket binds.  The file is consumed whatever happens: a crash loop
+    must not re-adopt a stale generation forever."""
+    path = env.get_str("RECYCLE_HANDOFF")
+    if not path or not os.path.exists(path):
+        return
+    from . import lifecycle
+
+    data = await asyncio.to_thread(lifecycle.read_handoff, path)
+    await asyncio.to_thread(lifecycle.consume_handoff, path)
+    if data is None:
+        logger.warning("recycle handoff at %s unreadable — booting clean",
+                       path)
+        return
+    handler: StreamEventHandler = app["stream_event_handler"]
+    webhook = data.get("webhook")
+    if isinstance(webhook, dict):
+        if handler.webhook_url is None and webhook.get("url"):
+            handler.webhook_url = webhook["url"]
+            handler.token = webhook.get("token")
+    sched = app.get("batch_scheduler")
+    restored = 0
+    for entry in data.get("sessions", ()):
+        if not isinstance(entry, dict):
+            continue
+        sid = str(entry.get("session") or "")
+        snap = entry.get("snapshot")
+        if not sid or not isinstance(snap, dict):
+            continue
+        token = f"rcy-{sid}"
+        rejected = _admission_gate(app, token)
+        if rejected is not None:
+            logger.warning("handoff session %s refused at admission", sid)
+            continue
+        sess = None
+        if (snap.get("kind") == "scheduler" and sched is not None
+                and hasattr(sched, "restore_session")):
+            from ..stream.scheduler import SnapshotMismatch
+            from .multipeer_serving import CapacityError
+
+            try:
+                sess = await asyncio.to_thread(
+                    sched.restore_session, snap, token
+                )
+            except (SnapshotMismatch, CapacityError) as e:
+                _release_admission(app, token)
+                logger.warning("handoff restore of %s refused: %s", sid, e)
+                continue
+        app.setdefault("imported_sessions", {})[token] = {
+            "session": sess, "ts": time.monotonic(),
+        }
+        asyncio.get_running_loop().call_later(
+            _IMPORTED_TTL_S + 1.0, _expire_imported, app, token
+        )
+        jmeta = entry.get("journey")
+        journey = (
+            jmeta if isinstance(jmeta, dict) and jmeta.get("journey_id")
+            else None
+        )
+        handler.handle_session_state(
+            sid, str(entry.get("room_id") or ""), "AGENT_RECYCLED",
+            "agent recycled in place — re-offer through the router to "
+            "resume on the same box",
+            journey=journey,
+        )
+        restored += 1
+        app["stats"].count("recycle_imports")
+    if restored:
+        logger.info("recycle handoff adopted: %d session(s) parked",
+                    restored)
+
+
+# ---------------------------------------------------------------------------
 # endpoints
 # ---------------------------------------------------------------------------
 
@@ -1196,11 +1414,17 @@ async def capacity(request):
                 "capacity": free if free is not None else -1,
                 "saturated": free == 0,
                 "retry_after_s": 0.0,
+                "boot_id": app.get("boot_id", ""),
             }
         )
     # plane-level view: counts live ladders PLUS in-flight admission
     # reservations, so a burst of half-set-up offers is not double-sold
-    return web.json_response(ov.capacity(free_slots=free))
+    body = ov.capacity(free_slots=free)
+    # the process nonce rides the capacity feed: the worker publishes it
+    # and the registry bumps the agent's epoch when it changes (a
+    # recycled replacement on the same address is a NEW process)
+    body["boot_id"] = app.get("boot_id", "")
+    return web.json_response(body)
 
 
 async def drain(request):
@@ -1905,8 +2129,16 @@ def build_app(
     # re-offer adopts them (X-Migrated-Session); TTL'd with their
     # admission reservations
     app["imported_sessions"] = {}
+    # per-process nonce: rides /capacity so the fleet registry can tell
+    # a recycled replacement from the process it replaced (epoch bump)
+    app["boot_id"] = uuid.uuid4().hex[:12]
+    app["recycling"] = False
 
     app.on_startup.append(on_startup)
+    # handoff adoption runs LAST in startup — planes exist, socket not
+    # yet bound: a replacement that answers /health has already parked
+    # its predecessor's sessions (the upgrade sweep's prewarm gate)
+    app.on_startup.append(_import_handoff)
     app.on_shutdown.append(on_shutdown)
 
     app.router.add_post("/whip", whip)
@@ -1923,6 +2155,7 @@ def build_app(
     app.router.add_post("/drain", drain)
     app.router.add_get("/migrate/export", migrate_export)
     app.router.add_post("/migrate/import", migrate_import)
+    app.router.add_post("/admin/recycle", admin_recycle)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/debug/trace", debug_trace)

@@ -185,6 +185,10 @@ def handler(
         # remaining capacity, not a boolean: -1 = no structural bound
         info["capacity"] = cap.get("capacity")
         info["saturated"] = bool(cap.get("saturated", False))
+    if cap is not None and cap.get("boot_id"):
+        # the agent's process nonce: the registry bumps the epoch when it
+        # changes (restart-in-place recycle behind the same address)
+        info["boot_id"] = str(cap["boot_id"])
     ok = publish(info)
     if ok is False:  # None (no return value) counts as success
         return 2
@@ -195,7 +199,9 @@ def handler(
         sleep(keep_alive)
         return 0
     t_end = clock() + keep_alive
-    last = (info.get("capacity"), info.get("saturated"))
+    last = (
+        info.get("capacity"), info.get("saturated"), info.get("boot_id")
+    )
     while True:
         remaining = t_end - clock()
         if remaining <= 0:
@@ -206,11 +212,18 @@ def handler(
         cap = fetch_capacity(cap_url)
         if cap is None or "capacity" not in cap:
             continue  # agent drowning or endpoint-less: keep the lease
-        cur = (cap.get("capacity"), bool(cap.get("saturated", False)))
+        # boot_id joins the change detector: a recycled agent behind the
+        # same port must republish so the registry can bump its epoch
+        cur = (
+            cap.get("capacity"), bool(cap.get("saturated", False)),
+            str(cap["boot_id"]) if cap.get("boot_id") else info.get("boot_id"),
+        )
         if cur == last:
             continue
         update = dict(info)
-        update["capacity"], update["saturated"] = cur
+        update["capacity"], update["saturated"] = cur[0], cur[1]
+        if cur[2]:
+            update["boot_id"] = cur[2]
         if publish(update) is not False:
             last = cur  # a failed republish retries on the next change
     return 0

@@ -82,6 +82,12 @@ DEFAULT_METRIC_TOLERANCES = {
     # what it catches is the hop going pathological (per-request agent
     # scans, body re-copies), which reads as multiples, not percents
     "fleet_router_offer_overhead_ms": 1.0,
+    # rolling-upgrade session move (ISSUE 16): export → import →
+    # re-point p50 between two loopback agents — like the router hop, a
+    # few-ms absolute number on a contended box, so the fence is wide;
+    # what it catches is the move window going pathological (snapshot
+    # re-copies, serialized sweeps), which reads as multiples
+    "upgrade_session_move_ms": 1.0,
     # mesh-sharded scheduler (ISSUE 12): on the CPU tier 8 virtual
     # devices oversubscribe a 2-core host, so the banked ratio is ~0.13x
     # and prices only the sharded dispatch machinery (partitioned

@@ -1,0 +1,200 @@
+"""Rolling-upgrade session-move latency: export-to-re-point p50 during
+an upgrade sweep.
+
+The zero-downtime acceptance for ``POST /fleet/upgrade`` (docs/fleet.md
+"Rolling upgrades & autoscaling") is that a session is "between boxes"
+only for the export → import → re-point window — the client keeps
+streaming on the source until the StreamMigrated webhook lands.  This
+bench prices exactly that window: a real upgrade sweep's per-session
+``upgrade_session_move_ms`` samples over N live sessions, reported as
+the p50 (lower is better; perf_compare ships a tolerance for it).
+
+Shape: TWO real agent apps (fake pipeline, loopback provider) behind an
+in-process fleet router, all on loopback.  N sessions land on agent A,
+then ``POST /fleet/upgrade`` starts the rolling sweep: A drains-as-move
+and every session's export/import/re-point is timed by the router
+itself (the same samples /metrics serves as
+``upgrade_session_move_ms_p50/_p99``).  Once all N moves are recorded
+the sweep is cancelled — the recycle/respawn tail needs a real process
+boundary (tests/test_fleet_procs.py) and prices process exec, not the
+move window under test.
+
+Prints ONE JSON line (bank-and-commit contract) and appends it to
+PERF_LOG.jsonl (PERF_LOG_PATH overrides; empty value disables).
+
+Env knobs: UPGRADE_BENCH_SESSIONS (default 8).
+
+Pure-host bench: jax is never imported (fingerprint says "unprobed") —
+the lifecycle tier is host machinery, and the control-plane snapshot
+path the fake pipeline exports through is the same HTTP surface the
+scheduler tier rides.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# host-only planes: the device/obs tiers are not under test and devtel
+# would drag in jax
+os.environ.setdefault("DEVTEL_ENABLE", "0")
+os.environ.setdefault("SLO_ENABLE", "0")
+os.environ.setdefault("FLIGHT_RECORDER", "0")
+os.environ.setdefault("BATCHSCHED", "0")
+os.environ.setdefault("WARMUP_FRAMES", "0")
+
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+
+SESSIONS = int(os.getenv("UPGRADE_BENCH_SESSIONS") or 8)
+
+
+async def measure() -> dict:
+    import aiohttp
+    from aiohttp import web
+
+    from ai_rtc_agent_tpu.fleet.registry import FleetRegistry
+    from ai_rtc_agent_tpu.fleet.router import build_router_app
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.signaling import (
+        LoopbackProvider,
+        make_loopback_offer,
+    )
+
+    class _Pipe:
+        def __call__(self, frame):
+            return frame
+
+        def update_prompt(self, p):
+            pass
+
+        def update_t_index_list(self, t):
+            pass
+
+    async def _serve(app):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner, site._server.sockets[0].getsockname()[1]
+
+    # two real agents: A carries the sessions, B is the sweep's target
+    agent_runners, ports = [], []
+    for _ in range(2):
+        runner, port = await _serve(
+            build_app(pipeline=_Pipe(), provider=LoopbackProvider())
+        )
+        agent_runners.append(runner)
+        ports.append(port)
+    registry = FleetRegistry()
+    # A first: all placements land on it before B even exists, and the
+    # upgrade sweep (registration order) drains it first
+    registry.register({
+        "worker_id": "bench-a", "public_ip": "127.0.0.1",
+        "public_port": str(ports[0]), "status": "ready",
+    })
+    router_app = build_router_app(registry=registry, poll=True)
+    router_runner, router_port = await _serve(router_app)
+
+    payload = {
+        "room_id": "bench",
+        "offer": {"sdp": make_loopback_offer(), "type": "offer"},
+    }
+    base = f"http://127.0.0.1:{router_port}"
+
+    async with aiohttp.ClientSession() as client:
+        for _ in range(SESSIONS):
+            async with client.post(f"{base}/offer", json=payload) as resp:
+                await resp.read()
+                assert resp.status == 200, resp.status
+        registry.register({
+            "worker_id": "bench-b", "public_ip": "127.0.0.1",
+            "public_port": str(ports[1]), "status": "ready",
+        })
+
+        # the poller must have real evidence for BOTH boxes before the
+        # sweep judges drain-to-zero / picks a migration target
+        deadline = time.monotonic() + 10
+        while not all(
+            r.last_ok is not None for r in registry.agents.values()
+        ):
+            assert time.monotonic() < deadline, "poller never settled"
+            await asyncio.sleep(0.05)
+
+        async with client.post(f"{base}/fleet/upgrade") as resp:
+            await resp.read()
+            assert resp.status == 202, resp.status
+
+        # the router times each move itself; drain the sweep until all
+        # N samples exist, then cancel (the respawn tail is a process
+        # boundary, not this bench's window)
+        moves = router_app["upgrade_move_ms"]
+        deadline = time.monotonic() + 60
+        while len(moves) < SESSIONS:
+            assert time.monotonic() < deadline, (
+                f"only {len(moves)}/{SESSIONS} sessions moved"
+            )
+            await asyncio.sleep(0.02)
+        async with client.post(
+            f"{base}/fleet/upgrade", params={"action": "cancel"}
+        ) as resp:
+            await resp.read()
+        deadline = time.monotonic() + 10
+        while router_app["upgrade"]["active"]:
+            assert time.monotonic() < deadline, "sweep never halted"
+            await asyncio.sleep(0.02)
+        samples = sorted(moves)
+
+    await router_runner.cleanup()
+    for runner in agent_runners:
+        await runner.cleanup()
+
+    p50 = samples[len(samples) // 2]
+    p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+    return {
+        "check": "upgrade_bench",
+        "sessions": SESSIONS,
+        "move_p99_ms": round(p99, 3),
+        # the contract quartet; floored just above zero — perf_compare
+        # treats value 0.0 as a failed run
+        "metric": "upgrade_session_move_ms",
+        "value": round(max(p50, 0.01), 3),
+        "unit": "ms",
+        "vs_baseline": round(max(p50, 0.01), 3),
+        "backend": "host",  # no jax in this process, by design
+        "live": True,
+        "label": f"upgrade_move_{SESSIONS}s",
+        "recorded_at": datetime.now(timezone.utc).isoformat(),
+        "fingerprint": fingerprint(probe_jax=False),
+    }
+
+
+from ai_rtc_agent_tpu.utils.perfbank import bank as _bank  # noqa: E402
+
+
+def main():
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("upgrade_bench timeout")
+    entry = {
+        "check": "upgrade_bench",
+        "metric": "upgrade_session_move_ms",
+        "value": 0.0,
+        "unit": "ms",
+        "vs_baseline": 0.0,
+    }
+    try:
+        entry = asyncio.run(measure())
+        _bank(entry)
+    except BaseException as e:  # the contract line must survive any exit
+        entry["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(entry))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,11 @@ Adds a test-only drive surface the parent test uses to move media:
                                     volley — the breach the journey
                                     plane's evidence capture rides)
 
-Prints one JSON line {"port": <bound port>} on stdout once serving.
+Prints one JSON line {"port": <bound port>, "pid": <pid>} on stdout once
+serving.  A recycled replacement (server/lifecycle.py argv re-exec)
+inherits this stdout pipe, so the parent test reads the replacement's
+own announce line from the SAME stream — the pid lets it reap re-exec
+children it never spawned itself.
 """
 
 import argparse
@@ -129,10 +133,21 @@ async def main(port: int) -> None:
     app.router.add_post("/_test/degrade", _degrade)
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", port)
-    await site.start()
+    # bounded bind retry: a recycled replacement on a FIXED port can race
+    # its predecessor's exit for the address — the old process releases
+    # it within its RECYCLE_EXIT_DELAY_S beat
+    site = None
+    for attempt in range(50):
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        try:
+            await site.start()
+            break
+        except OSError:
+            if port == 0 or attempt == 49:
+                raise
+            await asyncio.sleep(0.1)
     bound = site._server.sockets[0].getsockname()[1]
-    print(json.dumps({"port": bound}), flush=True)
+    print(json.dumps({"port": bound, "pid": os.getpid()}), flush=True)
     await asyncio.Event().wait()
 
 

@@ -1102,3 +1102,79 @@ def test_perf_compare_knows_fleet_leg(tmp_path, capsys):
     ])
     r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
     assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+
+
+# -- scripts/upgrade_bench.py: the rolling-upgrade move window (ISSUE 16) ----
+
+def test_upgrade_bench_contract(tmp_path):
+    """Upgrade session-move microbench smoke (ISSUE 16): pure host (never
+    imports jax), a REAL upgrade sweep moves every session between two
+    loopback agents, emits exactly one contract line, BANKS it, and the
+    per-session export-to-re-point p50 stays in single-digit-to-tens-of-
+    milliseconds territory even on a contended CI box.  The committed
+    PERF_LOG line carries the real number (~2.6ms on this box); what this
+    fence catches is the move window going pathological (snapshot
+    re-copies, serialized sweeps = hundreds of ms)."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update({
+        "PERF_LOG_PATH": str(log),
+        "UPGRADE_BENCH_SESSIONS": "4",
+    })
+    r = subprocess.run(
+        [sys.executable, "scripts/upgrade_bench.py"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "upgrade_session_move_ms"
+    assert d["sessions"] == 4
+    # pure-host bench: the fingerprint must say jax never entered
+    assert d["fingerprint"]["jax_backend"] == "unprobed"
+    assert 0 < d["value"] < 100.0, d
+    assert d["move_p99_ms"] >= d["value"]
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "upgrade_session_move_ms"
+
+
+def test_perf_compare_knows_upgrade_leg(tmp_path, capsys):
+    """ISSUE 16 satellite: the upgrade move window ships with a built-in
+    lower-is-better fence (1.0 = up to 2x the banked ms) — a fresh run
+    past it fails with no --tolerance-metric flags."""
+    main = _perf_compare_main()
+
+    def _perf_compare(args):
+        class R:
+            pass
+
+        r = R()
+        r.returncode = main(args)
+        r.stdout = capsys.readouterr().out
+        r.stderr = ""
+        return r
+
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "upgrade_session_move_ms", "value": 2.6,
+         "unit": "ms", "backend": "host", "live": True,
+         "label": "upgrade_move_8s"},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "upgrade_session_move_ms", "value": 5.0,
+         "unit": "ms", "backend": "host", "label": "upgrade_move_8s"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    _write_jsonl(fresh, [
+        {"metric": "upgrade_session_move_ms", "value": 5.5,
+         "unit": "ms", "backend": "host", "label": "upgrade_move_8s"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout

@@ -24,6 +24,15 @@ loopback media) on loopback ports behind an in-process fleet router:
 
 One test function: the 3 process spawns (~a second each, concurrent)
 are paid once for all three acceptance legs.
+
+ISSUE 16 adds the zero-downtime lifecycle acceptance: a 2-real-process
+rolling upgrade (``POST /fleet/upgrade`` — drain-as-move, real
+``/admin/recycle`` re-exec respawns read off the inherited stdout pipe,
+epoch-bumped re-registration, a final restart-in-place WITH live
+sessions through the AGENT_RECYCLED same-box adoption) and a SIGKILL
+mid-upgrade halt.  To pay for the added wall-time, the original
+3-process composite (whose crash/journey surface the migrate-drain +
+upgrade siblings now cover piecewise) moved to the slow tier.
 """
 
 import asyncio
@@ -62,12 +71,13 @@ AGENT_ENV = {
 }
 
 
-def _spawn_agents(n):
+def _spawn_agents(n, extra_env=None):
     procs = []
     for i in range(n):
         env = dict(os.environ)
         env.pop("PYTHONPATH", None)
         env.update(AGENT_ENV)
+        env.update(extra_env or {})
         # the agent's published identity — journey fragments stamp it,
         # so the merged chrome export can tell the legs' agents apart
         env["WORKER_ID"] = f"agent{i}"
@@ -102,6 +112,16 @@ def _kill(procs):
         p.stderr.close()
 
 
+def _kill_pids(pids):
+    """Reap recycle replacements: argv re-exec children of agent procs
+    that have since exited — not our children, so SIGKILL by pid."""
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 _OFFER = {
     "room_id": "fleet-room",
     "offer": {"sdp": make_loopback_offer(), "type": "offer"},
@@ -118,6 +138,9 @@ async def _wait_for(predicate, timeout_s, what):
         await asyncio.sleep(0.1)
 
 
+@pytest.mark.slow  # the lifecycle siblings below cover this composite's
+# surfaces piecewise in tier-1; the full 3-process crash/journey story
+# stays as the slow-tier integration sweep
 def test_three_process_fleet(monkeypatch):
     monkeypatch.setenv("FLEET_POLL_S", "0.15")
     monkeypatch.setenv("FLEET_POLL_TIMEOUT_S", "2.0")
@@ -555,6 +578,438 @@ def test_three_process_migrate_drain(monkeypatch):
             assert m["migrations_total"] == 1
             assert m.get("migrations_failed_total", 0) == 0
             assert m["fleet_drains_total"] == 1
+        finally:
+            await http.close()
+            await client.close()
+
+    try:
+        asyncio.run(go())
+    finally:
+        _kill(procs)
+
+
+def test_two_process_rolling_upgrade(monkeypatch):
+    """ISSUE 16 acceptance: ``POST /fleet/upgrade`` rolls TWO real agent
+    processes through drain-as-move -> ``/admin/recycle`` (real argv
+    re-exec respawn, announce read off the inherited stdout pipe) ->
+    epoch-bumped re-registration + prewarm, one at a time, with every
+    pumped frame delivered at every leg (zero drops).  The finale is the
+    OTHER half of the tentpole: restart-in-place WITH live sessions —
+    ``/admin/recycle`` on a box serving two streams, whose replacement
+    imports the handoff before binding and announces AGENT_RECYCLED, so
+    the clients re-offer back onto the SAME box at the next journey leg."""
+    monkeypatch.setenv("FLEET_POLL_S", "0.15")
+    monkeypatch.setenv("FLEET_POLL_TIMEOUT_S", "2.0")
+    # more failed-poll tolerance than the crash tests: a recycle gap
+    # (old process exit -> replacement announce) is an EXPECTED outage
+    monkeypatch.setenv("FLEET_DEAD_AFTER", "3")
+    procs, ports = _spawn_agents(2, extra_env={"RECYCLE_EXIT_DELAY_S": "0.1"})
+    names = [f"agent{i}" for i in range(2)]
+    port_of = dict(zip(names, ports))
+    proc_of = dict(zip(names, procs))
+    child_pids = []  # re-exec replacements: not our children, kill by pid
+    posted = []
+
+    class FakeResp:
+        status = 200
+
+    class FakeSession:
+        async def post(self, url, headers=None, json=None):
+            posted.append(json)
+            return FakeResp()
+
+    async def go():
+        import aiohttp
+
+        events = StreamEventHandler(
+            session_factory=FakeSession,
+            webhook_url="http://client-notify.example/hook", token="t",
+        )
+        reg = FleetRegistry(dead_after=3)
+        app = build_router_app(registry=reg, events_handler=events,
+                               poll=True)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=15)
+        )
+
+        async def agent_get(port, path):
+            async with http.get(f"http://127.0.0.1:{port}{path}") as r:
+                return await r.json()
+
+        async def agent_post(port, path, body):
+            async with http.post(
+                f"http://127.0.0.1:{port}{path}", json=body
+            ) as r:
+                return await r.json()
+
+        async def register(name):
+            # what server/worker.py publishes: address + capacity + the
+            # process boot nonce (the registry's epoch/ghost discipline)
+            cap = await agent_get(port_of[name], "/capacity")
+            r = await client.post("/fleet/register", json={
+                "worker_id": name, "public_ip": "127.0.0.1",
+                "public_port": str(port_of[name]), "status": "ready",
+                "capacity": 2, "boot_id": cap["boot_id"],
+            })
+            assert r.status == 200, await r.text()
+
+        async def read_announce(name):
+            # a recycled replacement re-execs argv and inherits stdout:
+            # its {"port","pid"} announce arrives on the SAME pipe the
+            # original process used at spawn
+            proc = proc_of[name]
+            line = await asyncio.wait_for(
+                asyncio.to_thread(proc.stdout.readline), timeout=45
+            )
+            assert line, f"{name}: pipe EOF before replacement announce"
+            info = json.loads(line)
+            child_pids.append(info["pid"])
+            port_of[name] = int(info["port"])
+
+        async def pump(name, frames, expect_sessions):
+            pumped = await agent_post(
+                port_of[name], "/_test/pump", {"frames": frames}
+            )
+            assert len(pumped["sessions"]) == expect_sessions, pumped
+            # the zero-drop acceptance: pushed == delivered, exactly
+            assert sum(pumped["sessions"].values()) == (
+                frames * expect_sessions
+            ), pumped
+
+        legs = {}  # journey id -> last acked leg
+
+        async def reoffer(jid, expect_owner):
+            r = await client.post(
+                "/offer", json=_OFFER, headers={"X-Journey-Id": jid}
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Journey-Id"] == jid
+            # every continuation is exactly leg+1 — no journey ever
+            # skips or repeats a leg across the whole rolling sweep
+            assert int(r.headers["X-Journey-Leg"]) == legs[jid] + 1
+            legs[jid] += 1
+            sid = r.headers["X-Stream-Id"]
+            assert app["session_table"].owner(sid) == expect_owner
+            return sid
+
+        try:
+            for name in names:
+                await register(name)
+
+            async def first_poll():
+                return all(
+                    rec.last_ok is not None for rec in reg.agents.values()
+                )
+
+            await _wait_for(first_poll, 10, "first poll round")
+
+            # one session per agent, webhooks at the router's ingest
+            jid_of = {}
+            for _ in range(2):
+                r = await client.post("/offer", json=_OFFER)
+                assert r.status == 200, await r.text()
+                sid = r.headers["X-Stream-Id"]
+                jid_of[app["session_table"].owner(sid)] = (
+                    r.headers["X-Journey-Id"]
+                )
+                legs[r.headers["X-Journey-Id"]] = 1
+            assert set(jid_of) == set(names), jid_of
+            events_url = str(client.make_url("/fleet/events"))
+            for name in names:
+                await agent_post(port_of[name], "/_test/webhook",
+                                 {"url": events_url, "token": "t"})
+                await pump(name, 10, 1)
+            jid_a0, jid_a1 = jid_of["agent0"], jid_of["agent1"]
+
+            # ---- the rolling sweep: agent0 then agent1 ---------------
+            r = await client.post("/fleet/upgrade")
+            assert r.status == 202, await r.text()
+            body = await r.json()
+            assert body["active"] and body["total"] == 2
+
+            # step 1: agent0's session moves to agent1; the re-pointed
+            # client re-offers as leg 2 and streams there mid-sweep
+            async def step1_moved():
+                evs = [e for e in posted
+                       if e.get("event") == "StreamMigrated"
+                       and e.get("source_agent") == "agent0"]
+                return evs or None
+
+            ev = (await _wait_for(step1_moved, 20, "step-1 move"))[0]
+            assert ev["target_agent"] == "agent1"
+            assert ev["reason"] == "upgrade"
+            assert ev["journey_id"] == jid_a0
+            await reoffer(jid_a0, "agent1")
+            await pump("agent1", 8, 2)
+
+            async def sweep0_done():
+                return app["migrate_sweeps"].get("agent0") is None
+
+            await _wait_for(sweep0_done, 20, "step-1 sweep retire")
+            # the client hangs up its OLD agent0 connection -> drain hits
+            # zero -> the sweep recycles agent0; its replacement
+            # announces on the inherited pipe and re-registers
+            await agent_post(port_of["agent0"], "/_test/close", {})
+            await read_announce("agent0")
+            await register("agent0")
+
+            # step 2: BOTH of agent1's sessions (its own + the adopted
+            # one) move onto the fresh agent0
+            async def step2_moved():
+                evs = [e for e in posted
+                       if e.get("event") == "StreamMigrated"
+                       and e.get("source_agent") == "agent1"]
+                return evs if len(evs) == 2 else None
+
+            evs = await _wait_for(step2_moved, 30, "step-2 moves")
+            assert {e["journey_id"] for e in evs} == {jid_a0, jid_a1}
+            for e in evs:
+                assert e["target_agent"] == "agent0"
+                assert e["reason"] == "upgrade"
+                await reoffer(e["journey_id"], "agent0")
+            await pump("agent0", 8, 2)
+
+            async def sweep1_done():
+                return app["migrate_sweeps"].get("agent1") is None
+
+            await _wait_for(sweep1_done, 20, "step-2 sweep retire")
+            await agent_post(port_of["agent1"], "/_test/close", {})
+            await read_announce("agent1")
+            await register("agent1")
+
+            async def upgrade_done():
+                h = await (await client.get("/fleet/health")).json()
+                u = h["upgrade"]
+                return u if (not u["active"] and u["done"]) else None
+
+            up = await _wait_for(upgrade_done, 30, "sweep completion")
+            assert up["halted"] is None, up
+            assert up["done"] == ["agent0", "agent1"]
+
+            # ---- finale: restart-in-place WITH live sessions ---------
+            # agent0 is serving both streams; recycle it directly (the
+            # single-box operator surface, no drain).  The replacement
+            # imports the handoff BEFORE binding, announces
+            # AGENT_RECYCLED per session, and the router pins each
+            # journey's next re-offer back to the SAME box.
+            r = await agent_post(
+                port_of["agent0"], "/admin/recycle", {"respawn": True}
+            )
+            assert r["recycling"] and r["sessions"] == 2, r
+
+            async def recycled():
+                evs = [e for e in posted
+                       if e.get("state") == "AGENT_RECYCLED"]
+                return evs if len(evs) == 2 else None
+
+            evs = await _wait_for(recycled, 30, "AGENT_RECYCLED re-points")
+            assert {e["journey_id"] for e in evs} == {jid_a0, jid_a1}
+            await read_announce("agent0")
+            await register("agent0")  # adoption pins need the new address
+            for jid in (jid_a0, jid_a1):
+                await reoffer(jid, "agent0")
+            await pump("agent0", 8, 2)
+
+            # ---- evidence: epochs, rings, metrics --------------------
+            h = await (await client.get("/fleet/health")).json()
+            # agent0: initial + upgrade recycle + in-place recycle
+            assert h["agents"]["agent0"]["epoch"] == 3, h["agents"]
+            assert h["agents"]["agent1"]["epoch"] == 2, h["agents"]
+            ring = app["journeys"].get(jid_a0)
+            kinds = [e["kind"] for e in ring["events"]]
+            for expected in ("migrated", "upgraded", "recycled"):
+                assert expected in kinds, kinds
+            assert [(leg["leg"], leg["agent"]) for leg in ring["legs"]] == [
+                (1, "agent0"), (2, "agent1"), (3, "agent0"), (4, "agent0"),
+            ]
+            m = await (await client.get("/metrics")).json()
+            assert m["fleet_upgrades_total"] == 1
+            assert m.get("fleet_upgrade_halts_total", 0) == 0
+            assert m["migrations_total"] == 3
+            assert m.get("migrations_failed_total", 0) == 0
+            assert m["fleet_recycled_sessions_total"] == 2
+            assert m["upgrade_session_move_ms_p50"] > 0
+            assert m["upgrade_session_move_ms_p99"] >= (
+                m["upgrade_session_move_ms_p50"]
+            )
+        finally:
+            # unblock any to_thread readline (EOF needs every writer
+            # gone) BEFORE the loop's executor shutdown would join it
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            _kill_pids(child_pids)
+            await http.close()
+            await client.close()
+
+    try:
+        asyncio.run(go())
+    finally:
+        _kill(procs)
+        _kill_pids(child_pids)
+
+
+@pytest.mark.slow  # SIGKILL + poller death detection riding on top of the
+# tier-1 upgrade sweep above; the halt logic itself also has fast unit
+# coverage in test_fleet_lifecycle.py
+def test_upgrade_sigkill_falls_back_to_crash_restore(monkeypatch):
+    """A mid-upgrade SIGKILL of the in-flight target halts the sweep
+    cleanly ("died mid-drain") and hands its sessions to the EXISTING
+    crash path: the banked drain export crash-restores onto the
+    survivor, the client re-offers as leg 2, and the untouched second
+    agent never enters the sweep."""
+    monkeypatch.setenv("FLEET_POLL_S", "0.15")
+    monkeypatch.setenv("FLEET_POLL_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("FLEET_DEAD_AFTER", "2")
+    # 3 slots: survivor's own session + the sweep's parked import + the
+    # crash-restore import all fit
+    procs, ports = _spawn_agents(
+        2, extra_env={"OVERLOAD_MAX_SESSIONS": "3"}
+    )
+    names = [f"agent{i}" for i in range(2)]
+    port_of = dict(zip(names, ports))
+    posted = []
+
+    class FakeResp:
+        status = 200
+
+    class FakeSession:
+        async def post(self, url, headers=None, json=None):
+            posted.append(json)
+            return FakeResp()
+
+    async def go():
+        import aiohttp
+
+        events = StreamEventHandler(
+            session_factory=FakeSession,
+            webhook_url="http://client-notify.example/hook", token="t",
+        )
+        reg = FleetRegistry(dead_after=2)
+        app = build_router_app(registry=reg, events_handler=events,
+                               poll=True)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=15)
+        )
+
+        async def agent_get(port, path):
+            async with http.get(f"http://127.0.0.1:{port}{path}") as r:
+                return await r.json()
+
+        async def agent_post(port, path, body):
+            async with http.post(
+                f"http://127.0.0.1:{port}{path}", json=body
+            ) as r:
+                return await r.json()
+
+        try:
+            for name in names:
+                r = await client.post("/fleet/register", json={
+                    "worker_id": name, "public_ip": "127.0.0.1",
+                    "public_port": str(port_of[name]), "status": "ready",
+                    "capacity": 3,
+                })
+                assert r.status == 200
+
+            async def first_poll():
+                return all(
+                    rec.last_ok is not None for rec in reg.agents.values()
+                )
+
+            await _wait_for(first_poll, 10, "first poll round")
+
+            sids, jids = [], {}
+            for _ in range(2):
+                r = await client.post("/offer", json=_OFFER)
+                assert r.status == 200, await r.text()
+                sid = r.headers["X-Stream-Id"]
+                sids.append(sid)
+                jids[sid] = r.headers["X-Journey-Id"]
+            events_url = str(client.make_url("/fleet/events"))
+            for name in names:
+                await agent_post(port_of[name], "/_test/webhook",
+                                 {"url": events_url, "token": "t"})
+            owner = {sid: app["session_table"].owner(sid) for sid in sids}
+            victim_sid = next(
+                sid for sid in sids if owner[sid] == "agent0"
+            )
+            vic_jid = jids[victim_sid]
+
+            r = await client.post("/fleet/upgrade")
+            assert r.status == 202, await r.text()
+
+            # the sweep exports + parks agent0's session on agent1, but
+            # the client never plays along (no re-offer, no hang-up):
+            # drain-to-zero blocks with the placement row still live
+            async def sweep_settled():
+                done = app["migrate_sweeps"].get("agent0") is None
+                moved = any(e.get("event") == "StreamMigrated"
+                            and e.get("source_agent") == "agent0"
+                            for e in posted)
+                return done and moved
+
+            await _wait_for(sweep_settled, 20, "step-1 sweep settle")
+            assert app["session_table"].owner(victim_sid) == "agent0"
+
+            # a successful move retires its banked export (so the crash
+            # path can't double-restore) — re-bank a fresh one, exactly
+            # the state of a sweep killed between export and client move
+            snap = await agent_get(
+                port_of["agent0"],
+                f"/migrate/export?session={victim_sid}",
+            )
+            app["snapshot_bank"][victim_sid] = {
+                "snapshot": snap, "ts": time.monotonic(),
+            }
+
+            procs[0].kill()  # SIGKILL mid-upgrade, session still placed
+
+            async def halted():
+                h = await (await client.get("/fleet/health")).json()
+                u = h["upgrade"]
+                return u if (not u["active"] and u["halted"]) else None
+
+            up = await _wait_for(halted, 20, "sweep halt")
+            assert "died mid-drain" in up["halted"], up
+            assert up["done"] == []
+
+            # the crash path owns the session now: banked snapshot
+            # restores onto the survivor and re-points the client
+            async def restored():
+                evs = [e for e in posted
+                       if e.get("event") == "StreamMigrated"
+                       and e.get("reason") == "agent_dead"]
+                return evs or None
+
+            ev = (await _wait_for(restored, 20, "crash restore"))[0]
+            assert ev["stream_id"] == victim_sid
+            assert ev["target_agent"] == "agent1"
+            r = await client.post(
+                "/offer", json=_OFFER, headers={"X-Journey-Id": vic_jid}
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Journey-Leg"] == "2"
+            new_sid = r.headers["X-Stream-Id"]
+            assert app["session_table"].owner(new_sid) == "agent1"
+            pumped = await agent_post(
+                port_of["agent1"], "/_test/pump", {"frames": 10}
+            )
+            assert len(pumped["sessions"]) == 2, pumped
+            assert sum(pumped["sessions"].values()) == 20, pumped
+
+            # the halt left the rest of the fleet untouched and serving
+            h = await (await client.get("/fleet/health")).json()
+            assert h["agents"]["agent0"]["state"] == "DEAD"
+            a1 = h["agents"]["agent1"]
+            assert a1["state"] == "HEALTHY" and not a1["draining"], a1
+            assert a1["epoch"] == 1
+            m = await (await client.get("/metrics")).json()
+            assert m["fleet_upgrade_halts_total"] == 1
+            assert m.get("fleet_upgrades_total", 0) == 0
         finally:
             await http.close()
             await client.close()

@@ -189,6 +189,13 @@ def test_multipeer_native_rtp_two_udp_clients(monkeypatch):
     if native.load() is None:
         pytest.skip("native lib unavailable")
     monkeypatch.setenv("WARMUP_FRAMES", "0")
+    # Deterministic under full-suite load: the admission gate refuses
+    # (503) when the event loop looks laggy, and a busy CI box running
+    # the whole suite can trip the default 200ms budget right as this
+    # test's /offer lands — the only test here that admits TWO sessions
+    # back to back.  The lag shield is not what this test exercises, so
+    # pin the budget far above any scheduler hiccup.
+    monkeypatch.setenv("OVERLOAD_LOOP_LAG_BUDGET_MS", "10000")
     from ai_rtc_agent_tpu.media.frames import VideoFrame
     from ai_rtc_agent_tpu.media.plane import H264RingSource, H264Sink
     from ai_rtc_agent_tpu.server.multipeer_serving import MultiPeerPipeline
