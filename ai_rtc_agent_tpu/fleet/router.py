@@ -123,6 +123,16 @@ class _SessionTable:
         entry = self._m.get(stream_id)
         return entry["agent"] if entry else None
 
+    def newest_of_kind(self, kind: str) -> tuple[str, dict] | None:
+        """Most recently placed session of ``kind`` (insertion order IS
+        recency here) — the broadcast tier resolves 'which agent owns the
+        live publisher' with this."""
+        for sid in reversed(list(self._m)):
+            e = self._m[sid]
+            if e["kind"] == kind:
+                return sid, dict(e)
+        return None
+
     def entry(self, stream_id: str) -> dict | None:
         return self._m.get(stream_id)
 
@@ -154,7 +164,11 @@ class _SessionTable:
 # ---------------------------------------------------------------------------
 
 async def _place_and_proxy(request: web.Request, path: str,
-                           kind: str) -> web.Response:
+                           kind: str, pin=None) -> web.Response:
+    """``pin``: a caller-chosen first-attempt agent (the broadcast tier's
+    edge placement) — tried before the registry's pick, with the normal
+    503/unreachable fallback walk behind it.  A migration pin (imported
+    stream state) outranks it: only that target holds the session."""
     import aiohttp
 
     app = request.app
@@ -181,7 +195,7 @@ async def _place_and_proxy(request: web.Request, path: str,
     journeys: JourneyLog | None = app["journeys"]
     journey_id = None
     leg = 1
-    pinned = None
+    pinned = pin
     if journeys is not None:
         echoed = request.headers.get("X-Journey-Id")
         if journeys.known(echoed):
@@ -305,10 +319,51 @@ async def whip(request):
     return await _place_and_proxy(request, "/whip", "whip")
 
 
+async def _edge_pull_pin(app) -> object | None:
+    """Two-level fan-out placement (ISSUE 17): pick a NON-owner edge for
+    the next viewer leg and make sure it is pulling ONE copy of the
+    publisher's stream (``POST /broadcast/pull`` is idempotent on the
+    agent).  Returns the record to pin the placement to, or None for the
+    plain registry walk.  Failures fall back to the owner — a viewer on
+    the owning agent is always correct, just not scaled out."""
+    import aiohttp
+
+    reg: FleetRegistry = app["fleet"]
+    stats: FrameStats = app["stats"]
+    newest = app["session_table"].newest_of_kind("whip")
+    if newest is None:
+        return None
+    owner = reg.agents.get(newest[1]["agent"])
+    if owner is None or owner.state == "DEAD":
+        return None
+    edge = reg.pick(exclude={owner.agent_id})
+    if edge is None:
+        return owner  # single-agent fleet: every viewer is local
+    try:
+        async with app["http"].post(
+            edge.base_url + "/broadcast/pull",
+            json={"owner_url": owner.base_url},
+        ) as resp:
+            if 200 <= resp.status < 300:
+                stats.count("fleet_edge_pulls")
+                return edge
+            # 409 = fan-out/edge-pull disabled on the agent; anything
+            # else = pull setup failed — either way the owner serves
+            stats.count("fleet_edge_pull_refused")
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        logger.warning("edge pull via %s failed: %s", edge.agent_id, e)
+        stats.count("fleet_edge_pull_failures")
+        reg.note_poll_fail(edge)
+    return owner
+
+
 async def whep(request):
     if request.method == "DELETE":
         return await _routed_delete(request, "/whep")
-    return await _place_and_proxy(request, "/whep", "whep")
+    pin = None
+    if env.broadcast_edge_pull_enabled():
+        pin = await _edge_pull_pin(request.app)
+    return await _place_and_proxy(request, "/whep", "whep", pin=pin)
 
 
 async def _routed_delete(request: web.Request, path: str) -> web.Response:

@@ -286,12 +286,18 @@ class H264Sink:
         ssrc: int = 0x5EED,
         payload_type: int = 96,
         plane_stats: FrameStats | None = None,
+        au_tap=None,
     ):
         """``payload_type``: RTP PT for outgoing packets — real-SDP answers
         echo the client's offered H264 payload number (server/sdp.py), so
         the wire must carry the same value.  ``plane_stats``: per-session
-        host-plane stage gauges (packetize µs histograms at /metrics)."""
+        host-plane stage gauges (packetize µs histograms at /metrics).
+        ``au_tap``: optional ``(au_bytes, pts)`` callable invoked on the
+        worker thread for every non-empty encoded AU, before packetize —
+        the broadcast GOP cache hangs off this (AU bytes are stable; the
+        packets below are pooled views and are NOT)."""
         self.stats = stats or FrameStats()
+        self._au_tap = au_tap
         self.plane_stats = plane_stats
         self.use_h264 = native.h264_available() if use_h264 is None else use_h264
         self._enc = H264Encoder(width, height, fps) if self.use_h264 else None
@@ -445,6 +451,8 @@ class H264Sink:
             self.stats.record_stage("glass", now - wall)
         if not au:
             return []
+        if self._au_tap is not None:
+            self._au_tap(au, int(pts))
         with self._enc_lock:  # close() frees the native packetizer too
             if self._pkt is None:
                 return [au] if not self._closed else []

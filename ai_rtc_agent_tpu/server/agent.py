@@ -1073,6 +1073,7 @@ def _refresh_source_track(app):
     live = app["state"].get("whip_pcs", {})
     tracks = app["state"].get("whip_tracks", {})
     relays = app["state"].get("whip_relays", {})
+    groups = app["state"].get("broadcast_groups", {})
     # sweep EVERY dead session first: an older publisher disconnecting while
     # a newer one stays live must not leave entries behind forever
     # (unbounded growth under publisher churn — ADVICE r2)
@@ -1081,12 +1082,75 @@ def _refresh_source_track(app):
         dead = relays.pop(sid, None)
         if dead is not None:
             dead.stop()
+        group = groups.pop(sid, None)
+        if group is not None:
+            # the publisher is gone: tear the shared TX plane down too
+            # (viewer sessions outlive it harmlessly — their group ref
+            # just stops fanning out)
+            spawn(group.close())
     for sid in reversed(list(tracks)):
         app["state"]["source_track"] = tracks[sid]
         app["state"]["source_relay"] = relays.get(sid)
         return
     app["state"]["source_track"] = None
     app["state"]["source_relay"] = None
+
+
+async def _ensure_broadcast_group(app):
+    """The broadcast TX plane for the CURRENT publisher (or the edge-pulled
+    stream), created on first viewer demand.  None => no group possible
+    (no relay to subscribe — e.g. a bare source_track test rig) and the
+    caller keeps the dedicated per-viewer chain."""
+    groups = app["state"].setdefault("broadcast_groups", {})
+    edge = groups.get("edge")
+    if edge is not None and not edge.closed:
+        return edge
+    relay = app["state"].get("source_relay")
+    if relay is None:
+        return None
+    sid = next(
+        (
+            s
+            for s, r in app["state"].get("whip_relays", {}).items()
+            if r is relay
+        ),
+        None,
+    )
+    if sid is None:
+        return None
+    group = groups.get(sid)
+    if group is None or group.closed:
+        from .broadcast import BroadcastGroup
+
+        provider = app["provider"]
+        group = BroadcastGroup(
+            sid,
+            width=getattr(provider, "default_width", 512),
+            height=getattr(provider, "default_height", 512),
+            use_h264=getattr(provider, "use_h264", None),
+            stats=relay.stats,
+        )
+        await group.start(relay.subscribe())
+        groups[sid] = group
+    return group
+
+
+def _broadcast_gauges(app) -> dict:
+    """Aggregate broadcast-plane gauges (/capacity /health /metrics):
+    group count + audience size vs the viewer cap — O(groups) int reads."""
+    groups = {
+        k: g
+        for k, g in app["state"].get("broadcast_groups", {}).items()
+        if not g.closed
+    }
+    viewers = sum(g.viewer_count for g in groups.values())
+    cap = env.broadcast_max_viewers()
+    return {
+        "broadcast_groups": len(groups),
+        "broadcast_viewers": viewers,
+        "broadcast_max_viewers": cap,
+        "broadcast_viewer_slots_free": max(0, cap - viewers) if cap else -1,
+    }
 
 
 async def whep(request):
@@ -1098,7 +1162,11 @@ async def whep(request):
         return web.Response(status=400)
 
     source_track = app["state"].get("source_track")
-    if source_track is None:
+    edge_group = app["state"].get("broadcast_groups", {}).get("edge")
+    if edge_group is not None and edge_group.closed:
+        edge_group = None
+    if source_track is None and edge_group is None:
+        # nothing to serve: no local publisher AND no pulled edge stream
         return web.Response(status=401)
 
     provider = app["provider"]
@@ -1113,12 +1181,39 @@ async def whep(request):
     offer_sdp = provider.session_description(sdp=body, type="offer")
     pc = provider.peer_connection()
     session_id = str(uuid.uuid4())
+
+    # broadcast fan-out (ISSUE 17): viewers of a native-provider stream
+    # share ONE encode/packetize plane and stop charging the engine —
+    # admission is a cheap viewer-count cap, not an engine slot.  The
+    # aiortc provider (no join_broadcast) keeps the dedicated chain.
+    group = None
+    if env.broadcast_fanout_enabled() and hasattr(pc, "join_broadcast"):
+        group = await _ensure_broadcast_group(app)
+    if group is None and source_track is None:
+        # edge-pulled stream exists but this provider can't join a group
+        await _discard_pc(pc, pcs)
+        return web.Response(
+            status=503, text="edge stream requires the broadcast plane"
+        )
+    if group is not None:
+        cap = env.broadcast_max_viewers()
+        if cap and group.viewer_count >= cap:
+            await _discard_pc(pc, pcs)
+            return web.Response(
+                status=503,
+                headers={"Retry-After": "2"},
+                text="broadcast viewer capacity reached",
+            )
+        pc.join_broadcast(group)
+
     pcs.add(pc)
     app["state"].setdefault("whep_pcs", {})[session_id] = pc
 
-    # each viewer gets its own relayed view of the processed stream — never
-    # concurrent recv() on the shared track (reference MediaRelay parity)
-    relay = app["state"].get("source_relay")
+    # dedicated tier only: each viewer gets its own relayed view of the
+    # processed stream — never concurrent recv() on the shared track
+    # (reference MediaRelay parity).  Broadcast viewers don't subscribe:
+    # the GROUP holds the one subscription.
+    relay = app["state"].get("source_relay") if group is None else None
     viewer_track = relay.subscribe() if relay is not None else source_track
 
     async def _fail_cleanup():
@@ -1145,8 +1240,9 @@ async def whep(request):
                 viewer_track.stop()
 
     try:
-        sender = pc.addTrack(viewer_track)
-        provider.force_codec(pc, sender, "video/H264")
+        if group is None:
+            sender = pc.addTrack(viewer_track)
+            provider.force_codec(pc, sender, "video/H264")
 
         await pc.setRemoteDescription(offer_sdp)
         # OBS WHIP: gather ALL ICE candidates before answering (reference
@@ -1261,7 +1357,11 @@ async def whip(request):
                 # the newest disconnects (_refresh_source_track)
                 from .relay import TrackRelay
 
-                relay = TrackRelay(vt)
+                # per-publisher aggregate stats: viewer-queue drops +
+                # delivery freshness land here (never per-viewer), and a
+                # broadcast group for this publisher adopts the SAME
+                # FrameStats so the whole fan-out story reads in one place
+                relay = TrackRelay(vt, stats=FrameStats())
                 app["state"].setdefault("whip_relays", {})[session_id] = relay
                 app["state"]["source_relay"] = relay
 
@@ -1382,6 +1482,9 @@ async def health_detail(request):
         "status": worst_state(s["state"] for s in sessions.values()),
         "sessions": sessions,
     }
+    # broadcast fan-out plane: audience size next to session health —
+    # a publisher with zero engine pressure can still be at viewer cap
+    body["broadcast"] = _broadcast_gauges(app)
     if ov is not None:
         body["overload"] = {
             "pressure": round(ov.admission.pressure(), 4),
@@ -1415,6 +1518,9 @@ async def capacity(request):
                 "saturated": free == 0,
                 "retry_after_s": 0.0,
                 "boot_id": app.get("boot_id", ""),
+                # viewer capacity is a SEPARATE pool from engine slots
+                # (ISSUE 17): broadcast viewers never charge admission
+                "broadcast": _broadcast_gauges(app),
             }
         )
     # plane-level view: counts live ladders PLUS in-flight admission
@@ -1424,7 +1530,72 @@ async def capacity(request):
     # and the registry bumps the agent's epoch when it changes (a
     # recycled replacement on the same address is a NEW process)
     body["boot_id"] = app.get("boot_id", "")
+    body["broadcast"] = _broadcast_gauges(app)
     return web.json_response(body)
+
+
+async def broadcast_pull(request):
+    """Edge-pull trigger (fleet tier, docs/fleet.md): the router asks this
+    agent to pull ONE copy of the publisher's stream from the OWNING agent
+    (``{"owner_url": "http://host:port"}``) so local WHEP viewers fan out
+    from here instead of all landing on the owner.  Idempotent while the
+    same owner's pull is live; a new owner_url replaces the old pull."""
+    app = request.app
+    if not (
+        env.broadcast_fanout_enabled() and env.broadcast_edge_pull_enabled()
+    ):
+        return web.Response(status=409, text="broadcast edge pull disabled")
+    try:
+        body = await request.json()
+    except (ValueError, LookupError):
+        return web.Response(status=400, text="invalid JSON body")
+    owner_url = body.get("owner_url") if isinstance(body, dict) else None
+    if not owner_url or not isinstance(owner_url, str):
+        return web.Response(status=400, text="owner_url required")
+    groups = app["state"].setdefault("broadcast_groups", {})
+    puller = app["state"].get("edge_puller")
+    if (
+        puller is not None
+        and not puller.closed
+        and puller.owner_url == owner_url.rstrip("/")
+    ):
+        group = groups.get("edge")
+        if group is not None and not group.closed:
+            return web.json_response(
+                {
+                    "status": "exists",
+                    "aus": puller.aus,
+                    "viewers": group.viewer_count,
+                }
+            )
+    from .broadcast import BroadcastGroup, EdgePuller
+
+    provider = app["provider"]
+    old_group = groups.pop("edge", None)
+    if old_group is not None:
+        await old_group.close()
+    if puller is not None:
+        await puller.close()
+        app["state"]["edge_puller"] = None
+    group = BroadcastGroup(
+        "edge",
+        width=getattr(provider, "default_width", 512),
+        height=getattr(provider, "default_height", 512),
+        use_h264=getattr(provider, "use_h264", None),
+    )
+    await group.start()  # AU mode: feed_au from the puller, no local sink
+    try:
+        puller = await EdgePuller(group, owner_url).open()
+    except Exception as e:
+        # native runtime missing, owner unreachable, or owner refused —
+        # the viewer leg will fall back to the owning agent
+        await group.close()
+        return web.Response(status=502, text=f"edge pull failed: {e}")
+    groups["edge"] = group
+    app["state"]["edge_puller"] = puller
+    return web.json_response(
+        {"status": "pulling", "owner_url": puller.owner_url}
+    )
 
 
 async def drain(request):
@@ -1671,6 +1842,20 @@ async def metrics(request):
     devtel_plane = request.app.get("devtel")
     if devtel_plane is not None:
         out.update(devtel_plane.snapshot())
+    # broadcast fan-out plane (server/broadcast.py): aggregate audience
+    # gauges + per-publisher-session group snapshots (drop counts, GOP
+    # cache state, rewrite/send/freshness µs percentiles) — bounded by
+    # publisher count, NEVER keyed by viewer (metric cardinality)
+    out["broadcast"] = _broadcast_gauges(request.app)
+    bsessions = {}
+    for sid, g in request.app["state"].get("broadcast_groups", {}).items():
+        if g.closed:
+            continue
+        snap = g.snapshot()
+        snap.update(g.stats.stage_snapshot_us())
+        bsessions[sid] = snap
+    if bsessions:
+        out["broadcast_sessions"] = bsessions
     fmt = request.query.get("format", "json")
     if fmt == "prom":
         # genuine Prometheus text exposition (obs/promexport.py): the
@@ -1912,6 +2097,10 @@ async def on_startup(app):
         "whip_tracks": {},
         "whip_relays": {},
         "whep_pcs": {},
+        # publisher session id -> BroadcastGroup (server/broadcast.py):
+        # the shared TX plane every broadcast viewer of that publisher
+        # rides; "edge" holds the pulled-stream group on edge agents
+        "broadcast_groups": {},
     }
     app["stats"] = FrameStats()
     if devtel_plane is not None:
@@ -2072,6 +2261,12 @@ async def on_shutdown(app):
     if "state" in app:
         for relay in app["state"].get("whip_relays", {}).values():
             relay.stop()
+        puller = app["state"].get("edge_puller")
+        if puller is not None:
+            await puller.close()
+        groups = app["state"].get("broadcast_groups", {})
+        await asyncio.gather(*[g.close() for g in groups.values()])
+        groups.clear()
     mp = app.get("multipeer_pipeline")
     if mp is not None:
         mp.close()
@@ -2147,6 +2342,7 @@ def build_app(
     app.router.add_post("/whep", whep)
     app.router.add_delete("/whep", whep)
     app.router.add_delete("/whep/{session}", whep)
+    app.router.add_post("/broadcast/pull", broadcast_pull)
     app.router.add_post("/offer", offer)
     app.router.add_post("/config", update_config)
     app.router.add_get("/", health)

@@ -1,0 +1,480 @@
+"""Broadcast TX plane: encode once, packetize once, serve the audience.
+
+Before ISSUE 17, every WHEP viewer owned a full private media chain —
+``relay.py`` fanned out DECODED frames and each subscriber paid its own
+encode → packetize → protect → send per frame, so audience size was an
+encoder-count property.  :class:`BroadcastGroup` amortizes the whole TX
+plane per PUBLISHER instead:
+
+* one :class:`~ai_rtc_agent_tpu.media.plane.H264Sink` encodes and
+  packetizes each stylized frame ONCE (pooled views, ISSUE 2 discipline);
+* per viewer, only a vectorized SSRC/seq/ts header rewrite over those
+  views (:class:`~ai_rtc_agent_tpu.media.rtp.RtpHeaderRewriter`) — secure
+  viewers then ride their own session's cached-cipher ``protect_frame``
+  path, plain viewers are batched into ONE whole-audience ``sendmmsg``
+  burst (:meth:`~ai_rtc_agent_tpu.media.sockio.CoalescedFlush.flush_grouped`);
+* viewer PLI / join re-sync NEVER touches the engine or the encoder: the
+  current GOP is replayed from :class:`~ai_rtc_agent_tpu.media.gop.GopCache`
+  as stable bytes, and the per-publisher
+  :class:`~ai_rtc_agent_tpu.resilience.netadapt.KeyframeGovernor` coalesces
+  storms to one replay per ``NETADAPT_PLI_COALESCE_MS`` window.
+
+The group also runs in AU mode (:meth:`BroadcastGroup.feed_au`) with no
+sink at all — the fleet tier's EDGE agents pull one copy of the
+publisher's stream from the owning agent, depacketize, and feed AUs here,
+so audience size stops being a single-box property (fleet/router.py).
+
+Metrics are AGGREGATE per group (one counter for the whole audience —
+per-viewer labels would blow metric cardinality).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from ..media import sockio
+from ..media.gop import GopCache
+from ..media.plane import H264Sink
+from ..media.rtp import BatchedRtpPacketizer, RtpHeaderRewriter, is_pli
+from ..resilience.netadapt import KeyframeGovernor
+from ..utils import env
+from ..utils.dispatch import spawn
+from ..utils.profiling import FrameStats
+
+logger = logging.getLogger(__name__)
+
+
+class _Viewer:
+    """Per-viewer fan-out state — everything a copy of the frame needs
+    beyond the shared packetization: a header-rewrite pass with its own
+    seq space (SRTP's consecutive-seq fast path depends on per-viewer
+    continuity) and ONE of (plain destination addr | secure send hook)."""
+
+    __slots__ = ("viewer_id", "rewriter", "addr", "send_secure")
+
+    def __init__(self, viewer_id, rewriter, addr=None, send_secure=None):
+        self.viewer_id = viewer_id
+        self.rewriter = rewriter
+        self.addr = addr
+        self.send_secure = send_secure
+
+
+class _GroupSocketProtocol(asyncio.DatagramProtocol):
+    """The group's shared UDP socket: TX for every plain viewer's media,
+    RX for their RTCP return channel (the only upstream message honored
+    is "please keyframe" — exactly like _PliListenerProtocol, but one
+    socket serves the whole audience)."""
+
+    def __init__(self, group: "BroadcastGroup"):
+        self._group = group
+        self.transport = None
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        if is_pli(data):
+            self._group.on_viewer_pli(addr=addr)
+
+
+class BroadcastGroup:
+    """Per-publisher broadcast fan-out: one TX media plane, N viewers."""
+
+    def __init__(
+        self,
+        publisher_id: str,
+        *,
+        width: int,
+        height: int,
+        fps: int = 30,
+        use_h264: bool | None = None,
+        ssrc: int = 0x5EED,
+        payload_type: int = 96,
+        stats: FrameStats | None = None,
+        coalesce_s: float | None = None,
+    ):
+        self.publisher_id = publisher_id
+        self.stats = stats or FrameStats()
+        self.gop = GopCache()
+        if coalesce_s is None:
+            coalesce_s = (
+                env.get_float("NETADAPT_PLI_COALESCE_MS", 700.0) / 1e3
+            )
+        self.governor = KeyframeGovernor(coalesce_s=coalesce_s)
+        self._ssrc = ssrc
+        self._payload_type = payload_type
+        self._wh = (width, height)
+        self._fps = fps
+        self._use_h264 = use_h264
+        self._viewers: dict = {}
+        self._by_addr: dict = {}  # plain viewer addr -> viewer_id (PLI map)
+        self._sink: H264Sink | None = None
+        self._track = None
+        self._pump_task: asyncio.Task | None = None
+        self._transport = None
+        self._flush = sockio.CoalescedFlush()
+        # replay/AU-mode packetizer — EVENT LOOP ONLY (the sink's own
+        # packetizer runs on the encode worker; sharing one would race
+        # its pool)
+        self._au_pkt = BatchedRtpPacketizer(
+            ssrc=ssrc, payload_type=payload_type
+        )
+        self.port: int | None = None
+        self.closed = False
+        self.frames = 0  # AUs fanned out (monotonic)
+        # AU mode has no encoder to force: a granted re-sync with an empty
+        # cache escalates here instead (the edge puller sends ONE PLI
+        # upstream to the owning agent — still governed, still no engine)
+        self.idr_fallback = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, track=None) -> None:
+        """Bind the group socket; with ``track`` (a RelayedTrack), start
+        the encode pump — without, the group runs in AU mode (edge pull
+        feeds :meth:`feed_au`)."""
+        loop = asyncio.get_event_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _GroupSocketProtocol(self),
+            local_addr=("0.0.0.0", 0),
+        )
+        self.port = self._transport.get_extra_info("sockname")[1]
+        self._flush.bind(self._transport)
+        if track is not None:
+            self._track = track
+            self._sink = H264Sink(
+                self._wh[0], self._wh[1], fps=self._fps,
+                stats=self.stats, use_h264=self._use_h264,
+                ssrc=self._ssrc, payload_type=self._payload_type,
+                plane_stats=self.stats,
+                au_tap=self._on_au,  # worker thread; GopCache.add is safe
+            )
+            self._pump_task = spawn(self._pump())
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+        if self._track is not None:
+            self._track.stop()
+        if self._sink is not None:
+            self._sink.close()
+        self._flush.close()
+        if self._transport is not None:
+            self._transport.close()
+        self._viewers.clear()
+        self._by_addr.clear()
+
+    # -- viewers -------------------------------------------------------------
+
+    @property
+    def viewer_count(self) -> int:
+        return len(self._viewers)
+
+    def add_viewer(
+        self,
+        viewer_id: str,
+        *,
+        addr=None,
+        send_secure=None,
+        payload_type: int | None = None,
+    ) -> None:
+        """Join one viewer: ``addr`` (plain tier — media + its PLIs ride
+        the GROUP socket) or ``send_secure`` (the viewer session's
+        frame-batch send hook; SRTP/socket stay per-viewer).  A non-None
+        ``payload_type`` is patched per packet (browser offers pick their
+        own H264 PT).  Joining mid-stream replays the cached GOP to THIS
+        viewer only — engine and encoder untouched."""
+        # seq0 rides the replay packetizer's cursor: the join replay below
+        # advances both in lockstep, so in AU mode (live traffic shares
+        # that packetizer) the viewer stays ALIGNED — rewrite's identity
+        # fast path serves it the source views with zero copying.  Frame
+        # mode desyncs at the replay (live seq is the sink's) and pays the
+        # normal copying rewrite; either way correctness is the same.
+        rewriter = RtpHeaderRewriter(
+            ssrc=self._ssrc,
+            payload_type=(
+                payload_type if payload_type != self._payload_type else None
+            ),
+            seq0=self._au_pkt.seq,
+        )
+        v = _Viewer(viewer_id, rewriter, addr=addr, send_secure=send_secure)
+        self._viewers[viewer_id] = v
+        if addr is not None:
+            self._by_addr[tuple(addr)] = viewer_id
+        self.stats.count("broadcast_viewer_joins")
+        snap = self.gop.snapshot()
+        if snap:
+            self._replay(snap, [v])
+        else:
+            # nothing cached yet (pre-first-IDR): one governed encoder
+            # keyframe re-syncs the whole join burst
+            self._request_idr()
+
+    def remove_viewer(self, viewer_id: str) -> None:
+        v = self._viewers.pop(viewer_id, None)
+        if v is not None and v.addr is not None:
+            self._by_addr.pop(tuple(v.addr), None)
+
+    # -- media in ------------------------------------------------------------
+
+    def _on_au(self, au, ts: int) -> None:
+        # encode-worker thread (H264Sink au_tap): the cache stabilizes the
+        # AU bytes itself
+        self.gop.add(au, ts)
+
+    async def _pump(self):
+        """Frame mode: pull the publisher's processed frames ONCE, encode
+        + packetize once, fan the pooled views out to everyone."""
+        try:
+            while not self.closed:
+                frame = await self._track.recv()
+                if self.governor.periodic_due():
+                    self._sink.force_keyframe()
+                pkts = await asyncio.to_thread(self._sink.consume, frame)
+                if pkts:
+                    self.fan_out(pkts)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("broadcast pump failed")
+
+    def feed_au(self, au, ts: int) -> None:
+        """AU mode (edge pull): one depacketized access unit from the
+        owning agent's stream — cache it, packetize ONCE, fan out."""
+        self.gop.add(au, ts)
+        t0 = time.perf_counter()
+        pkts = self._au_pkt.packetize(au, int(ts))
+        self.stats.record_stage("packetize", time.perf_counter() - t0)
+        if pkts:
+            self.fan_out(pkts)
+
+    # -- media out -----------------------------------------------------------
+
+    def fan_out(self, pkts) -> None:
+        """One packetized frame to every viewer: per-viewer header rewrite
+        over the shared pooled views, secure viewers through their own
+        cached-cipher path, the whole plain audience in one sendmmsg
+        burst.  Event loop only (rewriters and the grouped sender are
+        single-threaded by design)."""
+        self.frames += 1
+        if not self._viewers:
+            return
+        t0 = time.perf_counter()
+        batches = []
+        plan = None  # shared gather, computed once for all copying viewers
+        for v in self._viewers.values():
+            rw = v.rewriter
+            if plan is None and not rw.aligned(pkts):
+                plan = rw.plan(pkts)
+            out = rw.rewrite(pkts, plan)
+            if v.send_secure is not None:
+                # protect_frame copies into ciphertext before we return —
+                # safe to hand it the short-lived rewrite views
+                v.send_secure(out)
+            elif v.addr is not None:
+                batches.append((out, v.addr))
+        t1 = time.perf_counter()
+        self.stats.record_stage("rewrite", t1 - t0)
+        if batches:
+            # flush_grouped copies each view into the iovec pool inside
+            # this call — the rewrite views never outlive their pool slot
+            self._flush.flush_grouped(batches)
+            self.stats.record_stage("send", time.perf_counter() - t1)
+        self.stats.count("tx_packets", len(pkts) * len(self._viewers))
+
+    # -- keyframe re-sync (never the engine) ---------------------------------
+
+    def on_viewer_pli(self, viewer_id: str | None = None, addr=None) -> None:
+        """A viewer lost decode state.  Governed: one re-sync per coalesce
+        window no matter how many viewers storm.  Served from the GOP
+        cache when possible (zero engine/encoder work); only an empty
+        cache falls back to ONE governed encoder IDR."""
+        self.stats.count("broadcast_pli")
+        if addr is not None and viewer_id is None:
+            viewer_id = self._by_addr.get(tuple(addr))
+        if not self.governor.request():
+            self.stats.count("broadcast_pli_coalesced")
+            return
+        snap = self.gop.snapshot()
+        if snap:
+            # replay to the whole audience: like a coalesced encoder IDR,
+            # the one granted re-sync inside the window covers every
+            # viewer that stormed (or is about to)
+            self._replay(snap, list(self._viewers.values()))
+        else:
+            self._force_upstream_idr()
+
+    def _request_idr(self) -> None:
+        if self.governor.request():
+            self._force_upstream_idr()
+        else:
+            self.stats.count("broadcast_pli_coalesced")
+
+    def _force_upstream_idr(self) -> None:
+        """Governed, cache-missed re-sync: frame mode forces OUR encoder
+        (one IDR, engine untouched); AU mode escalates to the pull
+        source."""
+        self.stats.count("broadcast_encoder_idr")
+        if self._sink is not None:
+            self._sink.force_keyframe()
+        elif self.idr_fallback is not None:
+            self.idr_fallback()
+
+    def _replay(self, snap, viewers) -> None:
+        """Re-packetize the cached GOP (stable bytes) and deliver it to
+        ``viewers`` — per-viewer seq continues through the same rewriters
+        as live traffic, timestamps are the AUs' originals, and neither
+        the engine nor the encoder is touched."""
+        if not viewers:
+            return
+        self.stats.count("broadcast_gop_replays")
+        t0 = time.perf_counter()
+        for au, ts in snap:
+            pkts = self._au_pkt.packetize(au, ts)
+            if not pkts:
+                continue
+            batches = []
+            plan = None
+            for v in viewers:
+                rw = v.rewriter
+                if plan is None and not rw.aligned(pkts):
+                    plan = rw.plan(pkts)
+                out = rw.rewrite(pkts, plan)
+                if v.send_secure is not None:
+                    v.send_secure(out)
+                elif v.addr is not None:
+                    batches.append((out, v.addr))
+            if batches:
+                self._flush.flush_grouped(batches)
+        self.stats.record_stage("gop_replay", time.perf_counter() - t0)
+
+    # -- observability -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Aggregate gauges for /metrics /health /capacity — O(1) reads,
+        never per-viewer."""
+        return {
+            "viewers": len(self._viewers),
+            "frames": self.frames,
+            "gop_aus": self.gop.aus,
+            "gop_bytes": self.gop.bytes,
+            "gop_idrs": self.gop.idrs,
+            "gop_overflows": self.gop.overflows,
+            "pli_granted": self.governor.granted,
+            "pli_coalesced": self.governor.coalesced,
+            "port": self.port,
+        }
+
+
+class _PullProtocol(asyncio.DatagramProtocol):
+    def __init__(self, puller: "EdgePuller"):
+        self._puller = puller
+
+    def datagram_received(self, data, addr):
+        self._puller.on_datagram(data)
+
+
+class EdgePuller:
+    """The edge agent's ONE pulled copy of a publisher's stream.
+
+    Subscribes to the OWNING agent's /whep as a plain native viewer
+    (JSON-envelope offer, no engine slot charged there either), reorders +
+    reassembles the RTP back into access units, and feeds them to a local
+    AU-mode :class:`BroadcastGroup` — the edge's own viewers fan out from
+    that group, so the owner pays ONE viewer per edge box instead of one
+    per audience member (fleet/router.py places subscriber legs here).
+
+    Keyframe escalation stays governed end to end: a local viewer storm
+    coalesces at the edge group; only a granted-but-cache-missed re-sync
+    sends ONE PLI upstream (the owner's group coalesces again)."""
+
+    def __init__(self, group: BroadcastGroup, owner_url: str,
+                 advertise_host: str | None = None):
+        from ..media.rtp import RtpDepacketizer, RtpReorderBuffer
+
+        self.group = group
+        self.owner_url = owner_url.rstrip("/")
+        self._advertise = advertise_host or env.get_str(
+            "ADVERTISE_HOST", "127.0.0.1"
+        )
+        self._reorder = RtpReorderBuffer()
+        self._depkt = RtpDepacketizer()  # raises without the native runtime
+        self._transport = None
+        self._session_path: str | None = None
+        self._upstream = None  # (host, port) of the owner's group socket
+        self.closed = False
+        self.aus = 0  # access units pulled (monotonic)
+
+    async def open(self) -> "EdgePuller":
+        import aiohttp
+
+        loop = asyncio.get_event_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: _PullProtocol(self), local_addr=("0.0.0.0", 0)
+        )
+        port = self._transport.get_extra_info("sockname")[1]
+        offer = json.dumps(
+            {
+                "native_rtp": True,
+                "video": False,
+                "client_addr": [self._advertise, port],
+            }
+        )
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                f"{self.owner_url}/whep",
+                data=offer,
+                headers={"Content-Type": "application/sdp"},
+            ) as resp:
+                if resp.status not in (200, 201):
+                    raise RuntimeError(
+                        f"owner refused edge pull: HTTP {resp.status}"
+                    )
+                self._session_path = resp.headers.get("Location")
+                body = json.loads(await resp.text())
+        host = self.owner_url.split("://", 1)[-1].split("/", 1)[0]
+        host = host.rsplit(":", 1)[0] or "127.0.0.1"
+        self._upstream = (host, int(body["server_port"]))
+        self.group.idr_fallback = self.request_upstream_idr
+        # a fresh edge has nothing cached: ask the owner for one governed
+        # IDR now so the first local viewer can decode immediately
+        self.request_upstream_idr()
+        return self
+
+    def on_datagram(self, data) -> None:
+        """Owner's RTP in — AUs out to the local group.  Event loop,
+        microseconds per packet (reorder + reassembly, no decode)."""
+        for pkt in self._reorder.push(data):
+            got = self._depkt.push(pkt)
+            if got is not None:
+                self.aus += 1
+                self.group.feed_au(got[0], got[1])
+
+    def request_upstream_idr(self) -> None:
+        if self._transport is not None and self._upstream is not None:
+            from ..media.rtp import make_pli
+
+            self._transport.sendto(make_pli(), self._upstream)
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.group.idr_fallback = None
+        if self._session_path:
+            import aiohttp
+
+            try:
+                async with aiohttp.ClientSession() as http:
+                    await http.delete(f"{self.owner_url}{self._session_path}")
+            except Exception:
+                logger.debug("edge pull DELETE failed", exc_info=True)
+        if self._transport is not None:
+            self._transport.close()
+        self._depkt.close()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 logger = logging.getLogger(__name__)
 
@@ -36,7 +37,13 @@ class RelayedTrack:
         try:
             self._q.put_nowait(frame)
         except asyncio.QueueFull:
-            try:  # latest-wins: drop the stalest frame
+            # latest-wins: drop the stalest frame.  Silent until ISSUE 17 —
+            # per-viewer slowness now shows up on the relay's AGGREGATE
+            # stats (one counter for the whole audience; per-viewer labels
+            # would blow metric cardinality)
+            if self._relay.stats is not None:
+                self._relay.stats.count("broadcast_viewer_drops")
+            try:
                 self._q.get_nowait()
             except asyncio.QueueEmpty:
                 pass
@@ -51,6 +58,16 @@ class RelayedTrack:
         frame = await self._q.get()
         if frame is None:
             raise ConnectionError("relay ended")
+        stats = self._relay.stats
+        if stats is not None:
+            wall = getattr(frame, "wall_ts", None)
+            if wall is not None:
+                # freshness: decode-stamp age at the moment a subscriber
+                # takes delivery — its p99 is the audience's worst-case
+                # staleness (stage_snapshot_us at /metrics)
+                stats.record_stage(
+                    "broadcast_freshness", time.monotonic() - wall
+                )
         return frame
 
     def stop(self):
@@ -67,8 +84,12 @@ class RelayedTrack:
 class TrackRelay:
     """Fan one source track out to any number of subscribers."""
 
-    def __init__(self, source):
+    def __init__(self, source, stats=None):
+        """``stats``: optional FrameStats shared by ALL subscribers —
+        drop counts and freshness land here in aggregate (never keyed by
+        viewer)."""
         self.source = source
+        self.stats = stats
         self._subs: list[RelayedTrack] = []
         self._task: asyncio.Task | None = None
 

@@ -548,6 +548,11 @@ class NativeRtpPeerConnection:
         # agent's session wiring; None = no quality ladder on this session
         self.netadapt = None
         self.kf_governor = None
+        # broadcast fan-out (server/broadcast.py, ISSUE 17): set by
+        # join_broadcast() BEFORE setRemoteDescription — this session is
+        # then a viewer of a shared TX plane instead of owning a private
+        # sink/pump; PLIs route to the group's governed re-sync
+        self._broadcast_group = None
         provider.register_plane_session(self.pc_id, self.plane_stats, pc=self)
 
     # -- events --------------------------------------------------------------
@@ -698,12 +703,21 @@ class NativeRtpPeerConnection:
             and self._secure_session is None
             and self._client_addr is not None
         ):
-            # pure send side (WHEP viewer): bind the send socket NOW so the
-            # answer advertises ITS port — the viewer's RTCP PLI must have a
-            # reachable target or keyframe recovery never engages
-            # (code-review r3)
-            await self._ensure_send_transport()
-            self.server_port = self._send_transport.get_extra_info("sockname")[1]
+            if self._broadcast_group is not None:
+                # broadcast viewer (plain tier): no private socket at all —
+                # media arrives FROM the group socket and the viewer's
+                # RTCP PLI goes back TO it, so that's the port the answer
+                # must advertise
+                self.server_port = self._broadcast_group.port
+            else:
+                # pure send side (WHEP viewer): bind the send socket NOW so
+                # the answer advertises ITS port — the viewer's RTCP PLI
+                # must have a reachable target or keyframe recovery never
+                # engages (code-review r3)
+                await self._ensure_send_transport()
+                self.server_port = (
+                    self._send_transport.get_extra_info("sockname")[1]
+                )
 
     async def createAnswer(self):
         if self._sdp_offer is not None:
@@ -847,6 +861,12 @@ class NativeRtpPeerConnection:
         """RTCP-PLI handler: the viewer dropped a frame — next encode is
         IDR.  Under network adaptation the keyframe governor coalesces
         storms: N PLIs inside one window cost ONE IDR."""
+        if self._broadcast_group is not None:
+            # broadcast viewer (secure tier — its PLIs arrive on its own
+            # demuxed socket): re-sync is the GROUP's governed GOP replay,
+            # never this session's sink
+            self._broadcast_group.on_viewer_pli(self.pc_id)
+            return
         if self.kf_governor is not None and not self.kf_governor.request():
             return
         if self._sink is not None:
@@ -867,7 +887,35 @@ class NativeRtpPeerConnection:
         )
         self._plain_flush.bind(self._send_transport)
 
+    def join_broadcast(self, group) -> None:
+        """Make this session a VIEWER of a shared broadcast TX plane
+        (server/broadcast.py) — call before setRemoteDescription.  The
+        session then never builds a private sink or pump; registration
+        with the group happens in _start_senders (after the transports
+        the viewer tier needs exist)."""
+        self._broadcast_group = group
+
     async def _start_senders(self):
+        if self._broadcast_group is not None:
+            group = self._broadcast_group
+            if self._secure_session is not None:
+                # secure viewer: SRTP + socket stay per-session (the
+                # cached-cipher frame path); only encode/packetize are
+                # shared.  The group hands rewritten views straight to
+                # send_media_batch, which protects (copies) before return.
+                group.add_viewer(
+                    self.pc_id,
+                    send_secure=self._recv_protocol.send_media_batch,
+                    payload_type=self._h264_pt,
+                )
+            elif self._client_addr is not None:
+                # plain viewer: media + return RTCP ride the group socket
+                group.add_viewer(
+                    self.pc_id,
+                    addr=self._client_addr,
+                    payload_type=self._h264_pt,
+                )
+            return
         if not self.out_tracks:
             return
         if self._secure_session is None:
@@ -1006,6 +1054,9 @@ class NativeRtpPeerConnection:
             return
         self.connectionState = "closed"
         self._provider.unregister_plane_session(self.pc_id)
+        if self._broadcast_group is not None:
+            self._broadcast_group.remove_viewer(self.pc_id)
+            self._broadcast_group = None
         for t in self._sender_tasks:
             t.cancel()
         if self._sctp_timer_task is not None:

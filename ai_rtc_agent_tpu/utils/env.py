@@ -167,6 +167,33 @@ def migrate_enabled() -> bool:
     return get_bool("MIGRATE_ENABLE", True)
 
 
+def broadcast_fanout_enabled() -> bool:
+    """Broadcast TX plane (server/broadcast.py): WHEP viewers of a
+    native-provider stream share ONE encode/packetize pass and pay only a
+    header rewrite + (SRTP) + sendmmsg slot each.  ``BROADCAST_FANOUT=0``
+    restores the dedicated per-viewer chain (one private H264Sink and
+    pump per viewer); the remaining BROADCAST_* knobs are read by the
+    group and GOP cache themselves."""
+    return get_bool("BROADCAST_FANOUT", True)
+
+
+def broadcast_max_viewers() -> int:
+    """Viewer admission cap per agent (BROADCAST_MAX_VIEWERS): /whep
+    answers 503 + Retry-After past it.  Viewers don't charge engine
+    slots, so this bounds TX fan-out cost (rewrite + send per viewer),
+    not compute.  0 = uncapped."""
+    return max(0, get_int("BROADCAST_MAX_VIEWERS", 256))
+
+
+def broadcast_edge_pull_enabled() -> bool:
+    """Two-level fan-out at the fleet tier (fleet/router.py): subscriber
+    legs placed on non-owner agents trigger ONE pulled copy of the
+    publisher's stream to that edge (POST /broadcast/pull), so audience
+    size stops being a single-box property.  ``BROADCAST_EDGE_PULL=0``
+    pins every viewer onto the owning agent instead."""
+    return get_bool("BROADCAST_EDGE_PULL", True)
+
+
 def batchsched_enabled() -> bool:
     """Continuous cross-session batch scheduler (stream/scheduler.py) —
     the default single-device serving path.  BATCHSCHED=0 restores the

@@ -262,6 +262,16 @@ while true; do
   # are the accelerator trajectory, never the CPU fallback)
   run_item "meshsched_dp8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/mesh_sched_bench.py
   run_item "meshsched_dp8_w8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= QUANT_WEIGHTS=w8 QUANT_MIN_SIZE=256 python -u scripts/mesh_sched_bench.py
+  # ISSUE 17 broadcast fan-out ON THE TPU BOX: with libavcodec present
+  # the dedicated baseline pays a REAL per-viewer H.264 encode, so the
+  # amortization ratio here is the paper-facing number (the committed
+  # CPU rows price the NullCodec tier, where encode is a memcpy and the
+  # per-viewer kernel send dominates both legs).  The measurement is
+  # host-side; --probe-backend stamps the box's real backend so the
+  # banking filter's backend refusal stays honest, and --metric picks
+  # the one line each row banks (run_item keeps only the last line).
+  run_item "broadcast_fanout_n32" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/broadcast_bench.py --probe-backend --metric=broadcast_viewers_per_core_30fps
+  run_item "broadcast_fanout_1v" 1200 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/broadcast_bench.py --probe-backend --metric=broadcast_single_viewer_overhead_ratio
   run_item "multipeer4" 2400 python -u bench.py --config multipeer --frames 80 --peers 4
   # below-capacity occupancy: VERDICT r2 weak #5 hardware proof (1 of 8
   # claimed slots must cost ~1 peer of step time via the bucket path)

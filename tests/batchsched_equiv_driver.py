@@ -29,6 +29,10 @@ ISSUE 12 legs:
 * The fbs leg (in the default run): scheduler ``frame_buffer_size=2`` —
   sessions x consecutive frames as TWO batch dimensions of one bucket
   step — vs dedicated fbs=2 engines, bit-exact (``EQUIV_FBS_OK <n>``).
+
+ISSUE 17 budget shave: ``--leg dense`` runs ONLY the dense drive (no
+variant legs) — the lighter tier-1 sibling; the full composition (w8 +
+DeepCache + fbs, each re-tracing k=4/2/1) runs in the slow tier.
 """
 
 import os
@@ -274,7 +278,7 @@ def drive_fbs(bundle) -> int:
     return compared
 
 
-def main():
+def main(variants=True):
     bundle = registry.load_model_bundle("tiny-test")
     # 8 sub-timesteps with a single stage so update_t_index_list([5]) is a
     # REAL coefficient change (a 1-step schedule only admits index 0)
@@ -371,6 +375,12 @@ def main():
     sched.close()
 
     # --- ISSUE 9 variant legs: same drive, quantized + cached-cadence ---
+    # (skipped for --leg dense: each variant re-traces the full k=4/2/1
+    # geometry set, which is most of this driver's wall clock — the dense
+    # leg alone is the tier-1 sibling, the composition runs in slow)
+    if not variants:
+        print(f"EQUIV_OK {compared}")
+        return
     os.environ["QUANT_WEIGHTS"] = "w8"
     os.environ["QUANT_MIN_SIZE"] = "256"  # tiny-model kernels are small
     try:
@@ -403,5 +413,7 @@ def main():
 if __name__ == "__main__":
     if "--leg" in sys.argv and "sharded" in sys.argv:
         drive_sharded()
+    elif "--leg" in sys.argv and "dense" in sys.argv:
+        main(variants=False)
     else:
         main()

@@ -308,6 +308,54 @@ def test_host_plane_bench_contract_and_speedup(tmp_path):
     assert banked and banked[-1]["metric"] == "host_plane_batched_speedup"
 
 
+def test_broadcast_bench_contract(tmp_path):
+    """Broadcast fan-out bench smoke (ISSUE 17): runs in seconds on CPU,
+    emits exactly TWO contract lines (amortization + single-viewer
+    overhead), BANKS both, and the bench contract pin holds: the PLI
+    storm fired inside the fan-out leg produced exactly one GOP replay
+    and zero encoder IDRs.  No ratio fence here beyond sanity — the
+    amortization claim is measured by a full run (perf_compare fences
+    the banked numbers); what this catches is the harness rotting."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "PERF_LOG_PATH": str(log),
+            "BROADCAST_BENCH_FRAMES": "4",
+            "BROADCAST_BENCH_VIEWERS": "4",
+            "BROADCAST_BENCH_DIM": "64",
+            "BROADCAST_BENCH_PAIRS": "2",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/broadcast_bench.py"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 2, r.stdout
+    by_metric = {json.loads(ln)["metric"]: json.loads(ln) for ln in lines}
+    assert set(by_metric) == {
+        "broadcast_viewers_per_core_30fps",
+        "broadcast_single_viewer_overhead_ratio",
+    }
+    for d in by_metric.values():
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in d, d
+        assert "error" not in d, d
+        assert d["value"] > 0, d
+        assert d["fingerprint"]["jax_backend"] == "unprobed"  # host bench
+    assert by_metric["broadcast_viewers_per_core_30fps"]["unit"] == "viewers"
+    # the bench-contract half of the acceptance pin: the in-harness PLI
+    # storm coalesced to ONE gop replay, ZERO encoder/engine IDRs
+    d = by_metric["broadcast_viewers_per_core_30fps"]
+    assert d["pli_storm"] == {"replays": 1, "encoder_idrs": 0}
+    banked = {json.loads(x)["metric"] for x in log.read_text().splitlines()}
+    assert banked == set(by_metric)
+
+
 def test_trace_overhead_bench_contract(tmp_path):
     """Tracing-overhead microbench smoke (ISSUE 5): runs in seconds on
     CPU, emits exactly one contract line, BANKS it into PERF_LOG_PATH,

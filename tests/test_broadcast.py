@@ -1,0 +1,528 @@
+"""Broadcast fan-out plane (ISSUE 17): wire-identity of the per-viewer
+header rewrite, GOP-cache re-sync semantics, PLI-storm governance, the
+grouped sendmmsg burst, and the /whep viewer-cap integration.
+
+The tentpole claim these tests pin: N viewers of one publisher cost ONE
+encode + packetize, a header rewrite each, and zero engine/encoder work
+on re-sync.
+"""
+
+import asyncio
+import json
+import socket
+import struct
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from ai_rtc_agent_tpu.media import native
+from ai_rtc_agent_tpu.media.codec import NullCodec
+from ai_rtc_agent_tpu.media.gop import GopCache, au_is_idr
+from ai_rtc_agent_tpu.media.rtp import (
+    BatchedRtpPacketizer,
+    RtpHeaderRewriter,
+    RtpReorderBuffer,
+    split_nals,
+)
+from ai_rtc_agent_tpu.media.sockio import BatchSender
+from ai_rtc_agent_tpu.resilience import faults
+from ai_rtc_agent_tpu.server.broadcast import BroadcastGroup
+
+rng = np.random.default_rng(17)
+
+
+def _mkau(sizes, sc=4):
+    au = b""
+    for i, s in enumerate(sizes):
+        code = b"\x00\x00\x00\x01" if (i % 2 == 0 or sc == 4) else b"\x00\x00\x01"
+        au += (
+            code
+            + bytes([0x65 if s > 200 else 0x67])
+            + rng.integers(0, 256, s - 1, dtype=np.uint8).tobytes()
+        )
+    return au
+
+
+def _traw_idr(n=64):
+    """A NullCodec-tier access unit (all-intra — an IDR boundary)."""
+    return b"\x00\x00\x00\x01" + NullCodec.MAGIC + bytes(range(256))[:n]
+
+
+def _delta_au(n=64):
+    """A non-IDR H264 AU (NAL type 1, coded slice of a non-IDR picture)."""
+    return b"\x00\x00\x00\x01" + bytes([0x61]) + b"\x42" * n
+
+
+# ---------------------------------------------------------------------------
+# header-rewrite wire identity (satellite 2)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "au,stap",
+    [
+        (_mkau([31]), False),            # single NALU
+        (_mkau([31, 5001]), False),      # small + FU-A fragmentation
+        (_mkau([9, 12, 3000, 7, 8]), True),  # STAP-A aggregation
+    ],
+    ids=["single-nal", "fu-a", "stap-a"],
+)
+def test_rewrite_wire_identity(au, stap):
+    """A rewritten frame is byte-identical to a dedicated per-viewer
+    packetize of the same AU — SSRC, seq space, ts offset and PT are the
+    ONLY fields the viewer leg owns; marker bits, FU-A framing and
+    STAP-A layout ride through the copy untouched."""
+    src = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=96, stap_a=stap)
+    ded = BatchedRtpPacketizer(ssrc=0xBEEF, payload_type=102, stap_a=stap)
+    ded.seq = 777
+    rw = RtpHeaderRewriter(
+        ssrc=0xBEEF, payload_type=102, seq0=777, ts_offset=1234
+    )
+    for i in range(3):  # seq continuity across frames too
+        ts = 9000 + i * 3000
+        pkts = src.packetize(au, ts)
+        want = ded.packetize(au, (ts + 1234) & 0xFFFFFFFF)
+        got = rw.rewrite(pkts)
+        assert [bytes(p) for p in got] == [bytes(p) for p in want], i
+    assert rw.seq == ded.seq
+
+
+def test_rewrite_touches_only_header_fields():
+    """Field isolation: masking seq/ts/ssrc/PT out of both sides leaves
+    source and rewritten packets equal byte-for-byte."""
+    src = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=96)
+    rw = RtpHeaderRewriter(ssrc=0xBEEF, payload_type=102, seq0=9, ts_offset=7)
+    pkts = src.packetize(_mkau([31, 5001]), 12345)
+    out = rw.rewrite(pkts)
+    for a, b in zip(pkts, out):
+        a, b = bytearray(bytes(a)), bytearray(bytes(b))
+        for buf in (a, b):
+            buf[1] = buf[1] & 0x80  # PT (marker bit kept)
+            buf[2:12] = bytes(10)   # seq + ts + ssrc
+        assert a == b
+
+
+def test_rewrite_identity_fast_path_and_desync():
+    """An aligned viewer (same SSRC/PT, seq cursor matching the source)
+    gets the SOURCE views back — zero copies; a desynced cursor drops it
+    to the copying path for good."""
+    src = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=96)
+    rw = RtpHeaderRewriter(ssrc=0x5EED, seq0=src.seq)
+    for i in range(2):
+        pkts = src.packetize(_mkau([31, 3000]), i * 3000)
+        assert rw.aligned(pkts)
+        out = rw.rewrite(pkts)
+        assert all(o is p for o, p in zip(out, pkts))  # the very objects
+        assert rw.seq == src.seq  # cursor advanced in lockstep
+    rw.seq = (rw.seq + 5) & 0xFFFF  # a GOP replay desyncs the cursor
+    pkts = src.packetize(_mkau([31]), 9000)
+    assert not rw.aligned(pkts)
+    out = rw.rewrite(pkts)
+    assert out[0] is not pkts[0]
+    assert bytes(out[0])[4:] == bytes(pkts[0])[4:]  # ts+ssrc+payload equal
+    assert bytes(out[0])[2:4] != bytes(pkts[0])[2:4]  # own seq space
+
+
+def test_rewrite_plan_shared_across_viewers():
+    """fan_out computes plan() once per frame; passing it to every
+    copying viewer must give the same bytes as a solo rewrite."""
+    src = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=96)
+    pkts = src.packetize(_mkau([31, 5001, 12]), 3000)
+    a = RtpHeaderRewriter(ssrc=0xA, seq0=1)
+    b = RtpHeaderRewriter(ssrc=0xB, seq0=2, ts_offset=99)
+    a2 = RtpHeaderRewriter(ssrc=0xA, seq0=1)
+    b2 = RtpHeaderRewriter(ssrc=0xB, seq0=2, ts_offset=99)
+    plan = a.plan(pkts)
+    assert [bytes(p) for p in a.rewrite(pkts, plan)] == [
+        bytes(p) for p in a2.rewrite(pkts)
+    ]
+    assert [bytes(p) for p in b.rewrite(pkts, plan)] == [
+        bytes(p) for p in b2.rewrite(pkts)
+    ]
+
+
+def test_rewrite_pooled_views_survive_fault_injector():
+    """Pooled-view stabilization pinned through the fault injector
+    (satellite 2): rewritten views pushed through a deterministic
+    reorder plan — which makes the downstream reorder buffer HOLD
+    packets while the rewriter's 2-slot pool keeps wrapping — must
+    still reassemble every AU byte-correct (copy-on-hold discipline)."""
+    if native.load() is None:
+        pytest.skip("native lib unavailable (depacketizer half)")
+    from ai_rtc_agent_tpu.media.rtp import RtpDepacketizer
+
+    plan = faults.FaultPlan(
+        # start=1: the first packet passes clean so the reorder buffer
+        # syncs its cursor to the true seq0 (as a live session does on
+        # its first in-order packet); everything after is pairwise-swapped
+        specs=(faults.FaultSpec(target="rx", kind="reorder", p=1.0,
+                                start=1),),
+        seed=3,
+    )
+    faults.activate(plan)
+    try:
+        scope = faults.scope("rx")
+        src = BatchedRtpPacketizer(ssrc=0x5EED, mtu=600, pool_slots=2)
+        rw = RtpHeaderRewriter(ssrc=0x7777, seq0=0, pool_slots=2)
+        rb = RtpReorderBuffer()
+        d = RtpDepacketizer()
+        aus = [_mkau([31, 5001]), _mkau([1400, 40]), _mkau([2000]),
+               _mkau([12, 13, 1200, 9], sc=3)]
+        # trailing flush AU: the scope may end a burst still HOLDING the
+        # last packet; only the first len(aus) outputs are asserted
+        feed = aus + [_mkau([25])]
+        got = []
+        try:
+            for ci, au in enumerate(feed):
+                for p in rw.rewrite(src.packetize(au, 1000 + ci)):
+                    for data, _delay in scope.apply(p):
+                        for pkt in rb.push(data):
+                            r = d.push(pkt)
+                            if r is not None:
+                                got.append(r)
+        finally:
+            d.close()
+        assert scope.stats["reorder"] > 0  # the plan actually fired
+        want = [
+            (
+                b"".join(
+                    b"\x00\x00\x00\x01" + au[s:e] for s, e in split_nals(au)
+                ),
+                1000 + ci,
+            )
+            for ci, au in enumerate(aus)
+        ]
+        assert [(bytes(a), ts) for a, ts in got[:len(aus)]] == want
+    finally:
+        faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# GOP cache (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_gop_cache_idr_boundary_detection():
+    assert au_is_idr(_traw_idr())          # NullCodec all-intra tier
+    assert au_is_idr(_mkau([500]))         # NAL type 5 (0x65)
+    assert not au_is_idr(_delta_au())      # NAL type 1
+
+
+def test_gop_cache_gop_membership_and_stabilization():
+    c = GopCache(max_aus=16)
+    assert not c.add(_delta_au(), 0)  # mid-GOP, no IDR yet: stays empty
+    assert c.aus == 0
+    assert c.add(_traw_idr(), 100)
+    c.add(_delta_au(), 200)
+    c.add(_delta_au(), 300)
+    assert c.aus == 3 and c.idrs == 1
+    # a new IDR starts a NEW GOP: the old one is gone
+    assert c.add(_traw_idr(), 400)
+    assert c.aus == 1 and c.idrs == 2
+    assert c.snapshot() == [(_traw_idr(), 400)]
+    # pooled-view discipline: add() must stabilize to bytes (this IDR
+    # view becomes the new GOP head, then its backing is scribbled)
+    backing = bytearray(_traw_idr())
+    c.add(memoryview(backing), 500)
+    backing[:] = b"\x00" * len(backing)
+    assert c.snapshot() == [(_traw_idr(), 500)]
+
+
+def test_gop_cache_overflow_clears_whole_and_rearms():
+    c = GopCache(max_aus=3)
+    c.add(_traw_idr(), 0)
+    c.add(_delta_au(), 1)
+    c.add(_delta_au(), 2)
+    assert c.aus == 3 and c.overflows == 0
+    c.add(_delta_au(), 3)  # 4th AU: the GOP outgrew the cache
+    # whole-cache clear — an IDR-less tail can't re-sync anyone
+    assert c.aus == 0 and c.overflows == 1
+    c.add(_delta_au(), 4)  # still mid-GOP: stays empty
+    assert c.aus == 0
+    assert c.add(_traw_idr(), 5)  # next boundary re-arms
+    assert c.aus == 1
+
+    b = GopCache(max_bytes=len(_traw_idr()) + 10)
+    b.add(_traw_idr(), 0)
+    b.add(_delta_au(), 1)  # byte bound exceeded
+    assert b.aus == 0 and b.overflows == 1
+
+
+# ---------------------------------------------------------------------------
+# BroadcastGroup: fan-out + PLI-storm governance (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _counts(group):
+    snap = group.stats.stage_snapshot_us()
+    return {k: v for k, v in snap.items() if k.endswith("_total")}
+
+
+def test_pli_storm_one_idr_zero_engine_steps():
+    """The acceptance pin: a PLI storm from 16 viewers produces exactly
+    ONE granted re-sync (a GOP-cache replay) and ZERO engine/encoder
+    work — no sink exists, and the upstream-IDR escalation hook is never
+    called."""
+
+    async def go():
+        group = BroadcastGroup("pub", width=8, height=8, coalesce_s=60.0)
+        await group.start()  # AU mode: no track, no sink, no engine
+        engine_calls = []
+        group.idr_fallback = lambda: engine_calls.append(1)
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.setblocking(False)
+        try:
+            group.feed_au(_traw_idr(), 0)
+            group.feed_au(_delta_au(), 3000)
+            for i in range(16):
+                group.add_viewer(f"v{i}", addr=rx.getsockname())
+            assert group.viewer_count == 16
+            replays0 = _counts(group).get("broadcast_gop_replays_total", 0)
+            granted0 = group.governor.granted
+            for i in range(16):
+                group.on_viewer_pli(viewer_id=f"v{i}")
+            c = _counts(group)
+            assert group.governor.granted - granted0 == 1
+            assert c.get("broadcast_gop_replays_total", 0) - replays0 == 1
+            assert c.get("broadcast_pli_coalesced_total", 0) == 15
+            assert c.get("broadcast_pli_total", 0) == 16
+            # zero engine/encoder touches: no sink, no upstream escalation
+            assert group._sink is None
+            assert c.get("broadcast_encoder_idr_total", 0) == 0
+            assert engine_calls == []
+        finally:
+            rx.close()
+            await group.close()
+
+    asyncio.run(go())
+
+
+def test_group_fan_out_delivers_and_patches_pt():
+    """AU-mode fan-out: each viewer's wire bytes equal a DEDICATED
+    packetizer run in that viewer's own seq/PT space — join replay and
+    live traffic form one continuous stream per viewer, and the shared
+    replay packetizer's cursor accounts for AUs the viewer never saw."""
+
+    async def go():
+        group = BroadcastGroup("pub", width=8, height=8, coalesce_s=60.0)
+        await group.start()
+        rxs = []
+        for _ in range(2):
+            rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            rx.bind(("127.0.0.1", 0))
+            rx.setblocking(False)
+            rxs.append(rx)
+        try:
+            group.feed_au(_traw_idr(), 0)  # arms the cache (group seq 0)
+            group.add_viewer("plain", addr=rxs[0].getsockname())
+            group.add_viewer(
+                "pt102", addr=rxs[1].getsockname(), payload_type=102
+            )
+            # each join replayed the cached GOP (group seq 1 then 2);
+            # now one live AU (group seq 3)
+            group.feed_au(_delta_au(), 3000)
+            # viewer "plain" joined at group seq 1 and stays identity-
+            # aligned through its replay, then continues in its own seq
+            # space: exactly a dedicated packetizer starting at seq 1
+            ref = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=96)
+            ref.seq = 1
+            want_plain = [bytes(p) for p in ref.packetize(_traw_idr(), 0)]
+            want_plain += [bytes(p) for p in ref.packetize(_delta_au(), 3000)]
+            # viewer "pt102" joined one replay later (seq 2) with its own
+            # negotiated PT — always the copying path
+            ded = BatchedRtpPacketizer(ssrc=0x5EED, payload_type=102)
+            ded.seq = 2
+            want_pt = [bytes(p) for p in ded.packetize(_traw_idr(), 0)]
+            want_pt += [bytes(p) for p in ded.packetize(_delta_au(), 3000)]
+
+            async def drain(rx, n):
+                got = []
+                for _ in range(100):
+                    try:
+                        while True:
+                            got.append(rx.recv(4096))
+                    except BlockingIOError:
+                        if len(got) >= n:
+                            break
+                        await asyncio.sleep(0.01)
+                return got
+
+            got_plain = await drain(rxs[0], len(want_plain))
+            got_pt = await drain(rxs[1], len(want_pt))
+            assert got_plain == want_plain
+            assert got_pt == want_pt
+            assert all(g[1] & 0x7F == 102 for g in got_pt)
+            snap = group.snapshot()
+            assert snap["viewers"] == 2 and snap["gop_idrs"] == 1
+        finally:
+            for rx in rxs:
+                rx.close()
+            await group.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# grouped sendmmsg burst (media/sockio.py)
+# ---------------------------------------------------------------------------
+
+def _rx_sock():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.setblocking(False)
+    return rx
+
+
+def _drain_sync(rx, n):
+    got = []
+    for _ in range(200):
+        try:
+            while True:
+                got.append(rx.recv(4096))
+        except BlockingIOError:
+            if len(got) >= n:
+                break
+            asyncio.run(asyncio.sleep(0.005))
+    return got
+
+
+def test_send_grouped_duplicate_batch_iovec_reuse():
+    """Aligned viewers hand send_grouped the SAME pkts list object; the
+    duplicate batches must still deliver full, correct bytes to every
+    destination (their iovecs are word-copied from the first staging,
+    never re-staged) — and fresh content on the next burst must not leak
+    the previous staging."""
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.setblocking(False)
+    rx1, rx2, rx3 = _rx_sock(), _rx_sock(), _rx_sock()
+    try:
+        sender = BatchSender(use_sendmmsg=True)
+        shared = [b"A" * 100, b"B" * 700, b"C" * 33]
+        other = [b"D" * 50]
+        batches = [
+            (shared, rx1.getsockname()),
+            (shared, rx2.getsockname()),  # duplicate list object
+            (other, rx3.getsockname()),
+        ]
+        sent = sender.send_grouped(tx, batches)
+        assert sent == 7
+        assert _drain_sync(rx1, 3) == shared
+        assert _drain_sync(rx2, 3) == shared
+        assert _drain_sync(rx3, 1) == other
+        # same layout, new bytes: the span-signature skip must only skip
+        # the msg_name writes, never the byte staging
+        shared2 = [b"x" * 100, b"y" * 700, b"z" * 33]
+        batches2 = [
+            (shared2, rx1.getsockname()),
+            (shared2, rx2.getsockname()),
+            (other, rx3.getsockname()),
+        ]
+        assert sender.send_grouped(tx, batches2) == 7
+        assert _drain_sync(rx1, 3) == shared2
+        assert _drain_sync(rx2, 3) == shared2
+    finally:
+        for s in (tx, rx1, rx2, rx3):
+            s.close()
+
+
+def test_send_grouped_then_uniform_send_rewrites_names():
+    """A grouped burst leaves per-entry msg_names behind; the next
+    uniform-destination send() must not spray packets at stale viewer
+    addresses."""
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    tx.setblocking(False)
+    rx1, rx2 = _rx_sock(), _rx_sock()
+    try:
+        sender = BatchSender(use_sendmmsg=True)
+        sender.send_grouped(tx, [([b"g" * 20], rx1.getsockname()),
+                                 ([b"h" * 20], rx2.getsockname())])
+        _drain_sync(rx1, 1), _drain_sync(rx2, 1)
+        pkts = [b"u%d" % i * 10 for i in range(4)]
+        assert sender.send(tx, pkts, rx1.getsockname()) == 4
+        assert _drain_sync(rx1, 4) == pkts
+        assert _drain_sync(rx2, 0) == []
+    finally:
+        for s in (tx, rx1, rx2):
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# /whep integration: viewers stop charging engine slots (tentpole wiring)
+# ---------------------------------------------------------------------------
+
+def test_whep_broadcast_viewer_cap_and_gauges(monkeypatch):
+    """Viewer admission is the BROADCAST_MAX_VIEWERS cap (503 +
+    Retry-After past it), never an engine slot; the audience reads as
+    aggregate gauges on /capacity, /health and /metrics; and a closed
+    viewer releases its slot."""
+    if native.load() is None:
+        pytest.skip("native lib unavailable")
+    from ai_rtc_agent_tpu.server.agent import build_app
+    from ai_rtc_agent_tpu.server.rtc_native import NativeRtpProvider
+
+    monkeypatch.setenv("WARMUP_FRAMES", "0")
+    monkeypatch.setenv("BROADCAST_MAX_VIEWERS", "1")
+
+    class InvertPipeline:
+        def __call__(self, frame):
+            arr = frame if isinstance(frame, np.ndarray) else frame.to_ndarray()
+            return 255 - arr
+
+    async def go():
+        provider = NativeRtpProvider(use_h264=native.h264_available())
+        app = build_app(pipeline=InvertPipeline(), provider=provider)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/whip",
+                data=json.dumps(
+                    {"native_rtp": True, "video": True, "width": 64,
+                     "height": 64}
+                ),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+
+            def whep_offer(port):
+                return json.dumps(
+                    {"native_rtp": True, "video": False,
+                     "client_addr": ["127.0.0.1", port]}
+                )
+
+            r = await client.post(
+                "/whep", data=whep_offer(39001),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            loc = r.headers["Location"]
+            groups = app["state"]["broadcast_groups"]
+            assert sum(g.viewer_count for g in groups.values()) == 1
+
+            r = await client.post(
+                "/whep", data=whep_offer(39002),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 503
+            assert r.headers["Retry-After"] == "2"
+
+            for path in ("/capacity", "/health"):
+                body = await (await client.get(path)).json()
+                b = body["broadcast"]
+                assert b["broadcast_viewers"] == 1
+                assert b["broadcast_max_viewers"] == 1
+                assert b["broadcast_viewer_slots_free"] == 0
+            m = await (await client.get("/metrics")).json()
+            assert m["broadcast"]["broadcast_viewers"] == 1
+
+            # closing the viewer releases its slot for the next join
+            r = await client.delete(loc)
+            assert r.status == 200
+            r = await client.post(
+                "/whep", data=whep_offer(39003),
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+        finally:
+            await client.close()
+
+    asyncio.run(go())

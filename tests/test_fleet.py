@@ -195,7 +195,8 @@ def test_retry_after_hint_is_soonest_agent():
 
 class FakeAgent:
     """Minimal agent surface the router drives: /offer (+X-Stream-Id),
-    /whip, /capacity, /health, /drain — with a switchable 503 mode."""
+    /whip, /whep, /broadcast/pull, /capacity, /health, /drain — with a
+    switchable 503 mode."""
 
     def __init__(self, name, capacity=2, retry_after=7):
         self.name = name
@@ -203,9 +204,10 @@ class FakeAgent:
         self.retry_after = retry_after
         self.mode = "ok"
         self.fail_delete = False  # transient 5xx mode for DELETE
+        self.refuse_pull = False  # 409 mode for /broadcast/pull
         self.sessions: dict = {}
-        self.hits = {"offer": 0, "whip": 0, "drain": [], "delete": [],
-                     "flight": []}
+        self.hits = {"offer": 0, "whip": 0, "whep": 0, "pull": [],
+                     "drain": [], "delete": [], "flight": []}
         # journey fragments served at GET /debug/flight?journey= —
         # {journey_id: fragment-dict}, set by tests simulating an agent
         # that holds records for the journey
@@ -246,6 +248,22 @@ class FakeAgent:
                 status=201, text="answer-sdp",
                 headers={"Location": f"/whip/{sid}"},
             )
+
+        async def whep(req):
+            self.hits["whep"] += 1
+            sid = f"{self.name}-v{len(self.sessions) + 1}"
+            self.sessions[sid] = {}
+            return web.Response(
+                status=201, text="answer-sdp",
+                headers={"Location": f"/whep/{sid}"},
+            )
+
+        async def broadcast_pull(req):
+            body = await req.json()
+            self.hits["pull"].append(body["owner_url"])
+            if self.refuse_pull:
+                return web.Response(status=409, text="fan-out disabled")
+            return web.json_response({"publisher": "default"})
 
         async def whip_delete(req):
             sid = req.match_info["session"]
@@ -290,6 +308,8 @@ class FakeAgent:
         app.router.add_get("/debug/flight", debug_flight)
         app.router.add_post("/whip", whip)
         app.router.add_delete("/whip/{session}", whip_delete)
+        app.router.add_post("/whep", whep)
+        app.router.add_post("/broadcast/pull", broadcast_pull)
         app.router.add_get("/capacity", capacity)
         app.router.add_get("/health", health)
         app.router.add_post("/drain", drain)
@@ -450,6 +470,78 @@ def test_whip_location_and_routed_delete():
         finally:
             await client.close()
             await a.close()
+
+    run(go())
+
+
+def test_whep_edge_pull_places_viewer_off_owner():
+    """ISSUE 17 two-level fan-out: a /whep viewer lands on a NON-owner
+    edge agent after the router arranges its single pulled copy of the
+    publisher's stream (idempotent POST /broadcast/pull), so per-box
+    viewer caps multiply across the fleet."""
+    async def go():
+        a = await FakeAgent("a").start()
+        b = await FakeAgent("b").start()
+        app, client, reg = await _router([a, b])
+        try:
+            r = await client.post(
+                "/whip", data="v=0 m=video",
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            owner = a if a.hits["whip"] else b
+            edge = b if owner is a else a
+            r = await client.post(
+                "/whep", data="viewer-offer",
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            # the edge was told where to pull from, then got the viewer
+            assert edge.hits["pull"] == [f"http://127.0.0.1:{owner.port}"]
+            assert edge.hits["whep"] == 1 and owner.hits["whep"] == 0
+            sid = r.headers["Location"].rsplit("/", 1)[-1]
+            assert app["session_table"].owner(sid) == edge.name
+            m = await (await client.get("/metrics")).json()
+            assert m["fleet_edge_pulls_total"] == 1
+        finally:
+            await client.close()
+            await a.close()
+            await b.close()
+
+    run(go())
+
+
+def test_whep_edge_pull_refusal_falls_back_to_owner():
+    """An edge that refuses the pull (fan-out disabled there — 409) must
+    not strand the viewer: the placement falls back to the owning agent,
+    which is always correct, just not scaled out."""
+    async def go():
+        a = await FakeAgent("a").start()
+        b = await FakeAgent("b").start()
+        app, client, reg = await _router([a, b])
+        try:
+            r = await client.post(
+                "/whip", data="v=0 m=video",
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            owner = a if a.hits["whip"] else b
+            edge = b if owner is a else a
+            edge.refuse_pull = True
+            r = await client.post(
+                "/whep", data="viewer-offer",
+                headers={"Content-Type": "application/sdp"},
+            )
+            assert r.status == 201
+            assert len(edge.hits["pull"]) == 1
+            assert owner.hits["whep"] == 1 and edge.hits["whep"] == 0
+            m = await (await client.get("/metrics")).json()
+            assert m["fleet_edge_pull_refused_total"] == 1
+            assert "fleet_edge_pulls_total" not in m
+        finally:
+            await client.close()
+            await a.close()
+            await b.close()
 
     run(go())
 
