@@ -217,12 +217,15 @@ def run_checkers(project: Project, checkers=None) -> list:
         device_transfers,
         encoder_reconfig,
         env_registry,
+        http_contract,
         lock_discipline,
         loop_affinity,
         metric_cardinality,
         metrics_registry,
         pooled_views,
+        refusal_discipline,
         regressions,
+        reservation_pairing,
         span_pairing,
         task_lifecycle,
         trace_purity,
@@ -244,6 +247,9 @@ def run_checkers(project: Project, checkers=None) -> list:
         "metrics-registry": metrics_registry.check,
         "retry-4xx": regressions.check_retry_4xx,
         "restart-defaults": regressions.check_restart_defaults,
+        "http-contract": http_contract.check,
+        "refusal-discipline": refusal_discipline.check,
+        "reservation-pairing": reservation_pairing.check,
     }
     findings = []
     ran = tuple(checkers or registry)
@@ -270,6 +276,9 @@ ALL_CHECKERS = (
     "metrics-registry",
     "retry-4xx",
     "restart-defaults",
+    "http-contract",
+    "refusal-discipline",
+    "reservation-pairing",
 )
 
 

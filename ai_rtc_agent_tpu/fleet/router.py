@@ -59,6 +59,7 @@ import uuid
 from aiohttp import web
 
 from ..resilience.retry import RetryError, RetryPolicy
+from ..server import wire
 from ..server.events import StreamEventHandler
 from ..utils import env
 from ..utils.profiling import FrameStats
@@ -67,12 +68,16 @@ from .registry import AutoscaleController, FleetPoller, FleetRegistry
 
 logger = logging.getLogger(__name__)
 
-# response headers worth carrying back through the proxy verbatim
-# (X-Stream-Id included: a client can only act on an AGENT_DEAD webhook
-# if it knows which stream id was ITS session; X-Journey-Id/-Leg are the
-# cross-process correlation key the client echoes on a re-offer)
-_PASS_HEADERS = ("Content-Type", "Location", "Retry-After", "X-Stream-Id",
-                 "X-Journey-Id", "X-Journey-Leg")
+def _refuse_503(text: str, retry_after: float) -> web.Response:
+    """The router's ONE refusal constructor: every fleet-side 503 carries
+    a Retry-After so clients back off instead of hammering (the same
+    contract the agent's ``_overloaded_response`` holds — enforced by the
+    refusal-discipline checker on both planes)."""
+    return web.Response(
+        status=503,
+        text=text,
+        headers={wire.RETRY_AFTER: str(max(1, int(round(retry_after))))},
+    )
 
 
 def _parse_retry_after(value: str | None) -> float | None:
@@ -197,7 +202,7 @@ async def _place_and_proxy(request: web.Request, path: str,
     leg = 1
     pinned = pin
     if journeys is not None:
-        echoed = request.headers.get("X-Journey-Id")
+        echoed = request.headers.get(wire.JOURNEY_ID)
         if journeys.known(echoed):
             journey_id = echoed
             leg = journeys.next_leg(echoed)
@@ -214,11 +219,11 @@ async def _place_and_proxy(request: web.Request, path: str,
                 cand = reg.agents.get(mig["target"])
                 if cand is not None and cand.state != "DEAD":
                     pinned = cand
-                    headers["X-Migrated-Session"] = mig["token"]
+                    headers[wire.MIGRATED_SESSION] = mig["token"]
         else:
             journey_id = journeys.mint()
-        headers["X-Journey-Id"] = journey_id
-        headers["X-Journey-Leg"] = str(leg)
+        headers[wire.JOURNEY_ID] = journey_id
+        headers[wire.JOURNEY_LEG] = str(leg)
 
     tried: set = set()
     hint: float | None = None
@@ -228,7 +233,7 @@ async def _place_and_proxy(request: web.Request, path: str,
         else:
             # only the pinned target holds the imported state — every
             # fallback placement must claim fresh, not adopt
-            headers.pop("X-Migrated-Session", None)
+            headers.pop(wire.MIGRATED_SESSION, None)
             rec = reg.pick(exclude=tried)
         if rec is None:
             break
@@ -242,7 +247,7 @@ async def _place_and_proxy(request: web.Request, path: str,
                     # the agent's counted admission gate refused — honor
                     # ITS hint before this agent is ever offered again,
                     # then re-place on the next-best agent
-                    ra = _parse_retry_after(resp.headers.get("Retry-After"))
+                    ra = _parse_retry_after(resp.headers.get(wire.RETRY_AFTER))
                     if ra is None:
                         ra = rec.retry_after_s or app["retry_after_s"]
                     rec.saturated = True
@@ -260,8 +265,8 @@ async def _place_and_proxy(request: web.Request, path: str,
                     continue
                 if 200 <= resp.status < 300:
                     reg.note_placed(rec)
-                    sid = resp.headers.get("X-Stream-Id") or (
-                        _session_from_location(resp.headers.get("Location"))
+                    sid = resp.headers.get(wire.STREAM_ID) or (
+                        _session_from_location(resp.headers.get(wire.LOCATION))
                     )
                     if sid:
                         app["session_table"].remember(
@@ -281,12 +286,12 @@ async def _place_and_proxy(request: web.Request, path: str,
                             )
                 out_headers = {
                     k: resp.headers[k]
-                    for k in _PASS_HEADERS if k in resp.headers
+                    for k in wire.PASS_HEADERS if k in resp.headers
                 }
                 if journey_id is not None and 200 <= resp.status < 300:
                     # stamp even when the agent tier predates the echo
-                    out_headers.setdefault("X-Journey-Id", journey_id)
-                    out_headers.setdefault("X-Journey-Leg", str(leg))
+                    out_headers.setdefault(wire.JOURNEY_ID, journey_id)
+                    out_headers.setdefault(wire.JOURNEY_LEG, str(leg))
                 return web.Response(
                     status=resp.status, body=payload, headers=out_headers
                 )
@@ -302,11 +307,7 @@ async def _place_and_proxy(request: web.Request, path: str,
     retry = hint if hint is not None else reg.retry_after_hint(
         app["retry_after_s"]
     )
-    return web.Response(
-        status=503,
-        text="fleet saturated",
-        headers={"Retry-After": str(max(1, int(round(retry))))},
-    )
+    return _refuse_503("fleet saturated", retry)
 
 
 async def offer(request):
@@ -412,10 +413,7 @@ async def fleet_register(request):
     except ValueError as e:
         return web.Response(status=400, text=str(e))
     if rec is None:
-        return web.Response(
-            status=503, text="registry full",
-            headers={"Retry-After": str(int(request.app["retry_after_s"]))},
-        )
+        return _refuse_503("registry full", request.app["retry_after_s"])
     return web.json_response(
         {"agent_id": rec.agent_id, "agents": len(request.app["fleet"].agents)}
     )

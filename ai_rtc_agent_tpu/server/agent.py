@@ -45,7 +45,7 @@ from ..resilience.supervisor import (
 from ..utils import env
 from ..utils.dispatch import spawn
 from ..utils.profiling import FrameStats
-from . import turn
+from . import turn, wire
 from .events import StreamEventHandler
 from .signaling import get_provider
 from .tracks import VideoStreamTrack
@@ -70,11 +70,11 @@ def _parse_journey(app, request) -> dict | None:
     or with ``JOURNEY_ENABLE=0``."""
     if not app.get("journey_enabled", True):
         return None
-    journey_id = request.headers.get("X-Journey-Id")
+    journey_id = request.headers.get(wire.JOURNEY_ID)
     if not journey_id:
         return None
     try:
-        leg = max(1, int(request.headers.get("X-Journey-Leg", "1")))
+        leg = max(1, int(request.headers.get(wire.JOURNEY_LEG, "1")))
     except ValueError:
         leg = 1
     return {
@@ -108,8 +108,8 @@ def _journey_headers(meta: dict | None) -> dict:
     if not meta:
         return {}
     return {
-        "X-Journey-Id": meta["journey_id"],
-        "X-Journey-Leg": str(meta["leg"]),
+        wire.JOURNEY_ID: meta["journey_id"],
+        wire.JOURNEY_LEG: str(meta["leg"]),
     }
 
 
@@ -365,7 +365,7 @@ def _overloaded_response(
     return web.Response(
         status=503,
         text=text,
-        headers={"Retry-After": str(max(1, int(round(retry_after))))},
+        headers={wire.RETRY_AFTER: str(max(1, int(round(retry_after))))},
     )
 
 
@@ -513,7 +513,7 @@ def _admit_or_adopt(app, request, stream_id: str):
     import already paid the counted gate) and, when the import restored
     scheduler state, that session is adopted instead of a fresh claim.
     -> (imported session | None, rejection response | None)."""
-    token = request.headers.get("X-Migrated-Session")
+    token = request.headers.get(wire.MIGRATED_SESSION)
     entry = None
     if token:
         _expire_imported(app)
@@ -526,6 +526,7 @@ def _admit_or_adopt(app, request, stream_id: str):
             if ov is not None else True
         )
     if not adopted:
+        # tpurtc: allow[reservation-pairing] -- the admitted reservation deliberately outlives this helper: ownership transfers to the caller (offer/whip), which consumes it via on_track's register_session or releases it via _release_admission/_end_supervision on every failure path
         rejected = _admission_gate(app, stream_id)
         if rejected is not None:
             if entry is not None and entry.get("session") is not None:
@@ -1028,7 +1029,7 @@ async def offer(request):
         # their Location headers) so DELETEs route back and a crash can
         # re-point exactly the affected clients; the journey echo
         # confirms the correlation id was threaded end to end
-        headers={"X-Stream-Id": stream_id, **_journey_headers(jmeta)},
+        headers={wire.STREAM_ID: stream_id, **_journey_headers(jmeta)},
     )
 
 
@@ -1190,19 +1191,21 @@ async def whep(request):
     if env.broadcast_fanout_enabled() and hasattr(pc, "join_broadcast"):
         group = await _ensure_broadcast_group(app)
     if group is None and source_track is None:
-        # edge-pulled stream exists but this provider can't join a group
+        # edge-pulled stream exists but this provider can't join a group —
+        # the ONE refusal that used to ship without Retry-After (the
+        # refusal-discipline checker's real-world fixture shape): an edge
+        # whose group is still warming refuses exactly like a saturated
+        # box, and the client must know when to come back
         await _discard_pc(pc, pcs)
-        return web.Response(
-            status=503, text="edge stream requires the broadcast plane"
+        return _overloaded_response(
+            app, "edge stream requires the broadcast plane"
         )
     if group is not None:
         cap = env.broadcast_max_viewers()
         if cap and group.viewer_count >= cap:
             await _discard_pc(pc, pcs)
-            return web.Response(
-                status=503,
-                headers={"Retry-After": "2"},
-                text="broadcast viewer capacity reached",
+            return _overloaded_response(
+                app, "broadcast viewer capacity reached", retry_after=2.0
             )
         pc.join_broadcast(group)
 
@@ -1263,7 +1266,7 @@ async def whep(request):
         headers={
             "Access-Control-Allow-Origin": "*",
             "Access-Control-Allow-Headers": "*",
-            "Location": f"/whep/{session_id}",
+            wire.LOCATION: f"/whep/{session_id}",
             # viewers carry the correlation id too (the router placed
             # this leg); no recorder binds — a WHEP leg has no pipeline
             **_journey_headers(_parse_journey(app, request)),
@@ -1402,7 +1405,7 @@ async def whip(request):
         headers={
             "Access-Control-Allow-Origin": "*",
             "Access-Control-Allow-Headers": "*",
-            "Location": f"/whip/{session_id}",
+            wire.LOCATION: f"/whip/{session_id}",
             **_journey_headers(jmeta),
         },
         text=answer.sdp,

@@ -43,6 +43,7 @@ from ..resilience.netadapt import KeyframeGovernor
 from ..utils import env
 from ..utils.dispatch import spawn
 from ..utils.profiling import FrameStats
+from . import wire
 
 logger = logging.getLogger(__name__)
 
@@ -436,7 +437,7 @@ class EdgePuller:
                     raise RuntimeError(
                         f"owner refused edge pull: HTTP {resp.status}"
                     )
-                self._session_path = resp.headers.get("Location")
+                self._session_path = resp.headers.get(wire.LOCATION)
                 body = json.loads(await resp.text())
         host = self.owner_url.split("://", 1)[-1].split("/", 1)[0]
         host = host.rsplit(":", 1)[0] or "127.0.0.1"

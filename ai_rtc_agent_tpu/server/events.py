@@ -21,6 +21,25 @@ from ..utils import env
 
 logger = logging.getLogger(__name__)
 
+#: the CLOSED webhook vocabulary, machine-checked by the
+#: refusal-discipline checker (analysis/refusal_discipline.py): a
+#: ``Stream*`` event-name literal or a SCREAMING state literal anywhere
+#: in package code must be a member — the webhook plane's analog of the
+#: metric-cardinality closed-enum rule.  Literal frozensets on purpose:
+#: the checker AST-parses them out of this file.
+EVENT_NAMES = frozenset({
+    "StreamStarted", "StreamEnded", "StreamDegraded",
+    "StreamRecovered", "StreamMigrated",
+})
+STATE_NAMES = frozenset({
+    # supervisor states (resilience/supervisor.py)
+    "HEALTHY", "DEGRADED", "RECOVERING", "FAILED",
+    # fleet agent states (fleet/registry.py AGENT_STATES)
+    "DRAINING", "DEAD",
+    # breach + lifecycle states ridden by StreamDegraded (docs/fleet.md)
+    "SLO_BREACH", "RETRACE_BREACH", "AGENT_DEAD", "AGENT_RECYCLED",
+})
+
 
 class WebhookEvent(BaseModel):
     """``journey_id``/``journey_leg`` are the fleet's cross-process

@@ -1,0 +1,41 @@
+"""The cross-process HTTP wire vocabulary — ONE closed constants module.
+
+Every header name that crosses a process boundary (router → agent
+forwarding, agent → client answers, worker → router publishes, the
+EdgePuller's WHEP leg) lives here and nowhere else.  The fleet router's
+``_PASS_HEADERS`` tuple used to carry its own copies of these strings;
+an agent adding a header the router's tuple didn't know about silently
+dropped it at the proxy — exactly the drift class a single constants
+module kills.  The ``http-contract`` checker
+(ai_rtc_agent_tpu/analysis/http_contract.py) enforces adoption: a raw
+header-name literal in any headers context outside this module is a
+finding, and the route surface itself is registered in docs/http-api.md
+(both directions, like docs/environment.md for env knobs).
+
+``Content-Type`` and ``Authorization`` are deliberately NOT enforced —
+they are universal HTTP vocabulary, not this system's wire contract —
+but ``PASS_HEADERS`` still names Content-Type so the proxy carries
+media types through.
+"""
+
+from __future__ import annotations
+
+# correlation + identity (fleet/journey.py, docs/fleet.md)
+JOURNEY_ID = "X-Journey-Id"      # router-minted per placed session
+JOURNEY_LEG = "X-Journey-Leg"    # 1-based hop count within a journey
+STREAM_ID = "X-Stream-Id"        # the agent's server-side session id
+MIGRATED_SESSION = "X-Migrated-Session"  # adoption token for a migrated
+                                         # client's re-offer (docs/fleet.md)
+
+# standard names with system-specific semantics
+RETRY_AFTER = "Retry-After"      # every 503 carries one (refusal-discipline)
+LOCATION = "Location"            # WHIP/WHEP answer: /whip/<session> etc.
+
+#: response headers the fleet router carries back through the proxy
+#: verbatim (X-Stream-Id included: a client can only act on an AGENT_DEAD
+#: webhook if it knows which stream id was ITS session; X-Journey-Id/-Leg
+#: are the cross-process correlation key the client echoes on a re-offer)
+PASS_HEADERS = (
+    "Content-Type", LOCATION, RETRY_AFTER, STREAM_ID,
+    JOURNEY_ID, JOURNEY_LEG,
+)

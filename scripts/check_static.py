@@ -104,14 +104,18 @@ def main(argv=None) -> int:
             return 0
     if files is not None:
         # a partial scan set cannot prove registry completeness (unread
-        # knobs / metric collisions live across files) — per-file rules only
-        # (the concurrency trio resolves same-module/same-class and is
-        # per-file by construction)
+        # knobs / metric collisions / undocumented routes live across
+        # files) — per-file rules only, so env-registry, metrics-registry
+        # and http-contract run full-scan only (the concurrency trio
+        # resolves same-module/same-class and is per-file by construction;
+        # refusal-discipline degrades gracefully when server/events.py is
+        # outside the scan set)
         checkers = ("async-blocking", "bounded-queue", "device-transfer",
                     "encoder-reconfig", "lock-discipline", "loop-affinity",
                     "metric-cardinality", "pooled-view", "span-pairing",
                     "task-lifecycle", "trace-purity", "retry-4xx",
-                    "restart-defaults")
+                    "restart-defaults", "refusal-discipline",
+                    "reservation-pairing")
 
     project, parse_errors = load_project(root, files=files)
     findings = list(parse_errors) + run_checkers(project, checkers)

@@ -3,8 +3,10 @@
 #
 # Two rungs, fast first:
 #   1. the git-scoped analyzer pass over exactly what you touched
-#      (check_static --changed: per-file checkers, suppression hygiene,
-#      baseline discipline — seconds);
+#      (check_static --changed: per-file checkers incl. the wire-contract
+#      pair refusal-discipline + reservation-pairing, suppression
+#      hygiene, baseline discipline — seconds; the cross-file registry
+#      checkers, http-contract among them, need the full scan in rung 2);
 #   2. the full static-analysis tier-1 gate in-process
 #      (tests/test_static_analysis.py: every checker against its
 #      known-bad fixture, precision pins, AND the repo-wide

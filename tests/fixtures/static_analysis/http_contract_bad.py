@@ -1,0 +1,52 @@
+"""Known-bad fixture for the http-contract checker.
+
+``_PASS_HEADERS`` is the drift shape this checker exists for: the fleet
+router shipped its OWN copy of the agent's response-header names, and a
+header the copy didn't know about was silently dropped at the proxy.
+Plus: an undocumented route registration, client calls targeting paths
+the docs/http-api.md registry doesn't know, and raw header literals
+where server/wire.py constants are required.  Every ``ok_*`` spelling
+must stay clean.
+"""
+
+from aiohttp import web  # fixture: parsed, never imported
+
+from ai_rtc_agent_tpu.server import wire
+
+# BAD twice over: a local copy of the pass-through set, carrying one
+# raw wire literal (X-Stream-Id -> use wire.STREAM_ID) and one header
+# wire.py has never heard of
+_PASS_HEADERS = ("Content-Type", "X-Stream-Id", "X-Edge-Hint")
+
+
+def build_bad_app(handler):
+    app = web.Application()
+    app.router.add_post("/not/in/registry", handler)  # BAD: undocumented
+    app.router.add_get("/capacity", handler)  # ok: documented
+    return app
+
+
+async def bad_clients(http, base):
+    await http.post(base + "/offerz")  # BAD: typo'd path, 404s live
+    resp = await http.get("http://127.0.0.1:8080/capacityz")  # BAD
+    return resp
+
+
+def bad_headers(request, resp):
+    jid = request.headers.get("X-Journey-Id")  # BAD: wire.JOURNEY_ID
+    resp.headers["X-Edge-Hint"] = "1"  # BAD: unregistered X- header
+    return web.Response(headers={"X-Edge-Hint": jid or ""})  # BAD
+
+
+async def ok_clients(http, base, session):
+    await http.post(base + "/offer")
+    await http.get(base + "/capacity")
+    await http.delete(f"{base}/whip/{session}")  # dynamic tail: skipped
+    return await http.get("http://127.0.0.1:8080/health")
+
+
+def ok_headers(request, out_headers, jmeta):
+    jid = request.headers.get(wire.JOURNEY_ID)
+    out_headers[wire.STREAM_ID] = jmeta["stream_id"]
+    ct = request.headers.get("Content-Type")  # universal: free
+    return jid, ct

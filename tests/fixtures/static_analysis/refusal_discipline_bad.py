@@ -1,0 +1,86 @@
+"""Known-bad fixture for the refusal-discipline checker.
+
+``whep_refusal_bad`` is the pre-fix server/agent.py whep edge-refusal
+VERBATIM — a bare 503 with no Retry-After, built inline instead of
+through ``_overloaded_response``: the exact shipped bug the checker
+exists to make unshippable.  The vocab functions exercise the closed
+EVENT_NAMES / STATE_NAMES webhook enums.  Every ``ok_*`` spelling must
+stay clean.
+"""
+
+from aiohttp import web  # fixture: parsed, never imported
+
+
+async def whep_refusal_bad(request, app):
+    # the shipped shape: ad-hoc 503, Retry-After forgotten
+    if app.get("broadcast") is None:
+        return web.Response(
+            status=503, text="edge stream requires the broadcast plane"
+        )
+    return web.Response(text="ok")
+
+
+def _overloaded_response(app, text="overloaded", retry_after=None):
+    # the blessed helper itself forgetting the header is ALSO a finding
+    return web.Response(status=503, text=text)
+
+
+async def adhoc_with_header_still_bad(request):
+    # carrying Retry-After does not excuse bypassing the helper: one
+    # constructor per plane, or drift returns
+    return web.Response(
+        status=503, text="busy", headers={"Retry-After": "2"}
+    )
+
+
+def aiohttp_exc_bad():
+    raise web.HTTPServiceUnavailable(text="nope")
+
+
+def bad_event(handler, stream_id, room_id):
+    handler.send_request("StreamExploded", stream_id, room_id)
+
+
+def bad_state_kwarg(ev_cls):
+    return ev_cls(state="TOTALLY_BROKEN")
+
+
+def bad_state_positional(handler, stream_id, room_id):
+    handler.handle_session_state(stream_id, room_id, "KINDA_BAD", "x")
+
+
+def bad_state_compare(rec):
+    if rec.state == "ZOMBIE":
+        return True
+    return rec.state in ("HEALTHY", "UNDEAD")
+
+
+def bad_state_dict(reason):
+    return {"state": "WAT_BROKE", "reason": reason}
+
+
+def bad_state_assign(rec):
+    rec.state = "EXTREMELY_DEAD"
+
+
+def _refuse_503(text, retry_after):
+    # the router-plane helper done right: 503 + Retry-After, in-helper
+    return web.Response(
+        status=503, text=text, headers={"Retry-After": str(retry_after)}
+    )
+
+
+def ok_vocab(handler, stream_id, room_id, rec):
+    handler.send_request("StreamMigrated", stream_id, room_id)
+    handler.handle_session_state(stream_id, room_id, "DEGRADED", "slo")
+    rec.state = "DRAINING"
+    if rec.state in ("HEALTHY", "FAILED"):
+        return {"state": "RECOVERING"}
+    return None
+
+
+def ok_non_state_screaming(flag):
+    # SCREAMING literals OUTSIDE state contexts are free — env knob
+    # names, modes, log levels
+    mode = "DEBUG" if flag else "RELEASE"
+    return mode

@@ -1,0 +1,126 @@
+"""Known-bad fixture for the reservation-pairing checker.
+
+``gate_leak_except_path`` is the PR 4 ``_end_supervision`` leak shape:
+a counted admission taken, then an error path that returns without ever
+releasing it — the box's session budget shrinks by one forever.
+``gate_leak_refusal_without_release`` is the PR 15 shape: gate, fail a
+later step, refuse — without ``_release_admission``.  Every ``ok_*``
+spelling (release on all paths, park into app state, closure handoff,
+return-of-key, ``*_locked`` convention) must stay clean.
+"""
+
+from aiohttp import web  # fixture: parsed, never imported
+
+
+async def gate_leak_except_path(app, request, make_pc):
+    # the PR 4 shape
+    stream_id = "s"
+    rejected = _admission_gate(app, stream_id)
+    if rejected is not None:
+        return rejected
+    try:
+        pc = make_pc(request)
+    except ValueError:
+        # BAD: error path returns without _release_admission
+        return web.Response(status=400, text="bad sdp")
+    register_session(app, stream_id, pc)
+    return web.Response(text="ok")
+
+
+async def gate_leak_refusal_without_release(app, request):
+    # the PR 15 shape: the refusal return does NOT discharge a keyed
+    # gate — only _release_admission does
+    stream_id = "s"
+    rejected = _admission_gate(app, stream_id)
+    if rejected is not None:
+        return rejected
+    pipeline, release_pipeline = await _claim_pipeline(app)
+    if pipeline is None:
+        # BAD: admission still counted while we turn the client away
+        return _overloaded_response(app, "slots full")
+    release_pipeline()
+    _release_admission(app, stream_id)
+    return web.Response(text="ok")
+
+
+async def claim_leak_on_error(app, request, negotiate):
+    pipeline, release_pipeline = await _claim_pipeline(app)
+    if pipeline is None:
+        return _overloaded_response(app, "slots full")  # ok: held nothing
+    if not negotiate(request):
+        # BAD: engine slot held forever
+        return web.Response(status=400, text="bad offer")
+    release_pipeline()
+    return web.Response(text="ok")
+
+
+async def gate_leak_raise_path(app, payload):
+    token = "rcy-1"
+    rejected = _admission_gate(app, token)
+    if rejected is not None:
+        return rejected
+    if not payload:
+        # BAD: raises straight out, gate still counted
+        raise ValueError("bad payload")
+    _release_admission(app, token)
+    return web.Response(text="ok")
+
+
+async def ok_released_everywhere(app, request, make_pc):
+    stream_id = "s"
+    rejected = _admission_gate(app, stream_id)
+    if rejected is not None:
+        return rejected
+    try:
+        pc = make_pc(request)
+    except ValueError:
+        _release_admission(app, stream_id)
+        return web.Response(status=400, text="bad sdp")
+    except BaseException:
+        _release_admission(app, stream_id)
+        raise
+    register_session(app, stream_id, pc)
+    return web.Response(text="ok")
+
+
+async def ok_parked_into_app_state(app, snap):
+    token = "mig-1"
+    rejected = _admission_gate(app, token)
+    if rejected is not None:
+        return rejected
+    # the reservation now lives in app state (the import park): a later
+    # adopt or expiry sweep owns it
+    app["imported"][token] = {"snap": snap}
+    return web.Response(text="ok")
+
+
+async def ok_closure_handoff(app, request, pc):
+    stream_id = "s"
+    rejected = _admission_gate(app, stream_id)
+    if rejected is not None:
+        return rejected
+
+    def on_track(track):
+        # the aiortc event handler consumes the reservation long after
+        # this request handler returned
+        register_session(app, stream_id, track)
+
+    pc.on("track", on_track)
+    return web.Response(text="ok")
+
+
+async def ok_finally_release(app, key, work):
+    rejected = _admission_gate(app, key)
+    if rejected is not None:
+        return rejected
+    try:
+        await work()
+    finally:
+        _release_admission(app, key)
+    return web.Response(text="ok")
+
+
+def _sweep_locked(app, token):
+    # *_locked: the caller holds the pairing discipline
+    rejected = _admission_gate(app, token)
+    return rejected

@@ -123,6 +123,13 @@ def test_sharded_equivalence_subprocess():
     assert int(lines[0].split()[1]) >= 15
 
 
+# slow tier (ISSUE 18 budget shave): prewarm=True compiles every
+# (k, variant, dp) geometry before the churn even starts — most of this
+# test's wall clock; tier-1 keeps
+# test_shard_aware_bucket_keys_and_prewarm_coverage below, which pins
+# the same prewarm-coverage + shard-keyed-executable mechanism without
+# the compile bill
+@pytest.mark.slow
 def test_sharded_churn_never_retraces(bundle):
     """ISSUE 12 acceptance pin: a prewarmed dp-sharded scheduler serves a
     join -> leave -> rejoin churn (control-plane writes and a restart

@@ -502,6 +502,157 @@ def test_fixed_sources_are_clean():
     assert run_checkers(project, ("retry-4xx", "restart-defaults")) == []
 
 
+# -- the wire-contract trio (ISSUE 18) ---------------------------------------
+
+WIRE = REPO / "ai_rtc_agent_tpu" / "server" / "wire.py"
+EVENTS = REPO / "ai_rtc_agent_tpu" / "server" / "events.py"
+
+
+def run_on_with(names, checkers, extra):
+    """run_on, with real repo modules added to the scan set (the wire /
+    events vocabulary the registry checkers parse their closed sets
+    from)."""
+    files = [str(FIXTURES / n) for n in names] + [str(p) for p in extra]
+    project, errs = load_project(REPO, files=files)
+    assert not errs, errs
+    fs = run_checkers(project, checkers)
+    return [f for f in fs if "fixtures/static_analysis" in f.path]
+
+
+def test_refusal_discipline_reproduces_the_whep_503_bug():
+    """The pre-fix agent.py whep edge-refusal — a bare 503 with no
+    Retry-After — is the fixture shape; every ad-hoc / helper-drift /
+    vocab spelling fires, every ok_* spelling stays clean."""
+    fs = run_on_with(
+        ["refusal_discipline_bad.py"], ("refusal-discipline",), [EVENTS]
+    )
+    scopes = {f.scope for f in fs}
+    assert "whep_refusal_bad" in scopes  # the shipped bug, verbatim
+    assert "_overloaded_response" in scopes  # helper forgot the header
+    assert "adhoc_with_header_still_bad" in scopes  # bypassed the helper
+    assert "aiohttp_exc_bad" in scopes  # HTTPServiceUnavailable spelling
+    names = {f.name for f in fs}
+    assert "StreamExploded" in names
+    assert {"TOTALLY_BROKEN", "KINDA_BAD", "ZOMBIE", "UNDEAD",
+            "WAT_BROKE", "EXTREMELY_DEAD"} <= names
+    # member states never fire, SCREAMING outside state contexts is free
+    assert "HEALTHY" not in names and "DEBUG" not in names
+    assert not any(s.startswith(("ok_", "_refuse")) for s in scopes), scopes
+    msgs = " | ".join(f.message for f in fs)
+    assert "Retry-After" in msgs and "STATE_NAMES" in msgs
+
+
+def test_reservation_pairing_reproduces_the_pr4_and_pr15_leaks():
+    """The thrice-shipped leak class: gate taken, an exit path that never
+    releases/consumes/parks it.  Exception edges and refusal returns are
+    modeled; park, closure handoff, finally-release and *_locked stay
+    clean."""
+    fs = run_on(["reservation_pairing_bad.py"], ("reservation-pairing",))
+    scopes = {f.scope for f in fs}
+    assert "gate_leak_except_path" in scopes  # PR 4 shape
+    assert "gate_leak_refusal_without_release" in scopes  # PR 15 shape
+    assert "claim_leak_on_error" in scopes
+    assert "gate_leak_raise_path" in scopes
+    assert all(s.startswith(("gate_leak", "claim_leak")) for s in scopes), (
+        scopes
+    )
+    # findings anchor at the ACQUIRE line (one suppression covers all
+    # leaking paths of that take)
+    src = (FIXTURES / "reservation_pairing_bad.py").read_text().splitlines()
+    assert all(
+        "_admission_gate(" in src[f.line - 1]
+        or "_claim_pipeline(" in src[f.line - 1]
+        for f in fs
+    ), [f.render() for f in fs]
+    msgs = " | ".join(f.message for f in fs)
+    assert "PR 4/15" in msgs
+
+
+def test_http_contract_reproduces_the_pass_headers_drift():
+    """The router's local _PASS_HEADERS copy of the agent's header names
+    is the mechanized drift class: raw wire literals, unregistered X-
+    headers, undocumented routes and typo'd client paths all fire; wire
+    constants, documented routes and dynamic tails stay clean."""
+    fs = run_on_with(
+        ["http_contract_bad.py"], ("http-contract",), [WIRE]
+    )
+    names = {f.name for f in fs}
+    assert "X-Stream-Id" in names  # raw wire literal in the drift tuple
+    assert "X-Edge-Hint" in names  # header wire.py has never heard of
+    assert "X-Journey-Id" in names  # raw literal at a .get() site
+    assert "POST /not/in/registry" in names  # undocumented route
+    assert "POST /offerz" in names  # typo'd client path
+    assert any("capacityz" in n for n in names)  # loopback typo
+    scopes = {f.scope for f in fs}
+    assert not any(s.startswith("ok_") for s in scopes), scopes
+    # documented + matching spellings never fire
+    assert "GET /capacity" not in names
+    assert "POST /offer" not in names and "GET /health" not in names
+    msgs = " | ".join(f.message for f in fs)
+    assert "docs/http-api.md" in msgs and "wire.STREAM_ID" in msgs
+
+
+def test_http_contract_registry_is_bidirectional(tmp_path):
+    """A registered-but-undocumented route fails, and a documented row
+    with no registration fails too — the doc can never rot in either
+    direction."""
+    root = tmp_path
+    (root / "ai_rtc_agent_tpu").mkdir()
+    (root / "docs").mkdir()
+    (root / "ai_rtc_agent_tpu" / "srv.py").write_text(
+        "def build(app, h):\n"
+        "    app.router.add_post('/live', h)\n"
+        "    app.router.add_get('/only-in-code', h)\n"
+    )
+    (root / "docs" / "http-api.md").write_text(
+        "| Method | Path |\n|---|---|\n"
+        "| `POST` | `/live` |\n"
+        "| `GET` | `/only-in-doc` |\n"
+    )
+    project, errs = load_project(root)
+    assert not errs
+    fs = run_checkers(project, ("http-contract",))
+    names = {f.name for f in fs}
+    assert names == {"GET /only-in-code", "GET /only-in-doc"}, [
+        f.render() for f in fs
+    ]
+    doc_side = [f for f in fs if f.path == "docs/http-api.md"]
+    assert len(doc_side) == 1 and doc_side[0].scope == "<doc>"
+
+
+def test_reservation_pairing_suppression_and_the_live_handoff_site():
+    """The one deliberate ownership escape in the repo — _admit_or_adopt
+    hands its admission to the caller — carries a reasoned allow; the
+    suppression really is exercised (removing it would fail the repo
+    gate), and the fixed agent/router/broadcast sources scan clean under
+    the whole trio."""
+    files = [
+        str(REPO / "ai_rtc_agent_tpu" / "server" / "agent.py"),
+        str(REPO / "ai_rtc_agent_tpu" / "fleet" / "router.py"),
+        str(REPO / "ai_rtc_agent_tpu" / "server" / "broadcast.py"),
+        str(EVENTS), str(WIRE),
+    ]
+    project, errs = load_project(REPO, files=files)
+    assert not errs
+    fs = run_checkers(
+        project, ("refusal-discipline", "reservation-pairing")
+    )
+    assert fs == [], "\n".join(f.render() for f in fs)
+    # the allow is live, not decorative: the un-suppressed run contains
+    # exactly the _admit_or_adopt handoff finding
+    agent = project.module("ai_rtc_agent_tpu/server/agent.py")
+    from ai_rtc_agent_tpu.analysis import reservation_pairing
+
+    raw = [
+        f for f in reservation_pairing.check(project)
+        if f.path == "ai_rtc_agent_tpu/server/agent.py"
+    ]
+    assert len(raw) == 1 and "_admit_or_adopt" in raw[0].scope, [
+        f.render() for f in raw
+    ]
+    assert agent.suppression_for("reservation-pairing", raw[0].line)
+
+
 # -- suppression mechanics ---------------------------------------------------
 
 def test_suppression_with_reason_passes_without_reason_fails():
