@@ -64,6 +64,8 @@ JOURNEY_EVENTS = (
     "upgraded",      # the session moved as a rolling-upgrade sweep step
     "scaled",        # the session moved because the autoscaler retired
                      # its (emptiest) agent
+    "evacuated",     # the session moved because its agent's engine guard
+                     # exhausted rebuilds (POST /fleet/evacuate)
     "ended",         # StreamEnded webhook arrived
     "evidence",      # an agent-side capture was stored
     "bundle",        # the journey was sealed into the incident store

@@ -55,8 +55,12 @@ from ..utils import env
 
 logger = logging.getLogger(__name__)
 
-# closed enum: every state a fleet rollup gauge may be keyed by
-AGENT_STATES = ("HEALTHY", "DEGRADED", "DRAINING", "DEAD")
+# closed enum: every state a fleet rollup gauge may be keyed by.
+# FAILED: the agent self-reported an unrecoverable engine fault and
+# evacuated its sessions (POST /fleet/evacuate) — unlike DEAD (poll
+# silence) the process may still answer HTTP; it stays FAILED until a
+# re-register revives it (docs/resilience.md "Engine fault domain").
+AGENT_STATES = ("HEALTHY", "DEGRADED", "DRAINING", "FAILED", "DEAD")
 
 # session states whose webhook marks the owning agent DEGRADED (the
 # StreamDegraded family + the device-telemetry/SLO breach volleys)
@@ -100,7 +104,7 @@ class AgentRecord:
 
     def available(self, now: float) -> bool:
         """Can the router place a session here right now?"""
-        if self.state == "DEAD" or self.draining:
+        if self.state in ("DEAD", "FAILED") or self.draining:
             return False
         if now < self.not_before:
             # a 503's Retry-After (or a saturated /capacity hint) is the
@@ -216,7 +220,8 @@ class FleetRegistry:
             rec = AgentRecord(agent_id, base_url)
             rec.boot_id = boot_id
             self.agents[agent_id] = rec
-        elif (rec.state == "DEAD" or rec.base_url != base_url.rstrip("/")
+        elif (rec.state in ("DEAD", "FAILED")
+                or rec.base_url != base_url.rstrip("/")
                 or (boot_id and rec.boot_id and boot_id != rec.boot_id)):
             # replacement (same id re-published: revival, a new address,
             # or a NEW process behind the same address — the
@@ -286,8 +291,11 @@ class FleetRegistry:
             if isinstance(sessions, dict):
                 rec.live_sessions = len(sessions)
             status = str(health.get("status", "HEALTHY"))
-        if rec.state == "DEAD":
-            return  # dead stays dead until the worker re-registers
+        if rec.state in ("DEAD", "FAILED"):
+            # dead stays dead — and a FAILED (evacuated) agent stays
+            # failed even while its HTTP plane still answers polls —
+            # until the worker re-registers (fresh process, epoch bump)
+            return
         if rec.draining:
             rec.state = "DRAINING"
             if rec.live_sessions == 0 and not rec.recyclable:
@@ -304,7 +312,11 @@ class FleetRegistry:
         refused on placement is the same evidence)."""
         rec.fail_count += 1
         self._count("fleet_polls_failed")
-        if rec.fail_count >= self.dead_after and rec.state != "DEAD":
+        if (rec.fail_count >= self.dead_after
+                and rec.state not in ("DEAD", "FAILED")):
+            # FAILED is sticky past poll silence: its sessions were
+            # already evacuated — the on_dead crash-restore volley would
+            # re-point clients a second time
             self.mark_dead(rec)
 
     def mark_dead(self, rec: AgentRecord):
@@ -318,6 +330,15 @@ class FleetRegistry:
                 self.on_dead(rec)
             except Exception:
                 logger.exception("fleet on_dead handler failed")
+
+    def mark_failed(self, rec: AgentRecord):
+        """Agent self-reported an unrecoverable engine fault
+        (POST /fleet/evacuate): out of placement until it re-registers."""
+        rec.state = "FAILED"
+        rec.recyclable = False
+        self._count("fleet_agents_failed")
+        logger.warning("agent %s FAILED (engine fault, self-evacuating)",
+                       rec.agent_id)
 
     def ingest_event(self, event: dict, agent_id: str | None) -> str | None:
         """One webhook volley from an agent (StreamDegraded family).
@@ -379,7 +400,7 @@ class FleetRegistry:
         now = self._clock()
         hints = []
         for r in self.agents.values():
-            if r.state == "DEAD" or r.draining:
+            if r.state in ("DEAD", "FAILED") or r.draining:
                 continue
             if now < r.not_before:
                 hints.append(r.not_before - now)
@@ -416,6 +437,7 @@ class FleetRegistry:
             "fleet_agents_healthy": by_state["HEALTHY"],
             "fleet_agents_degraded": by_state["DEGRADED"],
             "fleet_agents_draining": by_state["DRAINING"],
+            "fleet_agents_failed": by_state["FAILED"],
             "fleet_agents_dead": by_state["DEAD"],
             "fleet_agents_recyclable": recyclable,
             "fleet_capacity_free": cap_free,
@@ -425,7 +447,7 @@ class FleetRegistry:
 
     def _count(self, name: str, n: int = 1):
         if self.stats is not None:
-            # tpurtc: allow[metrics-registry] -- closed set: every name this registry counts is a literal at its call sites (fleet_registers, fleet_registers_refused, fleet_polls_failed, fleet_agents_died, fleet_events_ingested, fleet_breaches, fleet_placements, fleet_stale_epoch_dropped)
+            # tpurtc: allow[metrics-registry] -- closed set: every name this registry counts is a literal at its call sites (fleet_registers, fleet_registers_refused, fleet_polls_failed, fleet_agents_died, fleet_agents_failed, fleet_events_ingested, fleet_breaches, fleet_placements, fleet_stale_epoch_dropped)
             self.stats.count(name, n)
 
 

@@ -750,9 +750,10 @@ async def _import_and_repoint(app, sid: str, entry: dict, snapshot: dict,
     # lifecycle-driven moves get their own ring kind on top of the
     # mechanical "migrated": an operator reading a journey should see
     # WHY the session moved, not just that it did
-    lifecycle_kind = {"upgrade": "upgraded", "autoscale": "scaled"}.get(
-        reason
-    )
+    lifecycle_kind = {
+        "upgrade": "upgraded", "autoscale": "scaled",
+        "evacuate": "evacuated",
+    }.get(reason)
     if lifecycle_kind is not None:
         journeys.note(
             jid, lifecycle_kind, source=source_id,
@@ -861,6 +862,10 @@ async def _run_migrate_drain(app, rec, sessions, gen: int,
                     # the rolling-upgrade acceptance metric: how long a
                     # session was between boxes during a sweep step
                     app["upgrade_move_ms"].append(move_ms)
+                elif reason == "evacuate":
+                    # the engine-fault-domain acceptance metric
+                    # (evacuation_session_move_ms, ISSUE 19)
+                    app["evacuation_move_ms"].append(move_ms)
 
     try:
         results = await asyncio.wait_for(
@@ -1086,6 +1091,49 @@ async def _apply_drain(app, rec, starting: bool, mode: str,
         "mode": mode if starting else "cancel",
         "migrating": migrating,
     }
+
+
+async def fleet_evacuate(request):
+    """POST /fleet/evacuate {"agent": id, "reason": str} — an agent whose
+    engine guard exhausted its rebuild attempts (resilience/engine_guard)
+    self-reports an unrecoverable device fault: mark it FAILED (out of
+    placement until it re-registers at a bumped epoch) and migrate-place
+    its sessions on healthy agents via the drain-as-move sweep
+    (reason="evacuate": journeys continue leg+1 with an ``evacuated``
+    ring entry).  Exports run against the FAILED agent — its HTTP plane
+    still answers; only its device is gone.  Same bearer auth as the
+    webhook ingest: this call moves every session on the box."""
+    app = request.app
+    handler: StreamEventHandler = app["fleet_events"]
+    if handler.token:
+        auth = request.headers.get("Authorization", "")
+        if auth != f"Bearer {handler.token}":
+            return web.Response(status=401, text="bad token")
+    try:
+        body = await request.json()
+    except ValueError:
+        body = {}
+    agent_id = str(body.get("agent") or request.query.get("agent") or "")
+    if not agent_id:
+        return web.Response(status=400, text="agent required")
+    reg: FleetRegistry = app["fleet"]
+    rec = reg.agents.get(agent_id)
+    if rec is None:
+        return web.Response(status=404, text=f"unknown agent {agent_id!r}")
+    refusal = _migrate_mode_refusal(app)
+    if refusal is not None:
+        return refusal
+    if rec.state != "FAILED":
+        reg.mark_failed(rec)
+    moving = _start_migrate_sweep(app, rec, reason="evacuate")
+    app["stats"].count("evacuations")
+    logger.warning(
+        "agent %s evacuating %d session(s): %s",
+        agent_id, moving, str(body.get("reason", ""))[:200],
+    )
+    return web.json_response(
+        {"agent": agent_id, "state": rec.state, "evacuating": moving}
+    )
 
 
 async def fleet_upgrade(request):
@@ -1339,7 +1387,9 @@ async def fleet_health(request):
     reg: FleetRegistry = request.app["fleet"]
     agents = {aid: rec.snapshot() for aid, rec in reg.agents.items()}
     worst = "HEALTHY"
-    order = {"HEALTHY": 0, "DEGRADED": 1, "DRAINING": 2, "DEAD": 3}
+    order = {
+        "HEALTHY": 0, "DEGRADED": 1, "DRAINING": 2, "FAILED": 3, "DEAD": 4,
+    }
     for rec in agents.values():
         if order.get(rec["state"], 0) > order[worst]:
             worst = rec["state"]
@@ -1531,6 +1581,15 @@ async def metrics(request):
         out["upgrade_session_move_ms_p99"] = round(
             moves[min(n - 1, int(n * 0.99))], 3
         )
+    # evacuation move latency (the subset driven by /fleet/evacuate —
+    # the engine-fault-domain SLO the recovery bench fences)
+    moves = sorted(app["evacuation_move_ms"])
+    if moves:
+        n = len(moves)
+        out["evacuation_session_move_ms_p50"] = round(moves[n // 2], 3)
+        out["evacuation_session_move_ms_p99"] = round(
+            moves[min(n - 1, int(n * 0.99))], 3
+        )
     if app["autoscale"].enabled:
         out.update(app["autoscale"].snapshot())
     if app["journeys"] is not None:
@@ -1699,6 +1758,8 @@ def build_router_app(
         "UPGRADE_STEP_TIMEOUT_S", 60.0
     )
     app["upgrade_move_ms"] = collections.deque(maxlen=512)
+    # engine-fault evacuations (POST /fleet/evacuate, ISSUE 19)
+    app["evacuation_move_ms"] = collections.deque(maxlen=512)
     app["autoscale"] = AutoscaleController(app["fleet"])
     app["autoscale_tick_s"] = env.get_float("AUTOSCALE_TICK_S", 1.0)
     app["autoscale_spawn"] = _default_autoscale_spawn
@@ -1716,6 +1777,7 @@ def build_router_app(
     app.router.add_post("/fleet/events", fleet_events)
     app.router.add_post("/fleet/drain", fleet_drain)
     app.router.add_post("/fleet/upgrade", fleet_upgrade)
+    app.router.add_post("/fleet/evacuate", fleet_evacuate)
     app.router.add_get("/fleet/health", fleet_health)
     app.router.add_get("/fleet/debug/journeys", journey_index)
     app.router.add_get("/fleet/debug/journey/{id}", journey_bundle)

@@ -84,6 +84,17 @@ _HELP = {
     "migration_snapshots_banked": "recent session exports held for the crash-restore path (bounded, TTL'd)",
     "migration_ms_p50": "export-to-re-point migration latency, median (bounded reservoir)",
     "migration_ms_p99": "export-to-re-point migration latency, p99",
+    # engine fault domain (resilience/engine_guard.py): agent-side guard
+    # counters + the router-side evacuation rollup — aggregate-only
+    "engine_trips_total": "engine guard trips (step deadline blown or device lost)",
+    "engine_rebuilds_total": "successful engine rebuilds after a trip",
+    "engine_quarantined": "1 while the engine guard is not ARMED (no dispatches)",
+    "engine_rebuild_ms_p50": "engine rebuild wall time, median (bounded reservoir)",
+    "engine_rebuild_ms_p99": "engine rebuild wall time, p99",
+    "fleet_agents_failed": "agents parked FAILED after self-evacuation",
+    "evacuations_total": "agent self-evacuations accepted via POST /fleet/evacuate",
+    "evacuation_session_move_ms_p50": "per-session evacuation move latency, median",
+    "evacuation_session_move_ms_p99": "per-session evacuation move latency, p99",
 }
 
 

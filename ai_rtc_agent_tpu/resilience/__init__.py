@@ -13,6 +13,7 @@ exponential-backoff + deadline helper every control-plane retry loop
 shares).  See docs/resilience.md.
 """
 
+from .engine_guard import EngineGuard, EngineQuarantinedError  # noqa: F401
 from .faults import (  # noqa: F401
     DeviceLostError,
     FaultPlan,
@@ -20,6 +21,7 @@ from .faults import (  # noqa: F401
     activate,
     active,
     deactivate,
+    release_wedge,
     scope,
 )
 from .retry import RetryError, RetryPolicy  # noqa: F401

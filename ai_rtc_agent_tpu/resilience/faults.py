@@ -30,13 +30,21 @@ Plan JSON shape::
         {"target": "engine", "kind": "slow_step", "start": 50, "stop": 55,
          "delay_s": 3.0},
         {"target": "engine", "kind": "nan", "start": 60, "stop": 62},
-        {"target": "engine", "kind": "device_lost", "start": 70, "stop": 71}]}
+        {"target": "engine", "kind": "device_lost", "start": 70, "stop": 71},
+        {"target": "engine", "kind": "wedge", "start": 80, "stop": 81}]}
 
 ``target``: ``rx`` (inbound datagrams), ``tx`` (outbound datagrams) or
 ``engine`` (diffusion steps).  ``start``/``stop`` bound the fault to an
 index window (packet index for net targets, step index for the engine;
 ``stop`` exclusive, both optional).  ``p`` is the per-event probability
 (default 1.0 inside the window).
+
+``wedge`` is the open-ended cousin of ``slow_step``: the step blocks until
+the test calls :func:`release_wedge` (a real wedged-device step has no
+fixed duration — the whole point of the engine guard's deadline is that
+nobody knows when, or whether, the step returns).  The release event is
+plan-global and re-armed by :func:`activate`; :func:`deactivate` releases
+any still-blocked step so abandoned worker threads never outlive a test.
 """
 
 from __future__ import annotations
@@ -44,13 +52,14 @@ from __future__ import annotations
 import json
 import logging
 import random
+import threading
 import time
 from dataclasses import dataclass
 
 logger = logging.getLogger(__name__)
 
 NET_KINDS = ("drop", "dup", "reorder", "delay", "truncate", "loss_burst")
-ENGINE_KINDS = ("slow_step", "nan", "device_lost")
+ENGINE_KINDS = ("slow_step", "nan", "device_lost", "wedge")
 TARGETS = ("rx", "tx", "engine")
 
 
@@ -128,9 +137,16 @@ class FaultPlan:
 ACTIVE: FaultPlan | None = None
 _SCOPE_SEQ = 0  # distinct per-scope RNG streams within one plan
 
+# wedge release gate — plan-global so one call frees every wedged scope.
+# activate() swaps in a FRESH event (after freeing stragglers from the
+# previous plan), so a released wedge never leaks into the next plan.
+_WEDGE_RELEASE = threading.Event()
+
 
 def activate(plan: FaultPlan) -> FaultPlan:
-    global ACTIVE, _SCOPE_SEQ
+    global ACTIVE, _SCOPE_SEQ, _WEDGE_RELEASE
+    _WEDGE_RELEASE.set()  # free any step still wedged on the old plan
+    _WEDGE_RELEASE = threading.Event()
     ACTIVE = plan
     _SCOPE_SEQ = 0
     logger.warning(
@@ -142,6 +158,13 @@ def activate(plan: FaultPlan) -> FaultPlan:
 def deactivate() -> None:
     global ACTIVE
     ACTIVE = None
+    _WEDGE_RELEASE.set()
+
+
+def release_wedge() -> None:
+    """Unblock every step currently held by a ``wedge`` fault (and any
+    future wedge hit under the SAME plan — a released wedge stays open)."""
+    _WEDGE_RELEASE.set()
 
 
 def active() -> FaultPlan | None:
@@ -225,9 +248,11 @@ class EngineFaultScope:
 
     ``step()`` is called once per diffusion step *before* dispatch:
     ``slow_step`` blocks the calling (worker) thread for ``delay_s`` —
-    a stalled device step; ``device_lost`` raises :class:`DeviceLostError`;
-    ``nan`` returns ``"nan"`` and the engine substitutes a non-finite
-    output (NaN latents that survived the decode).
+    a stalled device step; ``wedge`` blocks it open-endedly until
+    :func:`release_wedge` (the guard-deadline test shape); ``device_lost``
+    raises :class:`DeviceLostError`; ``nan`` returns ``"nan"`` and the
+    engine substitutes a non-finite output (NaN latents that survived the
+    decode).
     """
 
     def __init__(self, specs, rng: random.Random, sleep=time.sleep):
@@ -236,6 +261,9 @@ class EngineFaultScope:
         self.index = 0
         self.stats = {k: 0 for k in ENGINE_KINDS}
         self._sleep = sleep
+        # bound at scope construction (scopes are created under an active
+        # plan, after activate() armed the fresh event)
+        self._wedge = _WEDGE_RELEASE
 
     def step(self) -> str | None:
         i = self.index
@@ -247,6 +275,9 @@ class EngineFaultScope:
             if s.kind == "slow_step":
                 self._sleep(s.delay_s)
                 return "slow_step"
+            if s.kind == "wedge":
+                self._wedge.wait()
+                return "wedge"
             if s.kind == "device_lost":
                 raise DeviceLostError(
                     f"injected device loss at step {i} (fault plan)"

@@ -36,6 +36,7 @@ from typing import List, Tuple
 from aiohttp import web
 
 from ..obs.recorder import FlightRecorder
+from ..resilience.engine_guard import EngineGuard
 from ..resilience.overload import OverloadControlPlane, QueueProbe, ShedFrame
 from ..resilience.supervisor import (
     ResilientPipeline,
@@ -378,6 +379,14 @@ def _admission_gate(app, session_key: str | None = None) -> web.Response | None:
     :func:`_release_admission` / :func:`_end_supervision` on failure) so a
     burst of concurrent offers cannot race past OVERLOAD_MAX_SESSIONS
     before any of their tracks arrive.  None = admitted."""
+    guard = app.get("engine_guard")
+    if guard is not None and guard.quarantined:
+        # engine fault domain (resilience/engine_guard.py): a quarantined
+        # device plane cannot serve ANY new stream — refuse before touching
+        # overload accounting, Retry-After from the rebuild backoff
+        return _overloaded_response(
+            app, text="engine quarantined", retry_after=guard.retry_after_s()
+        )
     ov = app.get("overload")
     if ov is None:
         return None
@@ -1496,6 +1505,11 @@ async def health_detail(request):
         }
     if devtel_plane is not None:
         body["devtel"] = devtel_plane.health()
+    guard = app.get("engine_guard")
+    if guard is not None:
+        # engine fault domain: QUARANTINED/REBUILDING here explains why
+        # every session above just flipped to passthrough at once
+        body["engine"] = guard.health()
     return web.json_response(body)
 
 
@@ -1515,25 +1529,31 @@ async def capacity(request):
         free = None
     ov = app.get("overload")
     if ov is None:
-        return web.json_response(
-            {
-                "capacity": free if free is not None else -1,
-                "saturated": free == 0,
-                "retry_after_s": 0.0,
-                "boot_id": app.get("boot_id", ""),
-                # viewer capacity is a SEPARATE pool from engine slots
-                # (ISSUE 17): broadcast viewers never charge admission
-                "broadcast": _broadcast_gauges(app),
-            }
-        )
-    # plane-level view: counts live ladders PLUS in-flight admission
-    # reservations, so a burst of half-set-up offers is not double-sold
-    body = ov.capacity(free_slots=free)
+        body = {
+            "capacity": free if free is not None else -1,
+            "saturated": free == 0,
+            "retry_after_s": 0.0,
+        }
+    else:
+        # plane-level view: counts live ladders PLUS in-flight admission
+        # reservations, so a burst of half-set-up offers is not double-sold
+        body = ov.capacity(free_slots=free)
     # the process nonce rides the capacity feed: the worker publishes it
     # and the registry bumps the agent's epoch when it changes (a
     # recycled replacement on the same address is a NEW process)
     body["boot_id"] = app.get("boot_id", "")
+    # viewer capacity is a SEPARATE pool from engine slots (ISSUE 17):
+    # broadcast viewers never charge admission
     body["broadcast"] = _broadcast_gauges(app)
+    guard = app.get("engine_guard")
+    if guard is not None and guard.quarantined:
+        # engine fault domain: a quarantined device plane admits NOTHING,
+        # whatever the slot arithmetic says — saturate the feed so the
+        # fleet router routes around this agent while it rebuilds
+        body["saturated"] = True
+        body["retry_after_s"] = guard.retry_after_s()
+    if guard is not None:
+        body["engine"] = guard.health()
     return web.json_response(body)
 
 
@@ -1826,6 +1846,11 @@ async def metrics(request):
     sched = request.app.get("batch_scheduler")
     if sched is not None:
         out.update(sched.snapshot())
+    # engine fault domain (resilience/engine_guard.py): trip/rebuild
+    # counters + quarantine gauge + rebuild-latency percentiles
+    eng = request.app.get("engine_guard")
+    if eng is not None:
+        out.update(eng.snapshot())
     # tracing / flight recorder (obs/): cheap int reads, like the overload
     # snapshot — observability endpoints must survive the incidents they
     # exist to explain
@@ -2229,6 +2254,46 @@ async def on_startup(app):
         # for scheduler sessions: owns_step_signal)
         admission = app["overload"].admission
         sched.on_step = lambda dt_s, occ: admission.note_step_latency(dt_s)
+    if (
+        sched is not None
+        and hasattr(sched, "attach_guard")  # duck-typed test schedulers
+        and env.get_bool("ENGINE_GUARD", True)
+    ):
+        # engine fault domain (resilience/engine_guard.py): every device
+        # dispatch now rides the guard's step deadline; a trip quarantines
+        # the whole plane (sessions passthrough, admission refuses), the
+        # rebuild loop restores it bit-exact from the snapshot bank, and
+        # exhaustion self-evacuates through the fleet router.  Transition
+        # callbacks fire on guard worker threads — webhooks hop to the
+        # loop exactly like the retrace-breach path above.
+        loop = asyncio.get_event_loop()
+        handler = app["stream_event_handler"]
+
+        def _engine_transition(event_name, info):
+            extra = {
+                k: v
+                for k, v in info.items()
+                if k not in ("state", "reason")
+            }
+
+            def fire():
+                handler.handle_engine_state(
+                    event_name,
+                    info.get("state", ""),
+                    reason=str(info.get("reason", "")),
+                    **extra,
+                )
+
+            try:  # guard trips/rebuilds happen off-loop
+                loop.call_soon_threadsafe(fire)
+            except RuntimeError:
+                pass  # loop already closed (teardown race)
+
+        app["engine_guard"] = EngineGuard(
+            sched,
+            on_transition=_engine_transition,
+            on_exhausted=lambda: _evacuate_agent(app),
+        )
     if devtel_plane is not None:
         if app["overload"] is not None:
             # device-memory snapshot rides the ladder tick (rate-limited
@@ -2241,6 +2306,41 @@ async def on_startup(app):
         # first step WILL be reported: that config genuinely does
         # compile at serve time, and the watchdog's job is to say so.)
         devtel_plane.serving()
+
+
+def _evacuate_agent(app):
+    """Self-evacuation client (engine fault domain): on rebuild
+    exhaustion the guard calls this from its daemon thread — ask the
+    fleet router to move every live session off this agent (``POST
+    /fleet/evacuate``, fleet/router.py migrate-places them on healthy
+    agents) and park this agent FAILED.  Synchronous stdlib HTTP on
+    purpose: the loop may be wedged along with the device, and the
+    AgentEvacuating webhook has already fired — an unset EVACUATE_URL
+    just means no router-driven move (standalone agent)."""
+    url = env.get_str("EVACUATE_URL")
+    if not url:
+        return
+    import urllib.request
+
+    guard = app.get("engine_guard")
+    payload = json.dumps(
+        {
+            "agent": env.get_str("WORKER_ID") or "",
+            "reason": (guard.last_trip_reason or "") if guard else "",
+        }
+    ).encode("utf-8")
+    headers = {"Content-Type": "application/json"}
+    token = env.get_str("AUTH_TOKEN")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(url, data=payload, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            logger.warning(
+                "self-evacuation accepted by router (%d)", resp.status
+            )
+    except Exception:
+        logger.exception("self-evacuation POST failed (%s)", url)
 
 
 async def on_shutdown(app):
@@ -2284,6 +2384,9 @@ async def on_shutdown(app):
                 except Exception:
                     logger.exception("releasing imported session failed")
         app.get("imported_sessions", {}).clear()
+        guard = app.get("engine_guard")
+        if guard is not None:
+            guard.close()
         sched.close()
 
 

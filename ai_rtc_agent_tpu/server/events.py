@@ -30,6 +30,8 @@ logger = logging.getLogger(__name__)
 EVENT_NAMES = frozenset({
     "StreamStarted", "StreamEnded", "StreamDegraded",
     "StreamRecovered", "StreamMigrated",
+    # engine fault domain (resilience/engine_guard.py, docs/resilience.md)
+    "EngineDegraded", "EngineRecovered", "AgentEvacuating",
 })
 STATE_NAMES = frozenset({
     # supervisor states (resilience/supervisor.py)
@@ -38,6 +40,9 @@ STATE_NAMES = frozenset({
     "DRAINING", "DEAD",
     # breach + lifecycle states ridden by StreamDegraded (docs/fleet.md)
     "SLO_BREACH", "RETRACE_BREACH", "AGENT_DEAD", "AGENT_RECYCLED",
+    # engine guard states (resilience/engine_guard.py; terminal FAILED
+    # is shared with the supervisor vocabulary above)
+    "ARMED", "QUARANTINED", "REBUILDING", "EVACUATING",
 })
 
 
@@ -102,6 +107,38 @@ class StreamMigratedEvent(WebhookEvent):
     reason: str = ""
 
 
+class EngineDegradedEvent(WebhookEvent):
+    """The engine guard tripped (resilience/engine_guard.py): the shared
+    device step wedged past its deadline or the device was lost.  Every
+    session on the agent is serving passthrough while the rebuild loop
+    runs; ``state`` carries the guard state (QUARANTINED/REBUILDING)."""
+
+    event: str = "EngineDegraded"
+    state: str = "QUARANTINED"
+    reason: str = ""
+
+
+class EngineRecoveredEvent(WebhookEvent):
+    """The guard re-armed: the compiled plane was rebuilt and every live
+    slot restored from its banked snapshot (bit-exact where a bank row
+    existed).  ``rebuild_ms`` is the wall time of the winning attempt."""
+
+    event: str = "EngineRecovered"
+    state: str = "ARMED"
+    rebuild_ms: float = 0.0
+    attempt: int = 0
+
+
+class AgentEvacuatingEvent(WebhookEvent):
+    """Rebuild exhausted its attempts: the agent is exporting every
+    session and asking the router to migrate-place them on healthy
+    agents (``POST /fleet/evacuate``), after which it parks FAILED."""
+
+    event: str = "AgentEvacuating"
+    state: str = "EVACUATING"
+    reason: str = ""
+
+
 class StreamEventHandler:
     def __init__(self, session_factory=None, webhook_url=None, token=None):
         # explicit ctor values override the env config: the fleet router
@@ -129,6 +166,9 @@ class StreamEventHandler:
             "StreamDegraded": StreamDegradedEvent,
             "StreamRecovered": StreamRecoveredEvent,
             "StreamMigrated": StreamMigratedEvent,
+            "EngineDegraded": EngineDegradedEvent,
+            "EngineRecovered": EngineRecoveredEvent,
+            "AgentEvacuating": AgentEvacuatingEvent,
         }.get(event_name)
         if cls is None:
             raise ValueError(f"unknown event: {event_name}")
@@ -225,6 +265,17 @@ class StreamEventHandler:
             "StreamMigrated", stream_id, room_id,
             source_agent=source_agent, target_agent=target_agent,
             reason=reason, **self._journey_extra(journey),
+        )
+
+    def handle_engine_state(self, event_name: str, state: str,
+                            reason: str = "", **extra):
+        """Engine-guard transition -> webhook (EngineDegraded /
+        EngineRecovered / AgentEvacuating).  The fault domain is the whole
+        agent, not one stream, so ``stream_id`` rides the reserved
+        ``"engine-guard"`` marker (the devtel-breach idiom)."""
+        return self.send_request(
+            event_name, "engine-guard", "", state=state, reason=reason,
+            **extra,
         )
 
     def handle_session_state(

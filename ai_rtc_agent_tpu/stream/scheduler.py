@@ -99,6 +99,7 @@ import numpy as np
 from ..obs import devtel
 from ..obs.trace import get_trace, safe_list
 from ..parallel.multipeer import CapacityError, make_bucket_step
+from ..resilience import faults as _faults
 from ..resilience.overload import DeadlineQueue, ShedFrame
 from ..utils import env
 from .engine import (
@@ -422,6 +423,15 @@ class ScheduledSession:
         (clearing poisoned latents) on the same compiled bucket
         executables — the live prompt/guidance/t-indices are restored, not
         module defaults."""
+        g = self._owner._guard
+        if g is not None and g.quarantined:
+            # engine-level fault, not a per-slot one: the guard's rebuild
+            # restores this slot from its banked row (bit-exact — better
+            # than the fresh state built here), and installing into the
+            # poisoned stack would only crash the supervisor's recovery
+            # thread.  Report success so the session keeps serving
+            # passthrough instead of escalating to FAILED.
+            return
         state = self._owner._build_state(
             self.prompt, self.guidance_scale, self.delta, self._seed,
             t_index_list=self.t_index_list,
@@ -658,6 +668,28 @@ class BatchScheduler:
         self._occ_hist: dict = {}
         self.steps_total = 0
         self._aot_adopted = False
+        # -- engine fault domain (resilience/engine_guard.py) ---------------
+        # duck-typed attach (attach_guard) — no construction-order coupling
+        # with the agent.  The guard routes _step_batch_locked's one device
+        # call through its deadline worker; while it is quarantined the
+        # scheduler sheds instead of dispatching and claim() refuses.
+        self._guard = None
+        self._fault_scope = _faults.scope("engine")
+        # snapshot bank: per-slot DEVICE-side state rows refreshed on a
+        # cadence after successful dispatches.  The bucket steps DONATE the
+        # stacked states (multipeer donate_argnums=(1,)), so at trip time
+        # self.states is already unreadable — bit-exact restore is only
+        # possible from rows banked BEFORE the fault (each x[slot] slice is
+        # a fresh buffer the donation cannot invalidate, the
+        # snapshot_session rule).  <=0 cadence banks after EVERY dispatch
+        # (the chaos-test setting).
+        self._snap_every_s = env.get_float("ENGINE_SNAPSHOT_EVERY_S", 5.0)
+        self._snap_rows: dict = {}  # slot -> device-side state row pytree
+        self._last_snap_t = 0.0
+        # session_key -> full snapshot dict, frozen by the guard at
+        # quarantine entry; snapshot_session serves these while the live
+        # stack is poisoned (the /migrate/export evacuation path)
+        self._quarantine_snaps: dict = {}
         # warm the bucket geometries so join/leave never retraces at serve
         # time: adopt serialized engines when the cache has them (build
         # them with AOT_ENGINES=1 / the build CLI), then optionally
@@ -683,6 +715,8 @@ class BatchScheduler:
                 )
         if prewarm is None:
             prewarm = env.get_bool("BATCHSCHED_PREWARM", True)
+        # remembered so rebuild_engine() re-warms the way the boot did
+        self._prewarm = bool(prewarm)
         if prewarm and not self._aot_adopted:
             self.prewarm_buckets()
         self._thread = threading.Thread(
@@ -744,6 +778,11 @@ class BatchScheduler:
         full (the agent maps it to 503 + Retry-After).  The heavy state
         build (text-encode + prepare) runs OUTSIDE the step lock so live
         sessions keep batching while someone joins."""
+        g = self._guard
+        if g is not None and g.quarantined:
+            # no dispatch plane to serve the new session — same 503 +
+            # Retry-After surface as a full pool (docs/resilience.md)
+            raise CapacityError("engine quarantined — rebuild in progress")
         with self._lock:
             slot = self._pick_slot_locked()
             self.active[slot] = True
@@ -856,10 +895,17 @@ class BatchScheduler:
         (never mid-dispatch); in-flight window frames stay behind and are
         delivered by THIS agent, which keeps serving until the client
         actually moves."""
-        import base64
-
-        from ..parallel.checkpoint import serialize_pytree
-
+        g = self._guard
+        if g is not None and g.quarantined:
+            # the live stack is poisoned (donated buffers / lost device):
+            # serve the snapshot the guard froze at quarantine entry — the
+            # bank the evacuation's /migrate/export reads
+            snap = self._quarantine_snaps.get(session_key)
+            if snap is not None:
+                return dict(snap)
+            raise KeyError(
+                f"no banked snapshot for quarantined session {session_key!r}"
+            )
         sess = self.session(session_key)
         if sess is None:
             raise KeyError(f"no live scheduler session {session_key!r}")
@@ -883,11 +929,21 @@ class BatchScheduler:
             cache_tick = self._tick
             cache_uncaptured = sess.slot in self._uncaptured
         row = jax.tree.map(np.asarray, row_dev)
+        return self._row_snapshot(sess, row, cache_tick, cache_uncaptured)
+
+    def _row_snapshot(self, sess, row, cache_tick, cache_uncaptured) -> dict:
+        """One session's full snapshot dict from an already-host state row
+        (shared by the live export path above and the guard's quarantine
+        bank capture)."""
+        import base64
+
+        from ..parallel.checkpoint import serialize_pytree
+
         snap = {
             "schema": SESSION_SNAPSHOT_SCHEMA,
             "kind": "scheduler",
             "fingerprint": self.snapshot_fingerprint(),
-            "session": session_key,
+            "session": sess.session_key,
             "prompt": sess.prompt,
             "guidance_scale": float(sess.guidance_scale),
             "delta": float(sess.delta),
@@ -945,6 +1001,9 @@ class BatchScheduler:
 
         from ..parallel.checkpoint import deserialize_pytree
 
+        g = self._guard
+        if g is not None and g.quarantined:
+            raise CapacityError("engine quarantined — rebuild in progress")
         if not isinstance(snapshot, dict):
             raise SnapshotMismatch("session snapshot must be an object")
         schema = snapshot.get("schema")
@@ -1354,6 +1413,7 @@ class BatchScheduler:
             calls[(k, v)] = call
         self._bucket_steps.update(calls)
         self._warmed_buckets.update(calls)
+        # tpurtc: allow[lock-discipline] -- build-time single-thread phase (no dispatcher/guard yet; rebuild_engine locks because it runs live)
         self._aot_adopted = True
         return True
 
@@ -1393,6 +1453,167 @@ class BatchScheduler:
                     k, self.max_sessions, v, self.dp,
                 )
 
+    # -- engine fault domain (resilience/engine_guard.py) ----------------------
+
+    def attach_guard(self, guard):
+        """Wire an EngineGuard into the dispatch path: every bucket step
+        now runs under its deadline, and while it is quarantined the
+        scheduler sheds (passthrough) instead of dispatching, refuses
+        claims/restores, and serves banked snapshots to /migrate/export."""
+        self._guard = guard
+
+    def _maybe_bank_rows_locked(self):
+        """Refresh the snapshot bank (per-slot DEVICE-side state rows) on
+        the ENGINE_SNAPSHOT_EVERY_S cadence, after a successful dispatch.
+        Each ``x[slot]`` slice is a fresh buffer the bucket step's later
+        donation cannot invalidate (the snapshot_session rule) — these
+        rows are the ONLY readable copy of session state once a trip
+        poisons the stack.  Cheap device ops under the lock; nothing is
+        pulled to the host here."""
+        if self._guard is None or self._snap_every_s <= 0:
+            return  # <=0 disables banking (rebuilds re-derive from control)
+        now = time.monotonic()
+        if now - self._last_snap_t < self._snap_every_s:
+            return
+        self._last_snap_t = now
+        rows = {}
+        for slot, sess in self._sessions.items():
+            if not self.active[slot]:
+                continue
+            rows[slot] = jax.tree.map(
+                lambda x, slot=slot: x[slot], self.states
+            )
+        self._snap_rows = rows
+
+    def capture_quarantine_snapshots(self) -> dict:
+        """Freeze ``session_key -> full snapshot dict`` from the banked
+        device rows + the live sessions' control plane — the guard calls
+        this ONCE at quarantine entry, before any rebuild attempt, so an
+        eventual evacuation exports exactly what the bank held.  Slots
+        without a banked row (claimed after the last cadence refresh) are
+        skipped here and rebuilt from their control plane by
+        :meth:`rebuild_engine`.  Best-effort per slot: one unreadable row
+        must not void the other sessions' evacuation."""
+        with self._lock:
+            rows = dict(self._snap_rows)
+            sessions = {
+                slot: sess for slot, sess in self._sessions.items()
+                if self.active[slot]
+            }
+            cache_tick = self._tick
+            uncaptured = set(self._uncaptured)
+        snaps = {}
+        for slot, sess in sessions.items():
+            row_dev = rows.get(slot)
+            if row_dev is None:
+                logger.warning(
+                    "quarantine capture: slot %d has no banked row "
+                    "(claimed after the last bank refresh) — control-plane "
+                    "rebuild only", slot,
+                )
+                continue
+            try:
+                row = jax.tree.map(np.asarray, row_dev)
+                snaps[sess.session_key] = self._row_snapshot(
+                    sess, row, cache_tick, slot in uncaptured
+                )
+            except Exception:
+                logger.exception(
+                    "quarantine capture failed for slot %d (%s)",
+                    slot, sess.session_key,
+                )
+        self._quarantine_snaps = snaps
+        return snaps
+
+    def rebuild_engine(self, snapshots: dict | None = None) -> int:
+        """Quarantine recovery: re-derive the compiled step plane (every
+        executable may have baked in the dead device) and restore every
+        live slot — from its banked snapshot row BIT-EXACT when one
+        exists, from its session's control plane otherwise; never module
+        defaults.  Returns the number of slots restored bit-exact.
+        Raises on failure (the guard backs off and retries)."""
+        import base64
+
+        from ..parallel.checkpoint import deserialize_pytree
+
+        snapshots = snapshots if snapshots is not None else (
+            self._quarantine_snaps
+        )
+        # _has_work is Condition(self._lock) — acquiring the Lock directly
+        # is the same mutual exclusion (no wait/notify on this path)
+        with self._lock:
+            self._bucket_steps = {}
+            self._warmed_buckets = set()
+            self._idx_cache = {}
+            self._aot_adopted = False
+            self._vsteps = {
+                v: jax.vmap(
+                    make_step_fn(
+                        self._template.models, self.cfg, unet_variant=v
+                    ),
+                    in_axes=(None, 0, 0),
+                )
+                for v in self._variants
+            }
+            placeholder = None
+            per = []
+            exact = 0
+            for slot in range(self.max_sessions):
+                sess = (
+                    self._sessions.get(slot) if self.active[slot] else None
+                )
+                row = None
+                if sess is not None:
+                    snap = snapshots.get(sess.session_key)
+                    if snap is not None:
+                        try:
+                            row = deserialize_pytree(
+                                base64.b64decode(snap["state_b64"])
+                            )
+                            self._check_row(row)
+                        except Exception:
+                            logger.exception(
+                                "banked row unusable for slot %d — "
+                                "control-plane rebuild", slot,
+                            )
+                            row = None
+                    if row is not None:
+                        exact += 1
+                    else:
+                        row = self._build_state(
+                            sess.prompt, sess.guidance_scale, sess.delta,
+                            sess._seed, t_index_list=sess.t_index_list,
+                        )
+                else:
+                    if placeholder is None:
+                        placeholder = self._build_state(
+                            self.prompt, self.guidance_scale, self.delta,
+                            slot, t_index_list=self.t_index_list,
+                        )
+                    row = placeholder
+                per.append(row)
+            self.states = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+            if self.dp > 1:
+                self.states = jax.device_put(self.states, self._row_sh)
+            if self._cache_interval:
+                self._tick = 0  # fresh deep caches -> forced recapture
+                self._uncaptured.update(range(self.max_sessions))
+            # old in-flight batch refs pin poisoned buffers — drop them
+            self._batches = deque(maxlen=self._batches.maxlen)
+            self._snap_rows = {}
+            self._last_snap_t = 0.0
+        # re-warm the way the boot did (outside the step lock; the guard
+        # only re-arms dispatch after this returns)
+        if self._prewarm:
+            self.prewarm_buckets()
+        self._quarantine_snaps = {}
+        logger.warning(
+            "batchsched engine rebuilt: %d/%d live slot(s) restored "
+            "bit-exact from the snapshot bank",
+            exact, len([a for a in self.active if a]),
+        )
+        return exact
+
     # -- coalescing window + dispatcher ---------------------------------------
 
     def _evict(self, pending: _PendingFrame, reason: str):
@@ -1414,6 +1635,13 @@ class BatchScheduler:
         )
 
     def _enqueue(self, slot: int, pending: _PendingFrame):
+        g = self._guard
+        if g is not None and g.quarantined:
+            # no dispatch plane: resolve the waiter as passthrough NOW
+            # (the _evict discipline) instead of queueing work that could
+            # only shed at its deadline — recv never hangs on a quarantine
+            self._evict(pending, "engine-quarantined")
+            return
         with self._has_work:
             room = (
                 self._batches_in_flight(pending.t_enq) < self.PIPELINE_DEPTH
@@ -1529,17 +1757,34 @@ class BatchScheduler:
             if variant == "capture":
                 self._uncaptured.difference_update(idx)
         feed = (k, variant) in self._warmed_buckets
-        # compile-watchdog attribution: a bucket step that compiles HERE
-        # (prewarm disabled, or an evicted/missed geometry) is recorded
-        # against its (k, variant[, dp]) — in the serving phase that is
-        # the serve-time retrace breach this plane exists to catch
-        with devtel.compile_scope(self._bucket_label(k, variant)):
-            self.states, out = self._bucket_step(k, variant)(
-                self.params,
-                self.states,
-                frames_k,
-                self._idx_for(pad),
-            )
+        step = self._bucket_step(k, variant)
+        step_args = (self.params, self.states, frames_k, self._idx_for(pad))
+
+        def _device_step():
+            # compile-watchdog attribution: a bucket step that compiles
+            # HERE (prewarm disabled, or an evicted/missed geometry) is
+            # recorded against its (k, variant[, dp]) — in the serving
+            # phase that is the serve-time retrace breach this plane
+            # exists to catch.  Fault injection (slow_step / wedge /
+            # device_lost) fires on the SAME thread the step runs on, so
+            # a wedge holds the guard's worker, not the dispatch lock's
+            # owner.
+            if self._fault_scope is not None:
+                self._fault_scope.step()
+            with devtel.compile_scope(self._bucket_label(k, variant)):
+                return step(*step_args)
+
+        guard = self._guard
+        if guard is None:
+            self.states, out = _device_step()
+        else:
+            # deadline-bounded dispatch (resilience/engine_guard.py): a
+            # wedged or lost device trips the guard and raises — states
+            # are assigned only on success, so an abandoned worker's late
+            # result can never race the rebuild's fresh stack.  Cold
+            # bucket variants get the long compile deadline (the
+            # warm-step rule's analog).
+            self.states, out = guard.dispatch(_device_step, cold=not feed)
         self._warmed_buckets.add((k, variant))
         # per-slot readback plane: slice each rider's row ON DEVICE and
         # start its D2H copy now — a fetch resolves only its own buffer,
@@ -1742,11 +1987,21 @@ class BatchScheduler:
             # other sessions' fetches would otherwise hang out the full
             # fetch timeout) and surface in the submitter's track
             self._fail_entries(entries, e)
+            g = self._guard
+            if g is not None and g.quarantined:
+                # engine-level trip: the guard owns recovery (quarantine →
+                # rebuild from the snapshot bank).  The per-step rebuild
+                # below would both write into a poisoned stack and clobber
+                # the banked bit-exact rows with fresh prepares.
+                if submitter is None:
+                    return
+                raise
             self._recover_states_locked(e)
             if submitter is None:
                 return
             raise
         batch = _DispatchedBatch(rows, entries, t_disp, occ, feed=feed)
+        self._maybe_bank_rows_locked()
         if any(b.resolved for b in self._batches):
             # drop resolved batches WHEREVER they sit — the ring exists
             # only for the in-flight count, and a resolved batch kept
@@ -1884,6 +2139,20 @@ class BatchScheduler:
             with self._has_work:
                 while not self._stop:
                     waiting = self._waiting_slots()
+                    g = self._guard
+                    if g is not None and g.quarantined:
+                        # no dispatch plane: shed whatever queued (their
+                        # waiters resolve passthrough immediately) and
+                        # idle until the guard's rebuild re-arms
+                        for s in waiting:
+                            while True:
+                                plist = self._pop_group(s)
+                                if plist is None:
+                                    break
+                                for p in plist:
+                                    self._evict(p, "engine-quarantined")
+                        self._has_work.wait(timeout=0.1)
+                        continue
                     if not waiting:
                         self._has_work.wait(timeout=0.5)
                         continue

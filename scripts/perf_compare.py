@@ -88,6 +88,16 @@ DEFAULT_METRIC_TOLERANCES = {
     # what it catches is the move window going pathological (snapshot
     # re-copies, serialized sweeps), which reads as multiples
     "upgrade_session_move_ms": 1.0,
+    # engine quarantine recovery (ISSUE 19): rebuild-to-serving p50 —
+    # dominated by the bucket recompile on the CPU tier, so it wobbles
+    # with box contention; the fence catches the rebuild going
+    # pathological (per-slot device round-trips, snapshot re-decode in
+    # the lock), which reads as multiples
+    "engine_rebuild_ms": 1.0,
+    # self-evacuation session move (ISSUE 19): same export → import →
+    # re-point window as the upgrade move, driven by /fleet/evacuate —
+    # same wide fence for the same reason
+    "evacuation_session_move_ms": 1.0,
     # mesh-sharded scheduler (ISSUE 12): on the CPU tier 8 virtual
     # devices oversubscribe a 2-core host, so the banked ratio is ~0.13x
     # and prices only the sharded dispatch machinery (partitioned

@@ -262,6 +262,13 @@ while true; do
   # are the accelerator trajectory, never the CPU fallback)
   run_item "meshsched_dp8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/mesh_sched_bench.py
   run_item "meshsched_dp8_w8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= QUANT_WEIGHTS=w8 QUANT_MIN_SIZE=256 python -u scripts/mesh_sched_bench.py
+  # ISSUE 19 engine fault domain ON HARDWARE: trip -> rebuild -> serving
+  # with a REAL device recompile in the window (the committed CPU row
+  # prices the same machinery against the CPU compiler; this is the
+  # recovery SLO on the accelerator).  Rebuild leg only: the evacuation
+  # window is host machinery on any box and its line says backend=host,
+  # which the banking filter rightly refuses.
+  run_item "engine_rebuild" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/engine_recovery_bench.py --leg rebuild
   # ISSUE 17 broadcast fan-out ON THE TPU BOX: with libavcodec present
   # the dedicated baseline pays a REAL per-viewer H.264 encode, so the
   # amortization ratio here is the paper-facing number (the committed

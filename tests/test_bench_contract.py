@@ -1226,3 +1226,124 @@ def test_perf_compare_knows_upgrade_leg(tmp_path, capsys):
     ])
     r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
     assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+
+
+# -- scripts/engine_recovery_bench.py: the fault-domain windows (ISSUE 19) ---
+
+def test_engine_recovery_bench_evacuate_contract(tmp_path):
+    """Evacuation-move microbench smoke (ISSUE 19): pure host (never
+    imports jax), a REAL /fleet/evacuate sweep moves every session
+    between two loopback agents, emits exactly one contract line, BANKS
+    it, and the per-session export-to-re-point p50 stays in
+    single-digit-to-tens-of-milliseconds territory on a contended CI
+    box.  The rebuild leg (real scheduler + recompile) rides the slow
+    tier below."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update({
+        "PERF_LOG_PATH": str(log),
+        "ENGINE_BENCH_SESSIONS": "4",
+    })
+    r = subprocess.run(
+        [sys.executable, "scripts/engine_recovery_bench.py",
+         "--leg", "evacuate"],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "evacuation_session_move_ms"
+    assert d["sessions"] == 4
+    # host leg: the fingerprint must say jax never entered
+    assert d["fingerprint"]["jax_backend"] == "unprobed"
+    assert 0 < d["value"] < 100.0, d
+    assert d["move_p99_ms"] >= d["value"]
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "evacuation_session_move_ms"
+
+
+@pytest.mark.slow
+def test_engine_recovery_bench_rebuild_contract(tmp_path):
+    """Rebuild-leg smoke (ISSUE 19): a REAL trip/quarantine/rebuild cycle
+    on the tiny scheduler — the contract line carries the jax backend
+    (the TPU watcher row replays this leg on hardware) and the sample
+    includes the re-prewarm compile (`slow` tier: two of them)."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update({
+        "PERF_LOG_PATH": str(log),
+        "ENGINE_BENCH_REBUILDS": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    r = subprocess.run(
+        [sys.executable, "scripts/engine_recovery_bench.py",
+         "--leg", "rebuild"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    assert "error" not in d, d
+    assert d["metric"] == "engine_rebuild_ms"
+    assert d["trips"] == 1 and d["rebuilds"] == 1
+    assert d["backend"] == "cpu"
+    assert d["fingerprint"]["jax_backend"] == "cpu"
+    assert d["value"] > 0, d
+    assert d["rebuild_p99_ms"] >= d["value"]
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "engine_rebuild_ms"
+
+
+def test_perf_compare_knows_engine_recovery_legs(tmp_path, capsys):
+    """ISSUE 19 satellite: both fault-domain windows ship with built-in
+    lower-is-better fences (1.0 = up to 2x the banked ms) — a fresh run
+    past either fails with no --tolerance-metric flags."""
+    main = _perf_compare_main()
+
+    def _perf_compare(args):
+        class R:
+            pass
+
+        r = R()
+        r.returncode = main(args)
+        r.stdout = capsys.readouterr().out
+        r.stderr = ""
+        return r
+
+    banked = tmp_path / "banked.jsonl"
+    fresh = tmp_path / "fresh.jsonl"
+    _write_jsonl(banked, [
+        {"metric": "engine_rebuild_ms", "value": 16000.0,
+         "unit": "ms", "backend": "cpu", "live": True,
+         "label": "engine_rebuild_3x"},
+        {"metric": "evacuation_session_move_ms", "value": 7.0,
+         "unit": "ms", "backend": "host", "live": True,
+         "label": "evacuation_move_8s"},
+    ])
+    _write_jsonl(fresh, [
+        {"metric": "engine_rebuild_ms", "value": 30000.0,
+         "unit": "ms", "backend": "cpu", "label": "engine_rebuild_3x"},
+        {"metric": "evacuation_session_move_ms", "value": 13.0,
+         "unit": "ms", "backend": "host", "label": "evacuation_move_8s"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    _write_jsonl(fresh, [
+        {"metric": "engine_rebuild_ms", "value": 33000.0,
+         "unit": "ms", "backend": "cpu", "label": "engine_rebuild_3x"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout
+    _write_jsonl(fresh, [
+        {"metric": "evacuation_session_move_ms", "value": 14.5,
+         "unit": "ms", "backend": "host", "label": "evacuation_move_8s"},
+    ])
+    r = _perf_compare(["--fresh", str(fresh), "--log", str(banked)])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout, r.stdout

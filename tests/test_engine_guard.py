@@ -1,0 +1,441 @@
+"""Engine fault domain (ISSUE 19) — trip, quarantine, rebuild, evacuate.
+
+Three layers, hermetic on CPU:
+
+1. **Guard unit tests** against a fake scheduler: deadline trip,
+   DeviceLostError trip, non-fault exceptions propagating untripped,
+   the backed-off rebuild schedule (injectable sleep), exhaustion →
+   evacuation hook, Retry-After and metric snapshots.
+2. **Chaos integration** (real tiny-test BatchScheduler, 4 sessions
+   wrapped in ResilientPipeline): injected ``device_lost`` then
+   ``wedge`` mid-stream — every session serves passthrough with zero
+   dropped futures, the guard trips, and ``run_rebuild`` restores every
+   slot BIT-EXACT from the snapshot bank (an unmigrated control
+   scheduler proves it frame-for-frame).
+3. **HTTP evacuation** (real router + real agent apps, fake
+   schedulers): ``POST /fleet/evacuate`` migrate-places both sessions
+   on a healthy agent, journeys continue leg+1 with an ``evacuated``
+   ring entry, and the sick agent parks FAILED (out of placement).
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.resilience import faults
+from ai_rtc_agent_tpu.resilience.engine_guard import (
+    EngineGuard,
+    EngineQuarantinedError,
+)
+from ai_rtc_agent_tpu.resilience.faults import (
+    DeviceLostError,
+    FaultPlan,
+    FaultSpec,
+)
+from ai_rtc_agent_tpu.resilience.supervisor import ResilientPipeline
+from tests.test_migration import (
+    _fleet_harness,
+    _MigScheduler,
+    _mk_sched,
+    _offer_body,
+    _spawn_agent,
+    _tick,
+    _wait_for,
+    bundle,
+    cfg32,
+)
+
+__all__ = ["bundle", "cfg32"]  # re-exported module-scoped fixtures
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_leak():
+    faults.deactivate()
+    yield
+    faults.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# 1. guard unit tests (fake scheduler, injectable sleep/clock)
+# ---------------------------------------------------------------------------
+
+class _FakeSched:
+    def __init__(self, fail_rebuilds: int = 0):
+        self.guard = None
+        self.captures = 0
+        self.rebuild_calls = []
+        self.fail_rebuilds = fail_rebuilds
+
+    def attach_guard(self, g):
+        self.guard = g
+
+    def capture_quarantine_snapshots(self):
+        self.captures += 1
+        return {"sess-a": {"state_b64": "banked"}}
+
+    def rebuild_engine(self, snaps):
+        self.rebuild_calls.append(snaps)
+        if len(self.rebuild_calls) <= self.fail_rebuilds:
+            raise RuntimeError("device still gone")
+        return len(snaps)
+
+
+def _mk_guard(sched=None, **kw):
+    transitions = []
+    sleeps = []
+    kw.setdefault("deadline_s", 0.1)
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("backoff_s", 1.0)
+    kw.setdefault("auto_rebuild", False)
+    kw.setdefault("sleep", sleeps.append)
+    kw.setdefault(
+        "on_transition", lambda ev, info: transitions.append((ev, info))
+    )
+    g = EngineGuard(sched if sched is not None else _FakeSched(), **kw)
+    return g, transitions, sleeps
+
+
+def test_dispatch_passes_results_through_when_armed():
+    g, transitions, _ = _mk_guard()
+    assert g.dispatch(lambda: 42) == 42
+    assert g.state == "ARMED" and not g.quarantined
+    assert g.trips == 0 and transitions == []
+    assert g.retry_after_s() == 0.0
+    g.close()
+
+
+def test_blown_deadline_trips_and_quarantines():
+    release = threading.Event()
+    g, transitions, _ = _mk_guard(deadline_s=0.05)
+    with pytest.raises(EngineQuarantinedError):
+        g.dispatch(lambda: release.wait(5))
+    release.set()  # free the abandoned worker thread
+    assert g.state == "QUARANTINED" and g.quarantined
+    assert g.trips == 1
+    assert "deadline" in (g.last_trip_reason or "")
+    assert [t[0] for t in transitions] == ["EngineDegraded"]
+    assert transitions[0][1]["state"] == "QUARANTINED"
+    # quarantined dispatch refuses WITHOUT running fn
+    ran = []
+    with pytest.raises(EngineQuarantinedError):
+        g.dispatch(lambda: ran.append(1))
+    assert ran == [] and g.trips == 1  # refusal, not a second trip
+    assert 1.0 <= g.retry_after_s() <= 60.0
+    assert g.health()["state"] == "QUARANTINED"
+    assert g.snapshot()["engine_quarantined"] == 1
+    assert g.snapshot()["engine_trips_total"] == 1
+    g.close()
+
+
+def test_device_lost_trips_and_reraises():
+    g, transitions, _ = _mk_guard()
+
+    def boom():
+        raise DeviceLostError("halt 0x13")
+
+    with pytest.raises(DeviceLostError):
+        g.dispatch(boom)
+    assert g.state == "QUARANTINED" and g.trips == 1
+    assert "device lost" in g.last_trip_reason
+    g.close()
+
+
+def test_non_fault_exception_propagates_untripped():
+    g, transitions, _ = _mk_guard()
+
+    def shape_bug():
+        raise ValueError("bad shapes")
+
+    with pytest.raises(ValueError, match="bad shapes"):
+        g.dispatch(shape_bug)
+    assert g.state == "ARMED" and g.trips == 0 and transitions == []
+    g.close()
+
+
+def test_cold_dispatch_gets_the_compile_deadline():
+    g, _, _ = _mk_guard(deadline_s=0.05, cold_deadline_s=5.0)
+    # a 0.3s "compile" blows the warm deadline but not the cold one
+    assert g.dispatch(lambda: time.sleep(0.3) or "ok", cold=True) == "ok"
+    assert g.state == "ARMED"
+    g.close()
+
+
+def test_rebuild_success_rearms_and_banks_latency():
+    sched = _FakeSched()
+    g, transitions, sleeps = _mk_guard(sched)
+    with pytest.raises(DeviceLostError):
+        g.dispatch(lambda: (_ for _ in ()).throw(DeviceLostError("x")))
+    assert g.run_rebuild() is True
+    assert g.state == "ARMED" and not g.quarantined
+    assert g.rebuilds == 1 and g.trips == 1
+    assert sleeps == [1.0]  # one attempt, base backoff
+    # snapshots were captured ONCE, before the first attempt, and the
+    # SAME dict fed the rebuild (evacuation exports what the bank held)
+    assert sched.captures == 1
+    assert sched.rebuild_calls == [{"sess-a": {"state_b64": "banked"}}]
+    names = [t[0] for t in transitions]
+    assert names == ["EngineDegraded", "EngineRecovered"]
+    rec = transitions[1][1]
+    assert rec["state"] == "ARMED" and rec["attempt"] == 1
+    assert rec["restored"] == 1 and rec["rebuild_ms"] >= 0
+    snap = g.snapshot()
+    assert snap["engine_rebuilds_total"] == 1
+    assert snap["engine_quarantined"] == 0
+    assert snap["engine_rebuild_ms_p50"] >= 0
+    assert snap["engine_rebuild_ms_p99"] >= snap["engine_rebuild_ms_p50"]
+    assert g.retry_after_s() == 0.0
+    g.close()
+
+
+def test_rebuild_exhaustion_evacuates_and_parks_failed():
+    sched = _FakeSched(fail_rebuilds=3)
+    evacuated = []
+    g, transitions, sleeps = _mk_guard(
+        sched, max_attempts=3, backoff_s=1.0,
+        on_exhausted=lambda: evacuated.append(g.state),
+    )
+    with pytest.raises(DeviceLostError):
+        g.dispatch(lambda: (_ for _ in ()).throw(DeviceLostError("x")))
+    assert g.run_rebuild() is False
+    assert sleeps == [1.0, 2.0, 4.0]  # exponential schedule
+    assert g.state == "FAILED" and g.rebuilds == 0
+    # the hook ran DURING evacuation (webhook order: degraded ->
+    # evacuating; the hook sees EVACUATING, FAILED lands after)
+    assert evacuated == ["EVACUATING"]
+    names = [t[0] for t in transitions]
+    assert names == ["EngineDegraded", "AgentEvacuating"]
+    assert g.retry_after_s() == 60.0
+    assert g.snapshot()["engine_quarantined"] == 1
+    g.close()
+
+
+# ---------------------------------------------------------------------------
+# 2. chaos integration: real scheduler, device_lost then wedge mid-stream
+# ---------------------------------------------------------------------------
+
+def _wrap(sess):
+    # the agent's serving shape: every scheduler session rides a
+    # ResilientPipeline (errors/timeouts -> passthrough, never a hang)
+    return ResilientPipeline(sess, step_timeout_s=30.0)
+
+
+def _rtick(rp, frame):
+    # the wrapper's pipelined surface (scheduler sessions expose
+    # submit/fetch, so the wrapper binds them)
+    return np.asarray(rp.fetch(rp.submit(frame)))
+
+
+def _inject(sched, kind):
+    """Activate a one-step engine fault and rebind the scheduler's scope
+    (scopes bind at construction; the test re-binds to inject
+    mid-stream the way FAULT_PLAN-at-boot would have)."""
+    faults.activate(FaultPlan(
+        specs=(FaultSpec(target="engine", kind=kind, start=0, stop=1),),
+        seed=7,
+    ))
+    sched._fault_scope = faults.scope("engine")
+
+
+def test_chaos_device_lost_then_wedge_bitexact_rebuild(
+    bundle, cfg32, monkeypatch
+):
+    """4-session batch: a lost device and then a wedged step each trip
+    the guard mid-stream; every session keeps serving (passthrough,
+    zero dropped futures), and each rebuild restores all four slots
+    bit-exact from the snapshot bank — post-recovery frames equal an
+    unfaulted control scheduler's, frame for frame."""
+    monkeypatch.setenv("ENGINE_SNAPSHOT_EVERY_S", "0.000001")  # bank always
+    # window_ms=0: per-session ticks dispatch immediately (the test
+    # drives sessions serially; a coalescing window would stall them)
+    A = _mk_sched(bundle, cfg32, max_sessions=4, window_ms=0.0)
+    C = _mk_sched(bundle, cfg32, max_sessions=4, window_ms=0.0)  # control
+    guard = EngineGuard(
+        A, deadline_s=0.5, cold_deadline_s=120.0, auto_rebuild=False,
+        sleep=lambda s: None,
+    )
+    rng = np.random.default_rng(19)
+    frames = [
+        rng.integers(0, 256, (32, 32, 3), np.uint8) for _ in range(8)
+    ]
+    keys = ["s0", "s1", "s2", "s3"]
+    try:
+        live = {
+            k: _wrap(A.claim(k, prompt=f"chaos {k}", seed=i))
+            for i, k in enumerate(keys)
+        }
+        ctrl = {
+            k: C.claim(k, prompt=f"chaos {k}", seed=i)
+            for i, k in enumerate(keys)
+        }
+
+        def tick_all(frame):
+            for k in keys:
+                got = _rtick(live[k], frame)
+                want = _tick(ctrl[k], frame)
+                assert np.array_equal(got, want), f"{k}: frame mismatch"
+
+        for f in frames[:4]:  # healthy streaming; bank refreshes each step
+            tick_all(f)
+
+        # -- trip 1: device lost under session s0's dispatch ------------
+        _inject(A, "device_lost")
+        out = _rtick(live["s0"], frames[4])
+        assert np.array_equal(out, frames[4])  # passthrough
+        assert guard.state == "QUARANTINED" and guard.trips == 1
+        # the other three sessions keep serving passthrough — submits
+        # shed immediately (zero dropped futures, nothing hangs)
+        for k in keys[1:]:
+            assert np.array_equal(_rtick(live[k], frames[4]), frames[4])
+        # quarantine refuses claims and serves BANKED snapshots
+        with pytest.raises(Exception, match="quarantined"):
+            A.claim("s-new", prompt="late", seed=9)
+        A.capture_quarantine_snapshots()
+        banked = A.snapshot_session("s0")
+        assert banked["prompt"] == "chaos s0"
+
+        assert guard.run_rebuild() is True
+        assert guard.state == "ARMED" and guard.rebuilds == 1
+
+        # bit-exact proof #1: post-rebuild frames match the control,
+        # which never saw the faulted frame (it was passthrough)
+        tick_all(frames[5])
+
+        # -- trip 2: wedge (blocks until released; only the deadline
+        # layer can notice) ---------------------------------------------
+        _inject(A, "wedge")
+        t0 = time.monotonic()
+        out = _rtick(live["s0"], frames[6])
+        assert np.array_equal(out, frames[6])  # passthrough
+        assert time.monotonic() - t0 < 30.0  # deadline, not the wedge
+        assert guard.state == "QUARANTINED" and guard.trips == 2
+        for k in keys[1:]:
+            assert np.array_equal(_rtick(live[k], frames[6]), frames[6])
+        faults.release_wedge()  # free the abandoned worker
+        assert guard.run_rebuild() is True
+        assert guard.rebuilds == 2
+
+        # bit-exact proof #2, and the frame counters never stalled
+        tick_all(frames[7])
+        for k in keys:
+            snap = live[k].supervisor.snapshot()
+            assert snap["state"] != "FAILED"
+            # every tick delivered a frame (live or passthrough):
+            # zero dropped futures across both trips
+            assert (
+                snap["processed_frames"] + snap["passthrough_frames"]
+                == len(frames)
+            )
+    finally:
+        guard.close()
+        A.close()
+        C.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. HTTP evacuation: exhaustion moves every session to a healthy agent
+# ---------------------------------------------------------------------------
+
+def test_http_evacuation_moves_sessions_and_parks_agent_failed():
+    src = _MigScheduler()
+    dst = _MigScheduler()
+
+    async def go():
+        # register ONLY the sick agent first so both sessions land on it
+        router, router_app, agents, posted = await _fleet_harness([src])
+        try:
+            sids, jids = [], []
+            for _ in range(2):
+                r = await router.post("/offer", json=_offer_body())
+                assert r.status == 200, await r.text()
+                sids.append(r.headers["X-Stream-Id"])
+                jids.append(r.headers["X-Journey-Id"])
+            for sid in sids:
+                sess = src.session(sid)
+                for _ in range(3):
+                    sess(np.zeros((4, 4, 3), np.uint8))
+
+            # the healthy target joins, then the sick agent self-reports
+            app2, client2 = await _spawn_agent(dst)
+            agents.append((app2, client2))
+            r = await router.post("/fleet/register", json={
+                "worker_id": "m-agent1", "public_ip": "127.0.0.1",
+                "public_port": str(client2.server.port), "status": "ready",
+                "capacity": dst.max_sessions,
+            })
+            assert r.status == 200
+            await router_app["poller"].poll_once()
+
+            # wrong/missing token refused; unknown agent 404
+            r = await router.post(
+                "/fleet/evacuate", json={"agent": "m-agent0"}
+            )
+            assert r.status == 401
+            r = await router.post(
+                "/fleet/evacuate", json={"agent": "ghost"},
+                headers={"Authorization": "Bearer t"},
+            )
+            assert r.status == 404
+
+            r = await router.post(
+                "/fleet/evacuate",
+                json={"agent": "m-agent0",
+                      "reason": "engine rebuild exhausted"},
+                headers={"Authorization": "Bearer t"},
+            )
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["agent"] == "m-agent0"
+            assert body["state"] == "FAILED"
+            assert body["evacuating"] == 2
+
+            def _moved():
+                got = [e for e in posted
+                       if e.get("event") == "StreamMigrated"]
+                return got if len(got) == 2 else None
+
+            moved = await _wait_for(
+                _moved, 10, "both StreamMigrated webhooks"
+            )
+            assert {e["reason"] for e in moved} == {"evacuate"}
+            assert {e["target_agent"] for e in moved} == {"m-agent1"}
+            assert {e["stream_id"] for e in moved} == set(sids)
+            assert dst.restores == 2
+            # the sick agent is FAILED and sticky (polls don't revive it)
+            rec = router_app["fleet"].agents["m-agent0"]
+            assert rec.state == "FAILED"
+            await router_app["poller"].poll_once()
+            assert rec.state == "FAILED"
+
+            # journeys carry the WHY: an ``evacuated`` ring entry, and a
+            # client re-offer continues the journey at leg 2 on the
+            # healthy agent with its mid-stream state intact
+            for jid in jids:
+                kinds = [e["kind"] for e in
+                         router_app["journeys"].get(jid)["events"]]
+                assert "evacuated" in kinds and "migrated" in kinds
+            r = await router.post(
+                "/offer", json=_offer_body(),
+                headers={"X-Journey-Id": jids[0]},
+            )
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Journey-Leg"] == "2"
+            new_sid = r.headers["X-Stream-Id"]
+            assert router_app["session_table"].owner(new_sid) == "m-agent1"
+            assert dst.session(new_sid).counter == 3
+
+            m = await (await router.get("/metrics")).json()
+            assert m["evacuations_total"] == 1
+            assert m["fleet_agents_failed"] == 1
+            assert m["evacuation_session_move_ms_p50"] > 0
+            r = await router.get("/metrics", params={"format": "prom"})
+            text = await r.text()
+            assert "# TYPE evacuations_total counter" in text
+        finally:
+            for _app, client in agents:
+                await client.close()
+            await router.close()
+
+    asyncio.run(go())

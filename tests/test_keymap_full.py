@@ -70,6 +70,10 @@ def test_taesd_keymap_full_geometry():
     _roundtrip(params, LD.taesd_key_map(cfg))
 
 
+@pytest.mark.slow  # SD1.5-geometry ControlNet build (~40s on the 1-core
+# box), same reason its UNet full-geometry family is slow; the tiny-
+# geometry sibling (test_controlnet_stream.py::
+# test_controlnet_key_map_covers_params) keeps the keymap surface tier-1
 def test_controlnet_keymap_full_geometry():
     cfg = U.UNetConfig.sd15()
     params = _zeros_tree(

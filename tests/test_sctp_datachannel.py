@@ -324,6 +324,30 @@ class TestReviewR5Fixes:
         _pump(client.retransmit_due(), server, client)
         assert client.established and server.established
 
+    def test_duplicate_init_on_established_does_not_reset(self):
+        # RFC 9260 s5.2.2: a retransmitted INIT landing AFTER the
+        # association established (the client's timer racing a slow
+        # INIT-ACK) must be answered with the EXISTING tag and cookie —
+        # pre-fix the server re-derived _peer_tag/_cum_in from it,
+        # silently desyncing TSN tracking of the live association
+        server = SctpAssociation("server")
+        client = SctpAssociation("client")
+        (init,) = client.start()
+        _pump([init], server, client)
+        assert server.established and client.established
+        tag, cum, cookie = server._peer_tag, server._cum_in, server._cookie
+        (reply,) = server.handle_packet(init)  # replay the original INIT
+        assert reply[12] == 2  # INIT-ACK, not silence
+        assert cookie is not None and cookie in reply
+        assert server._peer_tag == tag and server._cum_in == cum
+        # the association the duplicate tried to reset still carries data
+        got = []
+        server.on_message = lambda ch, m: got.append(m)
+        ch, pkts = client.open_channel("post-dup")
+        _pump(pkts, server, client)
+        _pump(ch.send("still alive"), server, client)
+        assert got == ["still alive"]
+
 
 def test_multipeer_per_peer_prompts_over_native_datachannels(native_lib):
     """--multipeer on the NATIVE secure tier: each peer's datachannel
