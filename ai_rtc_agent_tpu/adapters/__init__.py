@@ -1,0 +1,37 @@
+"""Per-session style adapters: LoRA as a batch axis (ISSUE 20).
+
+The reference bakes ONE LCM-LoRA into the weights at build time
+(lib/wrapper.py fuse; build.py ghibli fuse) — every style change means a
+re-fused engine.  Production is every publisher picking their own style,
+which as fused weights would fragment the batch scheduler into
+per-variant buckets and destroy the cross-session amortization.
+
+This package keeps the BASE weights shared and moves the low-rank deltas
+into the stacked ``[S, ...]`` session STATE instead:
+
+* :class:`~ai_rtc_agent_tpu.adapters.registry.AdapterRegistry` loads
+  kohya/peft LoRA banks through the ``models/lora.py`` parser, resolves
+  them against ``models/loader.unet_key_map``, restricts to the 2-D
+  linear targets the runtime path supports, folds ``scale * alpha/r``
+  into the up factor, and zero-pads ranks to a small closed set of rank
+  buckets so every adapter of a deployment shares ONE bank shape.
+* :func:`~ai_rtc_agent_tpu.adapters.bank.graft_unet_params` splices a
+  bank's (down, up) rows into the UNet param pytree next to each target
+  ``kernel`` — inside the traced step, so the factors flow through the
+  vmapped bucket step per-row and a zero bank contributes exactly 0.0
+  (empty slots and adapterless sessions stay bit-identical to base).
+
+Sessions with DIFFERENT adapters share one executable, one AOT key
+(``(k, variant, rank, dp)``) and one vmapped bucket step; join/leave/
+hot-swap are ``.at[slot].set`` control-plane writes, never retraces.
+"""
+
+from .bank import graft_unet_params, zero_factor_rows
+from .registry import AdapterRegistry, build_registry
+
+__all__ = [
+    "AdapterRegistry",
+    "build_registry",
+    "graft_unet_params",
+    "zero_factor_rows",
+]

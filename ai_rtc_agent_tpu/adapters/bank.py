@@ -1,0 +1,62 @@
+"""Factor-bank pytree helpers (pure — safe inside traced step functions).
+
+A factor bank is ``{dotted_module_path: {"down": [R, in], "up": [out, R]}}``
+addressing 2-D linear modules inside ``params["unet"]``.  Dotted paths use
+the param-tree spelling — int-looking segments index list nodes
+("down_blocks.0.attentions.0.blocks.0.attn1.to_q"); module names never
+contain dots, so the encoding is unambiguous and needs no side table.
+
+``graft_unet_params`` splices the factors in NEXT TO each target kernel
+(``lora_down`` / ``lora_up`` siblings) so ``models/layers.linear`` applies
+``y += (x @ down.T) @ up.T`` per row.  ``scale * alpha/r`` is folded into
+``up`` at registry load time, which makes zero factors contribute exactly
+0.0 — zero-padded rank rows and empty slots are bitwise no-ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _path_parts(path: str):
+    return [int(p) if p.isdigit() else p for p in path.split(".")]
+
+
+def graft_unet_params(unet_params, factors):
+    """Return a shallow-copied UNet param tree with each bank entry's
+    (down, up) pair inserted beside the target module's kernel.  Pure
+    pytree surgery — runs inside jit/vmap tracing; untouched leaves keep
+    identity, so donation and sharding specs are unaffected."""
+    out = unet_params
+    for path, f in factors.items():
+        out = _graft_one(out, _path_parts(path), f)
+    return out
+
+
+def _graft_one(node, parts, f):
+    if not parts:
+        mod = dict(node)
+        mod["lora_down"] = f["down"]
+        mod["lora_up"] = f["up"]
+        return mod
+    copy = dict(node) if isinstance(node, dict) else list(node)
+    copy[parts[0]] = _graft_one(copy[parts[0]], parts[1:], f)
+    return copy
+
+
+def zero_factor_rows(targets, rank: int, dtype=jnp.float32):
+    """Build an all-zero factor bank for one session row.
+
+    ``targets``: {dotted_module_path: (in_dim, out_dim)}.  The zero bank
+    is both the template row every slot is born with and the row a
+    ``clear`` swap writes back — its contribution is exactly 0.0, so an
+    adapterless session through the factors path is bit-identical to the
+    base model.
+    """
+    return {
+        path: {
+            "down": jnp.zeros((rank, dims[0]), dtype),
+            "up": jnp.zeros((dims[1], rank), dtype),
+        }
+        for path, dims in targets.items()
+    }

@@ -1,0 +1,276 @@
+"""AdapterRegistry: the boot-time catalog of per-session style LoRAs.
+
+Loads kohya/peft LoRA banks through :mod:`ai_rtc_agent_tpu.models.lora`'s
+parser, resolves module paths against ``models/loader.unet_key_map``,
+restricts to the 2-D linear targets the runtime factors path supports
+(conv and text-encoder groups are DROPPED with a loud warning — offline
+fusion via ``load_model_bundle(lora_dict=...)`` still covers those), and
+zero-pads every adapter's rank to the smallest blessed rank bucket that
+holds it.
+
+The closed bucket set is the no-retrace contract: the scheduler sizes its
+stacked factor bank ONCE (``bank_rank`` = the largest bucket in use), so
+every join/leave/hot-swap is a same-shaped ``.at[slot].set`` write and the
+AOT key space ``(k, variant, rank, dp)`` stays enumerable for prewarm.
+
+``scale * (alpha/r)`` is folded into the up factor at load, so the runtime
+einsum ``(x @ down.T) @ up.T`` equals the offline-fused update up to float
+association order — and zero rows contribute exactly 0.0 (zero-slot
+exactness; tolerance documented in docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import lora as LR
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_RANK_BUCKETS = (4, 8, 16)
+
+
+def targets_digest(dims: Mapping[str, tuple]) -> str:
+    """Stable short digest of a bank's target set + dims — the
+    exact-match token migration fingerprints carry (the full path list
+    would bloat every snapshot)."""
+    return hashlib.sha256(
+        "|".join(f"{p}:{d[0]}x{d[1]}" for p, d in sorted(dims.items()))
+        .encode()
+    ).hexdigest()[:16]
+
+
+class AdapterRegistry:
+    """Named LoRA factor banks resolved against one UNet's param tree.
+
+    ``unet_params``: the live ``params["unet"]`` pytree (dims are read
+    from the target kernels, so a registry is per-base-model).
+    ``key_map``: ``models/loader.unet_key_map(unet_cfg)``.
+    """
+
+    def __init__(self, unet_params, key_map, rank_buckets=DEFAULT_RANK_BUCKETS):
+        if not rank_buckets or any(int(b) < 1 for b in rank_buckets):
+            raise ValueError(f"rank_buckets must be positive: {rank_buckets!r}")
+        self._unet_params = unet_params
+        self._key_map = key_map
+        self.rank_buckets = tuple(sorted(int(b) for b in rank_buckets))
+        # name -> {dotted_path: {"down": np[Rb, in], "up": np[out, Rb]}}
+        self._adapters: dict[str, dict] = {}
+        self._ranks: dict[str, int] = {}  # name -> bucket rank
+        # dotted_path -> (in_dim, out_dim), union over registered adapters
+        self._dims: dict[str, tuple] = {}
+
+    # -- catalog ------------------------------------------------------------
+
+    @property
+    def names(self) -> list[str]:
+        return sorted(self._adapters)
+
+    def __contains__(self, name) -> bool:
+        return name in self._adapters
+
+    def __len__(self) -> int:
+        return len(self._adapters)
+
+    @property
+    def bank_rank(self) -> int:
+        """The stacked bank's rank: largest bucket any adapter occupies
+        (0 when the catalog is empty — the factors path stays off)."""
+        return max(self._ranks.values(), default=0)
+
+    @property
+    def targets(self) -> dict[str, tuple]:
+        """{dotted_module_path: (in_dim, out_dim)} — union over adapters."""
+        return dict(self._dims)
+
+    def rank_of(self, name: str) -> int:
+        return self._ranks[name]
+
+    def fingerprint(self) -> dict:
+        """Exact-match identity of the bank SHAPE (not the styles): the
+        migration fingerprint embeds this so factor rows only land on a
+        scheduler whose bank has the same rank and target set.  Adapter
+        NAMES are deliberately excluded — the factors travel in the row
+        itself, so the destination catalog may differ."""
+        return {
+            "adapter_rank": self.bank_rank,
+            "adapter_targets": targets_digest(self._dims),
+        }
+
+    # -- loading ------------------------------------------------------------
+
+    def add(self, name: str, lora_groups: Mapping[str, dict], scale: float = 1.0):
+        """Resolve + pad one parsed LoRA into the catalog.
+
+        Returns ``(applied, dropped_paths)``.  ``applied == 0`` is a
+        hard error (same discipline as the offline fuse at
+        models/registry.py: a misnamed adapter must not register as a
+        no-op style).  A shape mismatch against the base kernels is a
+        hard error too (wrong base model).
+        """
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"bad adapter name {name!r}")
+        factors: dict[str, dict] = {}
+        dims: dict[str, tuple] = {}
+        dropped: list[str] = []
+        for path, g in lora_groups.items():
+            if path.startswith(("te.", "text_encoder.")):
+                dropped.append(path)  # runtime adapters are unet-only
+                continue
+            target = LR.resolve_lora_target(path, self._key_map)
+            if target is None:
+                dropped.append(path)
+                continue
+            kernel = self._leaf(target)
+            if np.ndim(kernel) != 2:
+                dropped.append(path)  # conv targets: offline fuse only
+                continue
+            in_dim, out_dim = kernel.shape
+            down = np.asarray(g["down"], np.float32).reshape(g["down"].shape[0], -1)
+            up = np.asarray(g["up"], np.float32).reshape(g["up"].shape[0], -1)
+            r = down.shape[0]
+            if down.shape[1] != in_dim or up.shape != (out_dim, r):
+                raise ValueError(
+                    f"adapter {name!r} path {path!r}: factors "
+                    f"{down.shape}/{up.shape} do not fit kernel "
+                    f"[{in_dim},{out_dim}] — wrong base model?"
+                )
+            bucket = self._bucket_for(name, path, r)
+            s = float(scale) * (float(g["alpha"]) / r if g.get("alpha") is not None else 1.0)
+            pd = np.zeros((bucket, in_dim), np.float32)
+            pd[:r] = down
+            pu = np.zeros((out_dim, bucket), np.float32)
+            pu[:, :r] = up * s
+            mod_path = ".".join(str(p) for p in target[:-1])
+            factors[mod_path] = {"down": pd, "up": pu}
+            dims[mod_path] = (in_dim, out_dim)
+        if not factors:
+            raise ValueError(
+                f"adapter {name!r}: matched 0 of {len(lora_groups)} modules "
+                f"({len(dropped)} dropped; first: {dropped[:3]}) — wrong "
+                "file or wrong base model"
+            )
+        if dropped:
+            logger.warning(
+                "adapter %r: %d/%d module paths DROPPED (text-encoder/conv/"
+                "unmatched — runtime factor banks cover 2-D unet linears "
+                "only; use offline lora_dict fusion for the rest). First: %s",
+                name, len(dropped), len(lora_groups), dropped[:5],
+            )
+        bucket = max(
+            (f["down"].shape[0] for f in factors.values()), default=0
+        )
+        self._adapters[name] = factors
+        self._ranks[name] = bucket
+        self._dims.update(dims)
+        logger.info(
+            "adapter %r registered: %d modules, rank bucket %d (%d dropped)",
+            name, len(factors), bucket, len(dropped),
+        )
+        return len(factors), dropped
+
+    def load_file(self, name: str, path: str, scale: float = 1.0):
+        from ..models import loader as LD
+
+        sd = LD.read_safetensors(path)
+        groups = LR.parse_lora_state_dict(sd)
+        return self.add(name, groups, scale=scale)
+
+    def _bucket_for(self, name, path, r):
+        for b in self.rank_buckets:
+            if r <= b:
+                return b
+        raise ValueError(
+            f"adapter {name!r} path {path!r}: rank {r} exceeds the largest "
+            f"blessed bucket {self.rank_buckets[-1]} (ADAPTER_RANK_BUCKETS) "
+            "— refusing to truncate a style silently"
+        )
+
+    def _leaf(self, target):
+        node = self._unet_params
+        for p in target:
+            node = node[p]
+        return node
+
+    # -- bank rows ----------------------------------------------------------
+
+    def factor_rows(self, name: str | None, rank: int | None = None,
+                    targets: Mapping[str, tuple] | None = None,
+                    dtype=jnp.float32):
+        """One session row of the stacked bank: adapter ``name``'s factors
+        zero-extended to ``rank`` over the full ``targets`` set (zeros at
+        targets the adapter does not touch).  ``name=None`` is the all-zero
+        row (no style).  Raises KeyError for an unknown name and
+        ValueError when the adapter cannot fit the bound bank shape."""
+        rank = self.bank_rank if rank is None else int(rank)
+        targets = self.targets if targets is None else dict(targets)
+        if name is None:
+            from .bank import zero_factor_rows
+
+            return zero_factor_rows(targets, rank, dtype)
+        if name not in self._adapters:
+            raise KeyError(
+                f"unknown adapter {name!r} (registered: {self.names})"
+            )
+        if self._ranks[name] > rank:
+            raise ValueError(
+                f"adapter {name!r} rank bucket {self._ranks[name]} exceeds "
+                f"the bound bank rank {rank} — rebuild the scheduler to "
+                "widen the bank"
+            )
+        factors = self._adapters[name]
+        rows = {}
+        for path, (in_dim, out_dim) in targets.items():
+            f = factors.get(path)
+            down = np.zeros((rank, in_dim), np.float32)
+            up = np.zeros((out_dim, rank), np.float32)
+            if f is not None:
+                rb = f["down"].shape[0]
+                down[:rb] = f["down"]
+                up[:, :rb] = f["up"]
+            rows[path] = {
+                "down": jnp.asarray(down, dtype),
+                "up": jnp.asarray(up, dtype),
+            }
+        unknown = set(factors) - set(targets)
+        if unknown:
+            raise ValueError(
+                f"adapter {name!r} touches modules outside the bound bank "
+                f"target set: {sorted(unknown)[:3]} — rebuild the scheduler"
+            )
+        return rows
+
+
+def build_registry(unet_params, unet_cfg, directory: str | None = None,
+                   rank_buckets=None):
+    """Boot-time helper: a registry over ``directory``'s ``*.safetensors``
+    (adapter name = file stem).  ``directory=None`` (ADAPTER_DIR unset)
+    returns an EMPTY registry — bank_rank 0, factors path off, AOT keys
+    unchanged.  A file that fails to parse/resolve refuses the boot (a
+    half-loaded catalog would serve wrong styles silently)."""
+    from ..models import loader as LD
+    from ..utils import env
+
+    if rank_buckets is None:
+        rank_buckets = env.adapter_rank_buckets()
+    reg = AdapterRegistry(unet_params, LD.unet_key_map(unet_cfg),
+                          rank_buckets=rank_buckets)
+    if directory:
+        names = sorted(
+            f for f in os.listdir(directory) if f.endswith(".safetensors")
+        )
+        for fname in names:
+            reg.load_file(fname[: -len(".safetensors")],
+                          os.path.join(directory, fname))
+        logger.info(
+            "adapter registry: %d adapters from %s (bank rank %d, %d "
+            "target modules)", len(reg), directory, reg.bank_rank,
+            len(reg.targets),
+        )
+    return reg

@@ -75,6 +75,18 @@ def mesh_key_extra(mesh) -> dict:
     return {"dp": dp} if dp > 1 else {}
 
 
+def adapter_key_extra(rank: int) -> dict:
+    """Engine-key extras for the per-session LoRA factor bank (adapters/):
+    same empty-when-disabled discipline as :func:`mesh_key_extra` — an
+    adapterless scheduler (bank rank 0) keeps every pre-existing key
+    valid, while a bank-carrying executable keys on its padded rank so
+    the AOT space is ``(k, variant, rank, dp)``.  Rank is the ONLY shape
+    axis the bank adds: target set and adapter names live in the stacked
+    state, so swaps never touch the key."""
+    rank = int(rank or 0)
+    return {"lrank": rank} if rank > 0 else {}
+
+
 def _digest(key: str, args_spec: str, platform: str) -> str:
     h = hashlib.sha256(f"{key}|{args_spec}|{platform}|{jax.__version__}".encode())
     return h.hexdigest()[:16]

@@ -73,6 +73,15 @@ def _kernel(p, dtype):
 def linear(p, x):
     w = _kernel(p, x.dtype)
     y = x @ w
+    if "lora_down" in p:
+        # per-session LoRA factor rows grafted by adapters/bank.py: the
+        # low-rank residual (x @ down.T) @ up.T with scale*alpha/r folded
+        # into up at load.  Zero factors contribute exactly 0.0 (empty
+        # slots stay bit-identical to base); composes with the w8 branch
+        # above because the residual reads the factors, not the kernel.
+        down = p["lora_down"].astype(x.dtype)
+        up = p["lora_up"].astype(x.dtype)
+        y = y + (x @ down.T) @ up.T
     if "bias" in p:
         y = y + p["bias"].astype(x.dtype)
     return y

@@ -94,28 +94,51 @@ def _normalize_kohya_path(path: str) -> str:
     return path
 
 
+def resolve_lora_target(path: str, key_map):
+    """Map one parsed LoRA module path onto our param-tree path tuple.
+
+    Accepts both the diffusers dotted spelling and the kohya underscore
+    spelling (module names legitimately contain underscores — to_q,
+    transformer_blocks — so matching is done on an underscore-normalized
+    basis against the weight key map).  Returns None when the path does
+    not address a module of this UNet."""
+    u_map = _underscore_map(key_map)
+    mod = path.split(".", 1)[1] if path.startswith(("unet.", "te.", "text_encoder.")) else path
+    return key_map.get(mod + ".weight") or u_map.get(mod.replace(".", "_"))
+
+
+def _underscore_map(key_map):
+    return {
+        k[: -len(".weight")].replace(".", "_"): v
+        for k, v in key_map.items()
+        if k.endswith(".weight")
+    }
+
+
 def fuse_lora_into_unet(params, lora_groups, key_map, scale: float = 1.0):
     """Fuse parsed LoRA groups into a UNet param pytree.
 
     ``key_map``: {diffusers module path -> (our path tuple)} from
     models.loader.unet_key_map — LoRA paths address the same modules as the
     weight keys minus the trailing ".weight".
+
+    Returns ``(params, applied, unmatched)``: unmatched is the list of
+    LoRA module paths that resolved to nothing in this UNet.  A non-empty
+    unmatched list is warned LOUDLY here (a partially-fused style is a
+    silently wrong style); deciding whether applied == 0 is fatal belongs
+    to the call site (models/registry.py errors — a fully-misnamed adapter
+    must not fuse to a no-op).
     """
     import copy
+    import logging
 
     params = copy.copy(params)  # shallow; leaves replaced immutably below
-    # underscore-normalized lookup: "down_blocks.0...attn1.to_q" and the
-    # kohya spelling "down_blocks_0...attn1_to_q" both resolve
-    u_map = {
-        k[: -len(".weight")].replace(".", "_"): v
-        for k, v in key_map.items()
-        if k.endswith(".weight")
-    }
     applied = 0
+    unmatched: list[str] = []
     for path, g in lora_groups.items():
-        mod = path.split(".", 1)[1] if path.startswith(("unet.", "te.", "text_encoder.")) else path
-        target = key_map.get(mod + ".weight") or u_map.get(mod.replace(".", "_"))
+        target = resolve_lora_target(path, key_map)
         if target is None:
+            unmatched.append(path)
             continue
         params = _replace_leaf(
             params,
@@ -123,7 +146,14 @@ def fuse_lora_into_unet(params, lora_groups, key_map, scale: float = 1.0):
             lambda k: fuse_lora_delta(k, g["down"], g["up"], scale, g.get("alpha")),
         )
         applied += 1
-    return params, applied
+    if unmatched:
+        logging.getLogger(__name__).warning(
+            "LoRA fuse: %d/%d module paths matched nothing in this UNet "
+            "and were DROPPED — the fused style is partial. First "
+            "unmatched: %s",
+            len(unmatched), len(lora_groups), unmatched[:5],
+        )
+    return params, applied, unmatched
 
 
 def _replace_leaf(tree, path, fn):

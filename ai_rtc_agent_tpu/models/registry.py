@@ -335,10 +335,21 @@ def load_model_bundle(
         for path, scale in lora_dict.items():
             sd = LD.read_safetensors(path)
             groups = LR.parse_lora_state_dict(sd)
-            params["unet"], n = LR.fuse_lora_into_unet(
+            params["unet"], n, unmatched = LR.fuse_lora_into_unet(
                 params["unet"], groups, km, scale=scale
             )
-            logger.info("fused LoRA %s (scale %s): %d modules", path, scale, n)
+            if n == 0:
+                # a misnamed/mismatched adapter used to fuse to a no-op
+                # style with only a debug line to show for it — refuse
+                raise ValueError(
+                    f"LoRA {path!r} matched 0 of {len(groups)} modules in "
+                    f"this UNet ({len(unmatched)} unmatched; first: "
+                    f"{unmatched[:3]}) — wrong file or wrong base model"
+                )
+            logger.info(
+                "fused LoRA %s (scale %s): %d modules (%d unmatched)",
+                path, scale, n, len(unmatched),
+            )
 
     tok = TK.find_clip_tokenizer(snap or "", max_length=clip_cfg.max_length)
     if fam in ("tiny", "tinyxl"):

@@ -323,6 +323,26 @@ def apply_runtime_config(pipeline, config: dict, encoders=None):
                 "encoder reconfigure not supported by this provider"
             )
         encoder = encoders.validate_encoder_config(encoder)  # BEFORE mutation
+    # style-adapter hot-swap (adapters/, ISSUE 20): PRESENCE-keyed so JSON
+    # null clears back to the zero bank ({"adapter": null} != key absent);
+    # capability-checked here like guidance — only the batch scheduler's
+    # factor-bank surface carries it
+    has_adapter = "adapter" in config
+    update_adapter = getattr(pipeline, "update_adapter", None)
+    if has_adapter:
+        if update_adapter is None:
+            raise ValueError(
+                "adapter hot-swap not supported by this pipeline (the "
+                "batch scheduler with a bound adapter registry owns it)"
+            )
+        adapter = config["adapter"]
+        if adapter is not None and not isinstance(adapter, str):
+            raise ValueError("adapter must be a string name or null")
+    if has_adapter:
+        # applied FIRST: update_adapter validates the name against the
+        # registry before touching any slot (unknown -> ValueError -> 400
+        # with nothing else applied yet)
+        update_adapter(adapter)
     t_index_list = config.get("t_index_list")
     if t_index_list is not None:
         pipeline.update_t_index_list(t_index_list)
@@ -2107,8 +2127,24 @@ async def on_startup(app):
             from ..stream.scheduler import BatchScheduler
 
             try:
+                # per-session style adapters (adapters/, ISSUE 20): load
+                # the ADAPTER_DIR catalog against THIS pipeline's UNet and
+                # bind its factor bank into the scheduler's stacked state.
+                # A bad catalog refuses the scheduler (shared-engine
+                # fallback below), never serves half-loaded styles.
+                adapters = None
+                adir = env.adapter_dir()
+                if adir:
+                    from ..adapters import build_registry
+
+                    pipe = app["pipeline"]
+                    adapters = build_registry(
+                        pipe.engine.params["unet"], pipe._bundle.unet_cfg,
+                        adir,
+                    )
                 app["batch_scheduler"] = BatchScheduler.from_pipeline(
-                    app["pipeline"], dp=env.batchsched_dp()
+                    app["pipeline"], dp=env.batchsched_dp(),
+                    adapters=adapters,
                 )
             except Exception:
                 logger.exception(

@@ -299,6 +299,20 @@ def make_step_fn(models: StreamModels, cfg: StreamConfig,
         """frame_u8: [fbs,H,W,3] (or [H,W,3] when fbs==1) uint8 RGB."""
         coeffs = _as_step_coeffs(state["coeffs"])
 
+        # ---- per-session style adapters (adapters/): graft the slot's
+        # LoRA factor rows beside the target kernels so layers.linear
+        # applies the low-rank residual per row INSIDE the (possibly
+        # vmapped) step.  Pure pytree surgery at trace time — untouched
+        # leaves keep identity, zero rows are a bitwise no-op, and the
+        # factors ride `state` through donation like every other leaf.
+        if "adapters" in state:
+            from ..adapters import graft_unet_params
+
+            params = dict(params)
+            params["unet"] = graft_unet_params(
+                params["unet"], state["adapters"]
+            )
+
         # ---- encode the incoming frame(s) to the noisiest stage ----
         if cfg.mode == "img2img":
             img = I.preprocess_uint8(frame_u8, dtype=dt)  # [fbs,H,W,3]

@@ -124,7 +124,11 @@ __all__ = [
 # guessing, because a misread row becomes silently wrong pixels on
 # another agent (the blob itself carries a second, byte-layout version
 # inside parallel/checkpoint.serialize_pytree).
-SESSION_SNAPSHOT_SCHEMA = 1
+# v2 (ISSUE 20): the state row may carry the per-session LoRA factor bank
+# ("adapters" subtree — migration moves style bit-exact) and the payload
+# gains the "adapter" name field; the fingerprint gains adapter_rank /
+# adapter_targets when a bank is bound.
+SESSION_SNAPSHOT_SCHEMA = 2
 
 
 class SnapshotMismatch(ValueError):
@@ -212,6 +216,7 @@ class ScheduledSession:
         self.guidance_scale = owner.guidance_scale
         self.delta = owner.delta
         self.t_index_list = list(owner.t_index_list)
+        self.adapter: str | None = None  # set by claim/restore/update paths
         self._seed = seed
         self._released = False
         cfg = owner.cfg
@@ -418,6 +423,14 @@ class ScheduledSession:
         if d is not None:
             self.delta = d
 
+    def update_adapter(self, name: str | None):
+        """Hot-swap THIS slot's style-adapter factor rows (``None`` clears
+        back to the zero bank).  A same-shaped ``.at[slot].set`` write on
+        the stacked bank — validated against the registry BEFORE any
+        state is touched, never a retrace."""
+        self._owner._apply_adapter(self.slot, name)
+        self.adapter = name
+
     def restart(self):
         """Supervisor recovery hook: a fresh stream state for THIS slot
         (clearing poisoned latents) on the same compiled bucket
@@ -434,7 +447,7 @@ class ScheduledSession:
             return
         state = self._owner._build_state(
             self.prompt, self.guidance_scale, self.delta, self._seed,
-            t_index_list=self.t_index_list,
+            t_index_list=self.t_index_list, adapter=self.adapter,
         )
         self._owner._install(self.slot, state)
 
@@ -456,6 +469,11 @@ class ScheduledSession:
         if owner.dp > 1:
             # which mesh shard this session's state row lives on (/health)
             out["shard"] = owner._slot_shard(self.slot)
+        if owner._adapter_rank:
+            # per-session style (/health): which adapter rides this slot's
+            # factor rows and the bank's padded rank
+            out["adapter"] = self.adapter
+            out["adapter_rank"] = owner._adapter_rank
         return out
 
 
@@ -486,6 +504,7 @@ class BatchScheduler:
         cache_dir: str | None = None,
         mesh=None,
         dp: int | None = None,
+        adapters=None,
     ):
         from .pipeline import (
             DEFAULT_DELTA,
@@ -563,6 +582,27 @@ class BatchScheduler:
         )
         self.delta = DEFAULT_DELTA if delta is None else delta
         self.t_index_list = list(cfg.t_index_list)
+        # -- per-session style adapters (adapters/, ISSUE 20) ----------------
+        # the registry's bank shape is BOUND here, once: rank = the largest
+        # blessed bucket in use, targets = the union module set.  Every
+        # later swap must fit this shape (same-shaped .at[slot].set — never
+        # a retrace); an EMPTY/absent registry keeps the factors path off
+        # and the stacked state / AOT keys identical to an adapterless
+        # build.
+        self.adapters = adapters
+        self._adapter_rank = int(adapters.bank_rank) if adapters is not None else 0
+        self._adapter_targets = dict(adapters.targets) if self._adapter_rank else {}
+        self._adapter_dtype = cfg.jdtype
+        if self._adapter_rank:
+            from ..adapters import zero_factor_rows
+
+            self._zero_rows = zero_factor_rows(
+                self._adapter_targets, self._adapter_rank, self._adapter_dtype
+            )
+        else:
+            self._zero_rows = None
+        self.default_adapter: str | None = None  # global /config default
+        self.adapter_swaps_total = 0
         # amortized admission feed: callable(dt_s, occupancy) — the agent
         # wires this to the overload plane's step EWMA as dt/occupancy
         self.on_step = None
@@ -617,8 +657,16 @@ class BatchScheduler:
             self.prompt, guidance_scale=self.guidance_scale,
             delta=self.delta, seed=0,
         )
+        tmpl_state = self._template.state
+        if self._adapter_rank:
+            # the factor bank stacks WITH the latents: every slot is born
+            # on the zero rows (a bitwise no-op through layers.linear), so
+            # the bank changes shapes exactly once — at bind — and every
+            # adapter install afterwards is a control-plane write
+            tmpl_state = dict(tmpl_state)
+            tmpl_state["adapters"] = self._zero_rows
         self.states = jax.tree.map(
-            lambda x: jnp.stack([x] * S), self._template.state
+            lambda x: jnp.stack([x] * S), tmpl_state
         )
         if self.dp > 1:
             # materialize the session-axis shards NOW: every later install
@@ -773,16 +821,24 @@ class BatchScheduler:
         session_key: str | None = None,
         prompt: str | None = None,
         seed: int | None = None,
+        adapter: str | None = None,
     ) -> ScheduledSession:
         """Claim a slot for a new connection; raises CapacityError when
         full (the agent maps it to 503 + Retry-After).  The heavy state
         build (text-encode + prepare) runs OUTSIDE the step lock so live
-        sessions keep batching while someone joins."""
+        sessions keep batching while someone joins.  ``adapter`` picks the
+        session's style-adapter factor rows (default: the scheduler-level
+        default the global update_adapter sets; validated against the
+        registry before any state is touched)."""
         g = self._guard
         if g is not None and g.quarantined:
             # no dispatch plane to serve the new session — same 503 +
             # Retry-After surface as a full pool (docs/resilience.md)
             raise CapacityError("engine quarantined — rebuild in progress")
+        adapter = self.default_adapter if adapter is None else adapter
+        # validate BEFORE claiming a slot (an unknown name must not churn
+        # the slot pool or pay the heavy prepare)
+        self._adapter_rows(adapter)
         with self._lock:
             slot = self._pick_slot_locked()
             self.active[slot] = True
@@ -791,7 +847,7 @@ class BatchScheduler:
         try:
             state = self._build_state(
                 prompt, self.guidance_scale, self.delta, seed,
-                t_index_list=self.t_index_list,
+                t_index_list=self.t_index_list, adapter=adapter,
             )
         except Exception:
             with self._lock:
@@ -800,6 +856,7 @@ class BatchScheduler:
         sess = ScheduledSession(
             self, slot, session_key or f"slot-{slot}", prompt, seed
         )
+        sess.adapter = adapter
         try:
             with self._has_work:
                 self._install_locked(slot, state)
@@ -873,7 +930,7 @@ class BatchScheduler:
         things the compiled bucket steps bake in.  A mismatch is a
         refused restore, never a reshape."""
         qextra = params_variant_extra(self.params)
-        return {
+        fp = {
             "model_id": self.model_id,
             "height": self.height,
             "width": self.width,
@@ -884,6 +941,17 @@ class BatchScheduler:
             "similar_filter": bool(self.cfg.similar_image_filter),
             "quant": str(qextra.get("quant", "")),
         }
+        if self._adapter_rank:
+            # the factor bank is part of the compiled row shape: rows only
+            # land on a scheduler whose bank has the same padded rank and
+            # target-module set (names stay out — the factors travel in
+            # the row itself).  Adapterless schedulers omit the keys, so
+            # their snapshots keep restoring against each other.
+            from ..adapters.registry import targets_digest
+
+            fp["adapter_rank"] = self._adapter_rank
+            fp["adapter_targets"] = targets_digest(self._adapter_targets)
+        return fp
 
     def snapshot_session(self, session_key: str) -> dict:
         """Serialize one live session for migration: its state row of the
@@ -957,6 +1025,10 @@ class BatchScheduler:
             # so these ride along for observability, not for replay
             "cache_tick": int(cache_tick),
             "cache_uncaptured": bool(cache_uncaptured),
+            # which adapter rides this row's factor bank (observability +
+            # post-restore hot-swap bookkeeping; the factors themselves
+            # travel bit-exact inside state_b64)
+            "adapter": sess.adapter,
             "state_b64": base64.b64encode(serialize_pytree(row)).decode(
                 "ascii"
             ),
@@ -1057,6 +1129,8 @@ class BatchScheduler:
         sess.guidance_scale = guidance
         sess.delta = delta
         sess.t_index_list = t_index_list
+        adapter = snapshot.get("adapter")
+        sess.adapter = str(adapter) if adapter is not None else None
         sess._had_output = bool(snapshot.get("had_output", False))
         sess.frames_submitted = int(snapshot.get("frames_submitted", 0))
         sess.frames_skipped_similar = int(
@@ -1094,9 +1168,31 @@ class BatchScheduler:
 
     # -- heavy/cheap state plumbing -------------------------------------------
 
-    def _build_state(self, prompt, guidance, delta, seed, t_index_list=None):
+    def _adapter_rows(self, name: str | None):
+        """One session row of the factor bank for adapter ``name`` at the
+        BOUND shape (the zero rows for None), or None when no bank is
+        bound.  Raises before any state is touched: a requested adapter
+        with no registry, an unknown name, or an adapter that outgrew the
+        bound rank must refuse the claim/swap cleanly."""
+        if not self._adapter_rank:
+            if name is not None:
+                raise ValueError(
+                    f"adapter {name!r} requested but this scheduler has no "
+                    "adapter registry bound (set ADAPTER_DIR and restart)"
+                )
+            return None
+        if name is None:
+            return self._zero_rows
+        return self.adapters.factor_rows(
+            name, rank=self._adapter_rank, targets=self._adapter_targets,
+            dtype=self._adapter_dtype,
+        )
+
+    def _build_state(self, prompt, guidance, delta, seed, t_index_list=None,
+                     adapter: str | None = None):
         from .engine import _coeff_state
 
+        rows = self._adapter_rows(adapter)  # validate before the heavy build
         # devtel: a session claim at serve time runs host-side eager ops
         # whose tiny per-op compiles are expected costs, not retrace
         # breaches (the watchdog still records + attributes them)
@@ -1112,6 +1208,11 @@ class BatchScheduler:
                 state["coeffs"] = _coeff_state(
                     self.cfg, self._template.schedule, tuple(t_index_list)
                 )
+            if rows is not None:
+                # the row must mirror the stacked pytree's structure —
+                # _install_locked's .at[slot].set pairs leaf-for-leaf
+                state = dict(state)
+                state["adapters"] = rows
             return state
 
     def _install(self, slot: int, state):
@@ -1197,6 +1298,31 @@ class BatchScheduler:
                     .set(jnp.asarray(delta, jnp.float32))
                 )
 
+    def _apply_adapter(self, slot: int, name: str | None):
+        """Swap one slot's factor rows in the stacked bank — the hot-swap
+        core: same-shaped ``.at[slot].set`` writes per target (the closed
+        rank-bucket contract makes every adapter the SAME shape), so the
+        compiled bucket steps never retrace.  ``None`` writes the zero
+        rows back (exact no-style)."""
+        rows = self._adapter_rows(name)  # raises BEFORE any write
+        if rows is None:
+            raise ValueError(
+                "adapter hot-swap unavailable: no adapter registry bound "
+                "(set ADAPTER_DIR and restart)"
+            )
+        with self._lock, devtel.expected_scope("sched-control-write"):
+            bank = self.states["adapters"]
+            for path, f in rows.items():
+                bank[path]["down"] = bank[path]["down"].at[slot].set(f["down"])
+                bank[path]["up"] = bank[path]["up"].at[slot].set(f["up"])
+            self.adapter_swaps_total += 1
+            if self._cache_interval:
+                # DeepCache: deep features captured under the OLD style
+                # must not serve under the new one — same recapture
+                # contract as a prompt write
+                self._tick = 0
+                self._uncaptured.add(slot)
+
     # -- global control plane (POST /config parity: applies to every live
     # session AND becomes the default for future ones) ------------------------
 
@@ -1253,6 +1379,29 @@ class BatchScheduler:
         if d is not None:
             self.delta = d
 
+    def update_adapter(self, name: str | None):
+        """Global adapter swap (POST /config parity with the other
+        update_* surfaces): applies to every live session AND becomes the
+        default future claims are born with; ``None`` clears to the zero
+        bank.  Validated once up front, so a bad name fails THIS call
+        even with zero live sessions."""
+        self._adapter_rows(name)
+        if not self._adapter_rank:
+            # name=None with no bank: nothing to clear, but the operator
+            # surface must still say why a swap can never work here
+            raise ValueError(
+                "adapter hot-swap unavailable: no adapter registry bound "
+                "(set ADAPTER_DIR and restart)"
+            )
+        with self._lock:
+            slots = list(self._sessions)
+        for s in slots:
+            self._apply_adapter(s, name)
+            sess = self._sessions.get(s)
+            if sess is not None:
+                sess.adapter = name
+        self.default_adapter = name
+
     # -- bucket executables ---------------------------------------------------
 
     def _bucket_for(self, n: int) -> int:
@@ -1287,9 +1436,12 @@ class BatchScheduler:
     def _bucket_label(self, k: int, variant: str) -> str:
         """Devtel compile-attribution scope for one bucket geometry — the
         mesh shape rides the label (``sbucket-<k>:<variant>:dp<N>``) so a
-        serve-time reshard retrace alerts with the right key; dp=1 keeps
-        the original spelling."""
+        serve-time reshard retrace alerts with the right key; a bound
+        factor bank adds its padded rank (``:r<R>``) the same way.  An
+        adapterless dp=1 scheduler keeps the original spelling."""
         label = f"sbucket-{k}:{variant}"
+        if self._adapter_rank:
+            label = f"{label}:r{self._adapter_rank}"
         return f"{label}:dp{self.dp}" if self.dp > 1 else label
 
     def _bucket_step(self, k: int, variant: str = "full"):
@@ -1345,18 +1497,23 @@ class BatchScheduler:
         capture+cached PAIR per bucket, w8-quantized params add
         ``quant-w8`` the way ``attn``/``fused`` already ride the key, and
         a dp mesh adds ``dp-N`` via ``aot/cache.mesh_key_extra`` so a
-        sharded executable never collides with the single-device slot)."""
-        from ..aot.cache import mesh_key_extra
+        sharded executable never collides with the single-device slot,
+        and a bound factor bank adds ``lrank-R`` via
+        ``aot/cache.adapter_key_extra`` — the AOT key space is
+        ``(k, variant, rank, dp)``)."""
+        from ..aot.cache import adapter_key_extra, mesh_key_extra
 
         model_id = model_id or self.model_id
         qextra = params_variant_extra(self.params)
         mextra = mesh_key_extra(self.mesh)
+        aextra = adapter_key_extra(self._adapter_rank)
         return {
             (k, v): stream_engine_key(
                 model_id, self.cfg, sbucket=k, sessions=self.max_sessions,
                 **({"variant": v} if v != "full" else {}),
                 **qextra,
                 **mextra,
+                **aextra,
             )
             for k in self._bucket_sizes
             for v in self._variants
@@ -1583,6 +1740,7 @@ class BatchScheduler:
                         row = self._build_state(
                             sess.prompt, sess.guidance_scale, sess.delta,
                             sess._seed, t_index_list=sess.t_index_list,
+                            adapter=sess.adapter,
                         )
                 else:
                     if placeholder is None:
@@ -1940,6 +2098,7 @@ class BatchScheduler:
                         self._build_state(
                             sess.prompt, sess.guidance_scale, sess.delta,
                             sess._seed, t_index_list=sess.t_index_list,
+                            adapter=sess.adapter,
                         )
                     )
                 else:
@@ -2264,6 +2423,16 @@ class BatchScheduler:
                 str(k): v for k, v in sorted(self._occ_hist.items())
             },
         }
+        if self._adapter_rank:
+            # style-adapter plane (adapters/): live sessions riding a
+            # non-zero factor bank + total hot-swap control writes.
+            # Lock-free like every gauge here (safe_list dict scan).
+            out["adapter_sessions"] = sum(
+                1 for s in safe_list(self._sessions.values())
+                if s.adapter is not None
+            )
+            out["adapter_swaps_total"] = self.adapter_swaps_total
+            out["adapter_rank"] = self._adapter_rank
         if self.dp > 1:
             # per-shard live-session occupancy (_slot_shard residence —
             # claim() balances it): the operator's view of how evenly the

@@ -217,6 +217,38 @@ def batchsched_dp() -> int:
     return max(1, mesh_shape()[0])
 
 
+def adapter_dir() -> str | None:
+    """Boot-time style-adapter catalog (ADAPTER_DIR): a directory of
+    ``*.safetensors`` LoRA banks (adapter name = file stem) loaded into
+    the AdapterRegistry and served as per-session factor banks through
+    the batch scheduler (adapters/).  Unset (default) keeps the factors
+    path OFF — the stacked state carries no bank, and AOT keys are
+    unchanged from an adapterless build."""
+    return get_str("ADAPTER_DIR")
+
+
+def adapter_rank_buckets() -> tuple:
+    """Blessed LoRA rank buckets (ADAPTER_RANK_BUCKETS, e.g. "4,8,16"):
+    every adapter is zero-padded to the smallest bucket that holds its
+    rank, and the scheduler sizes its stacked factor bank at the largest
+    bucket in use — the closed set is what keeps hot-swaps same-shaped
+    (never a retrace) and the (k, variant, rank, dp) AOT key space
+    enumerable for prewarm.  An adapter above the largest bucket is
+    REFUSED, never truncated."""
+    v = get_str("ADAPTER_RANK_BUCKETS")
+    if not v:
+        return (4, 8, 16)
+    try:
+        buckets = tuple(sorted(int(p) for p in v.split(",") if p.strip()))
+    except ValueError as e:
+        raise ValueError(
+            f"ADAPTER_RANK_BUCKETS={v!r} is not comma-separated ints"
+        ) from e
+    if not buckets or any(b < 1 for b in buckets):
+        raise ValueError(f"ADAPTER_RANK_BUCKETS={v!r}: buckets must be >= 1")
+    return buckets
+
+
 def mesh_shape() -> tuple:
     """(dp, tp, sp) serving-mesh axis sizes from MESH_SHAPE ("8,1,1" or
     "8x1x1"; trailing axes default to 1) — the declarative alternative to

@@ -1,0 +1,245 @@
+"""Per-session style adapters: LoRA as a batch axis vs dedicated fusion.
+
+Measures the adapter subsystem's economic claim (ISSUE 20 / ROADMAP
+multi-tenant lever): N sessions each wanting a DIFFERENT style.  The
+pre-adapter answer is N dedicated engines, each with its style fused
+offline into its own weight copy — N sequential device steps per frame
+tick and N full UNet weight sets resident.  The adapter answer is ONE
+batch scheduler whose stacked factor bank carries each session's
+(down, up) rows: one vmapped bucket step over shared base weights per
+tick.
+
+Two legs on the hermetic tiny model (same host-machinery argument as
+scripts/batch_scheduler_bench.py — on real accelerators the batch
+additionally rides idle matrix-unit capacity):
+
+  dedicated: N engines (shared jitted step — the step fn is pure in
+             params, so the N weight copies are the only duplication),
+             one per style, stepped back to back per tick.
+  adapters:  the same N frames through one BatchScheduler with the N
+             styles live in its factor bank — one k=N bucket step.
+
+Prints ONE JSON line (bank-and-commit contract) and appends it to
+PERF_LOG.jsonl (PERF_LOG_PATH overrides; empty value disables).
+
+Env knobs: ADAPTER_BENCH_FRAMES (default 16 per rep),
+ADAPTER_BENCH_PAIRS (default 24), ADAPTER_BENCH_SESSIONS (default 4;
+the smoke test uses 2 to halve compile cost — the metric name carries
+the count as NxN: N sessions x N distinct adapters).
+"""
+
+import json
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from ai_rtc_agent_tpu.utils.hwfp import fingerprint  # noqa: E402
+from ai_rtc_agent_tpu.utils.perfbank import paired as _paired  # noqa: E402
+
+FRAMES = int(os.getenv("ADAPTER_BENCH_FRAMES") or 16)
+PAIRS = int(os.getenv("ADAPTER_BENCH_PAIRS") or 24)
+# the acceptance number is 4 sessions x 4 adapters; the smoke runs 2x2
+SESSIONS = int(os.getenv("ADAPTER_BENCH_SESSIONS") or 4)
+
+
+def _mk_styles(bundle, n):
+    """n synthetic rank-2 styles over two attn linears of the tiny UNet
+    (pads to the smallest blessed bucket, 4) + the same styles as parsed
+    groups for the offline-fusion leg."""
+    import numpy as np
+
+    from ai_rtc_agent_tpu.adapters import AdapterRegistry
+    from ai_rtc_agent_tpu.models import loader as LD
+
+    mods = (
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q",
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_v",
+    )
+    rng = np.random.default_rng(42)
+    reg = AdapterRegistry(
+        bundle.params["unet"], LD.unet_key_map(bundle.unet_cfg)
+    )
+    all_groups = []
+    for i in range(n):
+        groups = {
+            m: {
+                "down": (rng.normal(size=(2, 8)) * 0.2).astype(np.float32),
+                "up": (rng.normal(size=(8, 2)) * 0.2).astype(np.float32),
+                "alpha": 2.0,
+            }
+            for m in mods
+        }
+        reg.add(f"style{i}", groups)
+        all_groups.append(groups)
+    return reg, all_groups
+
+
+def run() -> dict:
+    import numpy as np
+
+    from ai_rtc_agent_tpu.models import lora as LR
+    from ai_rtc_agent_tpu.models import registry
+    from ai_rtc_agent_tpu.models import loader as LD
+    from ai_rtc_agent_tpu.stream.engine import StreamEngine
+    from ai_rtc_agent_tpu.stream.scheduler import BatchScheduler
+
+    bundle = registry.load_model_bundle("tiny-test")
+    cfg = registry.default_stream_config(
+        "tiny-test", t_index_list=(0,), num_inference_steps=1,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        height=24, width=24,
+    )
+    reg, all_groups = _mk_styles(bundle, SESSIONS)
+    km = LD.unet_key_map(bundle.unet_cfg)
+
+    # the N dedicated weight sets: each style fused offline into its own
+    # full param copy (fusion in float32, BEFORE any quant cast — same
+    # order as the serving boot path)
+    fused_params = []
+    for groups in all_groups:
+        unet, applied, unmatched = LR.fuse_lora_into_unet(
+            bundle.params["unet"], groups, km
+        )
+        assert applied == len(groups) and not unmatched
+        p = dict(bundle.params)
+        p["unet"] = unet
+        fused_params.append(p)
+
+    # variant labels from what ACTUALLY runs (same discipline as
+    # batch_scheduler_bench.py: the quant label comes from the cast
+    # RESULT — set QUANT_MIN_SIZE=256 to actually quantize tiny-test)
+    variant_fields = {}
+    if (os.getenv("QUANT_WEIGHTS") or "").lower() in ("w8", "int8"):
+        from ai_rtc_agent_tpu.models.quant import quantized_bytes_saved
+
+        bundle.params = registry.cast_params(bundle.params, cfg.dtype)
+        fused_params = [
+            registry.cast_params(p, cfg.dtype) for p in fused_params
+        ]
+        if quantized_bytes_saved(bundle.params) > 0:
+            variant_fields["quant"] = "w8"
+
+    # --- dedicated leg: one engine per style, SHARING one jitted step
+    # (pure in params — the weight copies are the real duplication)
+    engines = [
+        StreamEngine(bundle.stream_models, p, cfg, bundle.encode_prompt)
+        for p in fused_params
+    ]
+    for eng in engines[1:]:
+        eng._step = engines[0]._step
+    for i, eng in enumerate(engines):
+        eng.prepare("bench prompt", seed=i)
+
+    # --- the adapter leg: one scheduler, N styles live in the factor bank
+    sched = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=SESSIONS, prewarm=True, dp=1, adapters=reg,
+    )
+    sessions = [
+        sched.claim(
+            f"bench-{i}", prompt="bench prompt", seed=i, adapter=f"style{i}"
+        )
+        for i in range(SESSIONS)
+    ]
+
+    rng = np.random.default_rng(7)
+    frames = rng.integers(
+        0, 256, (SESSIONS, cfg.height, cfg.width, 3), dtype=np.uint8
+    )
+
+    def dedicated_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            for j, eng in enumerate(engines):
+                eng(frames[j])
+        return (time.perf_counter() - t0) / FRAMES
+
+    def batched_rep() -> float:
+        t0 = time.perf_counter()
+        for _ in range(FRAMES):
+            handles = [s.submit(frames[j]) for j, s in enumerate(sessions)]
+            for s, h in zip(sessions, handles):
+                s.fetch(h)
+        return (time.perf_counter() - t0) / FRAMES
+
+    # warmup (compiles + pool growth), then short paired reps
+    # (perfbank.paired median-of-adjacent-ratios throttle discipline)
+    dedicated_rep()
+    batched_rep()
+    dedicated_s, batched_s, amortization = _paired(
+        dedicated_rep, batched_rep, PAIRS
+    )
+
+    # hot-swap cost: a same-shaped bank write, no step in the loop — the
+    # number the "join/leave/swap never retraces" contract prices
+    swap = sessions[0]
+    swap.update_adapter("style1")
+    t0 = time.perf_counter()
+    swaps = 0
+    while time.perf_counter() - t0 < 0.25:
+        swap.update_adapter(f"style{swaps % SESSIONS}")
+        swaps += 1
+    swap_ms = 1e3 * (time.perf_counter() - t0) / max(swaps, 1)
+    sched.close()
+
+    import jax
+
+    return {
+        "check": "adapter_bench",
+        "sessions": SESSIONS,
+        "adapters": SESSIONS,
+        "frames": FRAMES,
+        "config": "tiny24-turbo1-r4",
+        "dedicated_ms_per_frame": round(1e3 * dedicated_s, 2),
+        "adapters_ms_per_frame": round(1e3 * batched_s, 2),
+        "dedicated_ms_per_session_frame": round(
+            1e3 * dedicated_s / SESSIONS, 2
+        ),
+        "adapters_ms_per_session_frame": round(1e3 * batched_s / SESSIONS, 2),
+        "adapter_swap_ms": round(swap_ms, 3),
+        "bank_rank": reg.bank_rank,
+        # the contract quartet
+        "metric": f"adapter_amortization_{SESSIONS}x{SESSIONS}",
+        "value": round(amortization, 2),
+        "unit": "x",
+        "vs_baseline": round(amortization, 2),
+        "backend": jax.default_backend(),
+        "live": True,
+        "label": f"adapter_{SESSIONS}x{SESSIONS}_{FRAMES}f",
+        "recorded_at": datetime.now(timezone.utc).isoformat(),
+        "fingerprint": fingerprint(),
+        **variant_fields,
+    }
+
+
+from ai_rtc_agent_tpu.utils.perfbank import bank as _bank  # noqa: E402
+
+
+def main():
+    from ai_rtc_agent_tpu.utils.contract import sigterm_to_exception
+
+    sigterm_to_exception("adapter_bench timeout")
+    entry = {
+        "check": "adapter_bench",
+        "metric": f"adapter_amortization_{SESSIONS}x{SESSIONS}",
+        "value": 0.0,
+        "unit": "x",
+        "vs_baseline": 0.0,
+    }
+    try:
+        entry = run()
+        _bank(entry)
+    except BaseException as e:  # the contract line must survive any exit
+        entry["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        print(json.dumps(entry))
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

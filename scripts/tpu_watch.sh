@@ -269,6 +269,15 @@ while true; do
   # window is host machinery on any box and its line says backend=host,
   # which the banking filter rightly refuses.
   run_item "engine_rebuild" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/engine_recovery_bench.py --leg rebuild
+  # ISSUE 20 per-session style adapters ON HARDWARE: 4 sessions x 4
+  # distinct LoRA styles through one factor-bank scheduler vs 4 fused
+  # dedicated engines.  On a real accelerator the dedicated leg also
+  # pays 4 resident UNet weight copies and 4 serial launches — this is
+  # the multi-tenant economics row (the committed CPU line prices only
+  # the host dispatch machinery).  The w8 sibling prices the factors
+  # path riding quantized kernels (QUANT_MIN_SIZE=256: see batchsched_w8).
+  run_item "adapter_4x4" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= python -u scripts/adapter_bench.py
+  run_item "adapter_4x4_w8" 2400 env JAX_PLATFORMS=tpu PERF_LOG_PATH= QUANT_WEIGHTS=w8 QUANT_MIN_SIZE=256 python -u scripts/adapter_bench.py
   # ISSUE 17 broadcast fan-out ON THE TPU BOX: with libavcodec present
   # the dedicated baseline pays a REAL per-viewer H.264 encode, so the
   # amortization ratio here is the paper-facing number (the committed

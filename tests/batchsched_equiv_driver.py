@@ -33,6 +33,17 @@ ISSUE 12 legs:
 ISSUE 17 budget shave: ``--leg dense`` runs ONLY the dense drive (no
 variant legs) — the lighter tier-1 sibling; the full composition (w8 +
 DeepCache + fbs, each re-tracing k=4/2/1) runs in the slow tier.
+
+ISSUE 20 adapter leg (in the full run): per-session LoRA factor banks
+THROUGH the scheduler — each slot's style applied inside the shared
+bucket step — vs dedicated engines with the SAME style offline-fused
+(``models/lora.py``).  The factors path computes ``y + (x@down.T)@up.T``
+where the fuse bakes ``kernel + down.T@up.T``: identical math up to
+float association order, so the documented tolerance is PR 7's rounding
+tie class (``|uint8 diff| <= 1``; ties reported — a couple observed per
+run on this box).  A slot with NO adapter carries zero factors through the same
+graph and must stay BIT-exact with a plain engine (zero-slot
+exactness).  Prints ``EQUIV_ADAPTER_OK <n> ties=<t>``.
 """
 
 import os
@@ -278,6 +289,145 @@ def drive_fbs(bundle) -> int:
     return compared
 
 
+def drive_adapter(bundle) -> int:
+    """ISSUE 20 parity leg: per-session style adapters through the
+    scheduler's stacked factor bank vs dedicated engines with the same
+    LoRA offline-fused, across join/leave/bucket transitions, hot-swaps
+    (mirrored as a params reassignment on the dedicated side — the step
+    fn is pure in params) and restart.  See module docstring for the
+    documented tolerance."""
+    from ai_rtc_agent_tpu.adapters import AdapterRegistry
+    from ai_rtc_agent_tpu.models import loader as LD
+    from ai_rtc_agent_tpu.models import lora as LR
+
+    cfg = registry.default_stream_config(
+        "tiny-test", t_index_list=(2,), num_inference_steps=8,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+    )
+    km = LD.unet_key_map(bundle.unet_cfg)
+    MQ = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q"
+    MV = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_v"
+    grng = np.random.default_rng(77)
+
+    def mk_groups(mods, r=2, din=8, dout=8):
+        return {
+            m: {
+                "down": (grng.normal(size=(r, din)) * 0.2).astype(np.float32),
+                "up": (grng.normal(size=(dout, r)) * 0.2).astype(np.float32),
+                "alpha": float(r),
+            }
+            for m in mods
+        }
+
+    # styleA touches ONE module, styleB two: the bank's target set is the
+    # union, so styleA's row carries explicit zeros at MV (zero-extension)
+    gA = mk_groups([MQ])
+    gB = mk_groups([MQ, MV])
+    reg = AdapterRegistry(bundle.params["unet"], km)
+    reg.add("styleA", gA)
+    reg.add("styleB", gB)
+    assert reg.bank_rank == 4, reg.bank_rank  # rank 2 pads to bucket 4
+
+    def fused(groups):
+        unet, applied, unmatched = LR.fuse_lora_into_unet(
+            bundle.params["unet"], groups, km
+        )
+        assert applied == len(groups) and not unmatched
+        p = dict(bundle.params)
+        p["unet"] = unet
+        return p
+
+    pA, pB = fused(gA), fused(gB)
+
+    sched = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=4, window_ms=10_000.0, prewarm=False, dp=1,
+        adapters=reg,
+    )
+    # dedicated engines share ONE jitted step (pure in params); the plain
+    # one doubles as the hot-swap mirror by reassigning .params
+    e_base, eA, eB = dedicated_engines(3, bundle, cfg)
+    base_params = e_base.params
+    eA.params = pA
+    eB.params = pB
+    rng = np.random.default_rng(31)
+    compared = 0
+    ties = 0
+
+    def frames(n):
+        return [rng.integers(0, 256, (64, 64, 3), np.uint8) for _ in range(n)]
+
+    def step_pairs(sessions, dedicated, exact, fs):
+        nonlocal compared, ties
+        handles = [s.submit(f) for s, f in zip(sessions, fs)]
+        outs = [s.fetch(h) for s, h in zip(sessions, handles)]
+        for out, eng, ex, f in zip(outs, dedicated, exact, fs):
+            ref = eng(f)
+            if ex:
+                np.testing.assert_array_equal(out, ref)
+            else:
+                d = np.abs(out.astype(np.int16) - ref.astype(np.int16))
+                assert d.max() <= 1, (
+                    f"adapter parity beyond a rounding tie (max {d.max()})"
+                )
+                ties += int((d == 1).sum())
+            compared += 1
+
+    s1 = sched.claim("ad-a", prompt="a red cat", seed=11, adapter="styleA")
+    eA.prepare("a red cat", seed=11)
+    s2 = sched.claim("ad-b", prompt="a blue dog", seed=22)  # no adapter
+    e_base.prepare("a blue dog", seed=22)
+    # k=2: styled slot within the tie class, zero-factor slot BIT-exact
+    for _ in range(2):
+        step_pairs([s1, s2], [eA, e_base], [False, True], frames(2))
+
+    # JOIN with a different style -> padded k=4, three styles live at once
+    s3 = sched.claim("ad-c", prompt="green hills", seed=33, adapter="styleB")
+    eB.prepare("green hills", seed=33)
+    for _ in range(2):
+        step_pairs([s1, s2, s3], [eA, e_base, eB],
+                   [False, True, False], frames(3))
+
+    # HOT-SWAP mid-stream: s2 None -> styleA; the dedicated mirror is a
+    # params reassignment on the SAME engine (state history carries over
+    # on both sides).  From here s2's pair is tie-class, not exact: its
+    # pre-swap state already differs from the mirror's by association
+    # rounding fed back through the latent ring.
+    s2.update_adapter("styleA")
+    e_base.params = pA
+    for _ in range(2):
+        step_pairs([s1, s2, s3], [eA, e_base, eB],
+                   [False, False, False], frames(3))
+
+    # swap BACK to no style + restart: a fresh zero-factor state against
+    # a fresh plain engine state is bit-exact again
+    s2.update_adapter(None)
+    e_base.params = base_params
+    s2.restart()
+    e_base.prepare("a blue dog", seed=22)
+    for _ in range(2):
+        step_pairs([s1, s2, s3], [eA, e_base, eB],
+                   [False, True, False], frames(3))
+
+    # LEAVE -> k=2; the styled survivor stays pinned to its factors
+    s3.release()
+    for _ in range(2):
+        step_pairs([s1, s2], [eA, e_base], [False, True], frames(2))
+
+    # restart() rebuilds the styled session's state WITH its adapter
+    s1.restart()
+    eA.prepare("a red cat", seed=11)
+    for _ in range(2):
+        step_pairs([s1, s2], [eA, e_base], [False, True], frames(2))
+
+    snap = sched.snapshot()
+    assert snap["adapter_rank"] == 4, snap
+    assert snap["adapter_swaps_total"] >= 2, snap
+    sched.close()
+    print(f"EQUIV_ADAPTER_OK {compared} ties={ties}")
+    return compared
+
+
 def main(variants=True):
     bundle = registry.load_model_bundle("tiny-test")
     # 8 sub-timesteps with a single stage so update_t_index_list([5]) is a
@@ -407,6 +557,8 @@ def main(variants=True):
     compared += n_fbs
     print(f"EQUIV_FBS_OK {n_fbs}")
 
+    compared += drive_adapter(bundle)
+
     print(f"EQUIV_OK {compared}")
 
 
@@ -415,5 +567,7 @@ if __name__ == "__main__":
         drive_sharded()
     elif "--leg" in sys.argv and "dense" in sys.argv:
         main(variants=False)
+    elif "--leg" in sys.argv and "adapter" in sys.argv:
+        drive_adapter(registry.load_model_bundle("tiny-test"))
     else:
         main()

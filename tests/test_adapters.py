@@ -1,0 +1,212 @@
+"""Per-session style adapters (ai_rtc_agent_tpu/adapters/) — ISSUE 20.
+
+Unit pins for the registry half of the subsystem: kohya/peft banks resolve
+through models/lora.py's parser against the loader key map, pad to the
+closed rank-bucket set, refuse above the largest bucket, DROP
+text-encoder/conv/unmatched groups loudly, and emit bank-shaped factor
+rows with zero-extension over the union target set.  The runtime half
+(factors inside the vmapped bucket step, parity with offline fusion) is
+pinned by the equivalence driver's adapter leg and the scheduler tests.
+"""
+
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ai_rtc_agent_tpu.adapters import (
+    AdapterRegistry,
+    build_registry,
+    graft_unet_params,
+    zero_factor_rows,
+)
+from ai_rtc_agent_tpu.adapters.registry import targets_digest
+from ai_rtc_agent_tpu.models import loader as LD
+from ai_rtc_agent_tpu.models import registry as REG
+
+# diffusers spelling (what the parser emits) for two tiny-test attn linears
+MQ_DIFF = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q"
+MV_DIFF = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_v"
+# param-tree spelling (what bank rows / graft paths use)
+MQ_TREE = "down_blocks.0.attentions.0.blocks.0.attn1.to_q"
+MV_TREE = "down_blocks.0.attentions.0.blocks.0.attn1.to_v"
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return REG.load_model_bundle("tiny-test")
+
+
+@pytest.fixture()
+def reg(bundle):
+    return AdapterRegistry(
+        bundle.params["unet"], LD.unet_key_map(bundle.unet_cfg)
+    )
+
+
+def _group(rng, r=2, din=8, dout=8, alpha=None):
+    return {
+        "down": (rng.normal(size=(r, din)) * 0.2).astype(np.float32),
+        "up": (rng.normal(size=(dout, r)) * 0.2).astype(np.float32),
+        "alpha": float(r) if alpha is None else float(alpha),
+    }
+
+
+def test_rank_bucketing_pads_and_refuses(reg, rng):
+    # rank 2 -> smallest blessed bucket (4); rank 5 -> 8
+    reg.add("small", {MQ_DIFF: _group(rng, r=2)})
+    assert reg.rank_of("small") == 4
+    reg.add("mid", {MQ_DIFF: _group(rng, r=5)})
+    assert reg.rank_of("mid") == 8
+    assert reg.bank_rank == 8  # largest bucket in use
+    # above the largest bucket: REFUSED, never truncated
+    with pytest.raises(ValueError, match="refusing to truncate"):
+        reg.add("huge", {MQ_DIFF: _group(rng, r=17)})
+    assert "huge" not in reg
+    # padding is explicit zeros beyond the true rank
+    rows = reg.factor_rows("small")
+    down = np.asarray(rows[MQ_TREE]["down"])
+    assert down.shape == (8, 8)  # bank rank 8 x in_dim 8
+    assert np.all(down[2:] == 0) and np.any(down[:2] != 0)
+
+
+def test_drops_te_conv_unmatched_loudly(reg, rng, caplog):
+    groups = {
+        MQ_DIFF: _group(rng),                      # good 2-D linear
+        f"te.{MQ_DIFF}": _group(rng),              # text encoder: dropped
+        "down_blocks.0.resnets.0.conv1": _group(rng, din=8),  # conv: dropped
+        "mid_block.bogus.to_q": _group(rng),       # unmatched: dropped
+    }
+    with caplog.at_level(logging.WARNING, logger="ai_rtc_agent_tpu.adapters.registry"):
+        applied, dropped = reg.add("partial", groups)
+    assert applied == 1 and len(dropped) == 3
+    assert "DROPPED" in caplog.text
+    assert list(reg.targets) == [MQ_TREE]
+    # a fully-unresolvable bank is a hard error, not a no-op style
+    with pytest.raises(ValueError, match="matched 0 of"):
+        reg.add("bogus", {"mid_block.bogus.to_q": _group(rng)})
+    assert "bogus" not in reg
+
+
+def test_shape_mismatch_is_wrong_base_model(reg, rng):
+    with pytest.raises(ValueError, match="wrong base model"):
+        reg.add("misfit", {MQ_DIFF: _group(rng, din=16)})
+
+
+def test_factor_rows_zero_extension_and_refusals(reg, rng):
+    reg.add("styleA", {MQ_DIFF: _group(rng)})
+    reg.add("styleB", {MQ_DIFF: _group(rng), MV_DIFF: _group(rng)})
+    assert set(reg.targets) == {MQ_TREE, MV_TREE}
+    # styleA's row spans the UNION target set with zeros at MV
+    rows = reg.factor_rows("styleA")
+    assert set(rows) == {MQ_TREE, MV_TREE}
+    assert np.any(np.asarray(rows[MQ_TREE]["down"]) != 0)
+    assert not np.any(np.asarray(rows[MV_TREE]["down"]))
+    assert not np.any(np.asarray(rows[MV_TREE]["up"]))
+    # name=None is the all-zero row; dtype honoured
+    z = reg.factor_rows(None, dtype=jnp.bfloat16)
+    assert z[MQ_TREE]["down"].dtype == jnp.bfloat16
+    assert not np.any(np.asarray(z[MQ_TREE]["down"], np.float32))
+    with pytest.raises(KeyError, match="unknown adapter"):
+        reg.factor_rows("nope")
+    # a bank narrower than the adapter's bucket: rebuild, don't clip
+    with pytest.raises(ValueError, match="rebuild the scheduler"):
+        reg.factor_rows("styleA", rank=2)
+
+
+def test_scale_alpha_folded_into_up(reg, rng):
+    g = _group(rng, r=2, alpha=1.0)  # alpha/r = 0.5
+    reg.add("scaled", {MQ_DIFF: g}, scale=2.0)  # s = 2.0 * 0.5 = 1.0
+    rows = reg.factor_rows("scaled")
+    np.testing.assert_allclose(
+        np.asarray(rows[MQ_TREE]["up"])[:, :2], g["up"], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(rows[MQ_TREE]["down"])[:2], g["down"], rtol=1e-6
+    )
+
+
+def test_graft_inserts_factors_beside_kernel(bundle, reg, rng):
+    reg.add("styleA", {MQ_DIFF: _group(rng)})
+    rows = reg.factor_rows("styleA")
+    grafted = graft_unet_params(bundle.params["unet"], rows)
+    mod = grafted["down_blocks"][0]["attentions"][0]["blocks"][0]["attn1"]["to_q"]
+    assert "lora_down" in mod and "lora_up" in mod
+    assert mod["kernel"] is bundle.params["unet"]["down_blocks"][0][
+        "attentions"][0]["blocks"][0]["attn1"]["to_q"]["kernel"]
+    # untouched subtrees keep identity (donation/sharding unaffected)
+    assert grafted["mid_block"] is bundle.params["unet"]["mid_block"]
+    # the factored linear equals base + (x @ down.T) @ up.T
+    from ai_rtc_agent_tpu.models.layers import linear
+
+    x = jnp.asarray(rng.normal(size=(3, 8)), jnp.float32)
+    base = linear(bundle.params["unet"]["down_blocks"][0]["attentions"][0][
+        "blocks"][0]["attn1"]["to_q"], x)
+    got = linear(mod, x)
+    want = base + (x @ rows[MQ_TREE]["down"].T) @ rows[MQ_TREE]["up"].T
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    # zero rows are a bitwise no-op through the SAME factored code path
+    zmod = dict(mod)
+    zrows = zero_factor_rows({MQ_TREE: (8, 8)}, 4)
+    zmod["lora_down"], zmod["lora_up"] = (
+        zrows[MQ_TREE]["down"], zrows[MQ_TREE]["up"],
+    )
+    np.testing.assert_array_equal(np.asarray(linear(zmod, x)), np.asarray(base))
+
+
+def test_fingerprint_tracks_bank_shape_not_names(reg, rng):
+    assert reg.fingerprint() == {
+        "adapter_rank": 0, "adapter_targets": targets_digest({}),
+    }
+    reg.add("styleA", {MQ_DIFF: _group(rng)})
+    fp1 = reg.fingerprint()
+    assert fp1["adapter_rank"] == 4
+    # a second style over the SAME targets/rank keeps the fingerprint
+    reg.add("styleA2", {MQ_DIFF: _group(rng)})
+    assert reg.fingerprint() == fp1
+    # widening the target set changes it
+    reg.add("styleB", {MV_DIFF: _group(rng)})
+    assert reg.fingerprint() != fp1
+
+
+def test_build_registry_scans_directory(bundle, rng, tmp_path):
+    kohya = "lora_unet_down_blocks_0_attentions_0_transformer_blocks_0_attn1_to_q"
+    for name in ("ghibli", "noir"):
+        g = _group(rng)
+        LD.write_safetensors(str(tmp_path / f"{name}.safetensors"), {
+            f"{kohya}.lora_down.weight": g["down"],
+            f"{kohya}.lora_up.weight": g["up"],
+            f"{kohya}.alpha": np.array(g["alpha"], np.float32),
+        })
+    reg = build_registry(
+        bundle.params["unet"], bundle.unet_cfg, str(tmp_path)
+    )
+    assert reg.names == ["ghibli", "noir"] and reg.bank_rank == 4
+    # ADAPTER_DIR unset -> empty registry, factors path off
+    empty = build_registry(bundle.params["unet"], bundle.unet_cfg, None)
+    assert len(empty) == 0 and empty.bank_rank == 0
+    # a broken bank refuses the boot instead of half-loading the catalog
+    LD.write_safetensors(str(tmp_path / "broken.safetensors"), {
+        "lora_unet_mid_block_bogus_to_q.lora_down.weight": _group(rng)["down"],
+        "lora_unet_mid_block_bogus_to_q.lora_up.weight": _group(rng)["up"],
+    })
+    with pytest.raises(ValueError, match="matched 0 of"):
+        build_registry(bundle.params["unet"], bundle.unet_cfg, str(tmp_path))
+
+
+def test_env_rank_buckets_parsing(monkeypatch):
+    from ai_rtc_agent_tpu.utils import env
+
+    monkeypatch.delenv("ADAPTER_RANK_BUCKETS", raising=False)
+    assert env.adapter_rank_buckets() == (4, 8, 16)
+    monkeypatch.setenv("ADAPTER_RANK_BUCKETS", "2, 8,32")
+    assert env.adapter_rank_buckets() == (2, 8, 32)
+    monkeypatch.setenv("ADAPTER_RANK_BUCKETS", "8,zero")
+    with pytest.raises(ValueError):
+        env.adapter_rank_buckets()
+    monkeypatch.delenv("ADAPTER_RANK_BUCKETS", raising=False)
+    monkeypatch.delenv("ADAPTER_DIR", raising=False)
+    assert env.adapter_dir() is None
+    monkeypatch.setenv("ADAPTER_DIR", "/styles")
+    assert env.adapter_dir() == "/styles"

@@ -21,7 +21,6 @@ import numpy as np
 import pytest
 
 from ai_rtc_agent_tpu.models import registry
-from ai_rtc_agent_tpu.stream.engine import StreamEngine
 from ai_rtc_agent_tpu.stream.scheduler import BatchScheduler, CapacityError
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -70,8 +69,11 @@ def test_equivalence_dense_subprocess():
 def test_equivalence_bit_identical_subprocess():
     """The full composition: the dense drive PLUS the ISSUE 9 variant
     legs (w8 quant and the DeepCache cadence THROUGH the scheduler's
-    bucket steps, k=4/2/1, same documented exact tolerance) and the
-    fbs=2 leg."""
+    bucket steps, k=4/2/1, same documented exact tolerance), the fbs=2
+    leg and the ISSUE 20 adapter leg (per-session LoRA factor banks vs
+    offline-fused dedicated engines across join/leave/hot-swap/restart;
+    tolerance = the documented rounding-tie class, zero-factor slots
+    bit-exact)."""
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)
     env.pop("XLA_FLAGS", None)
@@ -84,7 +86,8 @@ def test_equivalence_bit_identical_subprocess():
     lines = [ln for ln in r.stdout.splitlines() if ln.startswith("EQUIV_OK")]
     assert lines, r.stdout
     assert int(lines[0].split()[1]) >= 70  # dense + both variant legs
-    for leg, floor in (("EQUIV_W8_OK", 15), ("EQUIV_DC_OK", 15)):
+    for leg, floor in (("EQUIV_W8_OK", 15), ("EQUIV_DC_OK", 15),
+                       ("EQUIV_ADAPTER_OK", 25)):
         leg_lines = [
             ln for ln in r.stdout.splitlines() if ln.startswith(leg)
         ]
@@ -362,15 +365,16 @@ def test_refuses_incompatible_configs(bundle):
         )
 
 
-def test_amortized_admission_feed_and_aot_roundtrip(
+def test_amortized_admission_feed_and_step_recovery(
     bundle, cfg, tmp_path, rng
 ):
-    """One compile-bearing in-process test: (a) on_step receives
+    """One compile-bearing in-process test (ISSUE 20 budget shave: the
+    AOT export->adopt roundtrip that used to ride here — three more
+    compiles — moved to the slow sibling below): (a) on_step receives
     PER-BATCH-AMORTIZED latency (dt / occupancy — what the overload
-    plane's step-EWMA is wired to); (b) every bucket geometry exports
-    through the engine cache (sbucket/sessions keys), a fresh scheduler
-    adopts WITHOUT building, and aot_status/EngineCache.has report the
-    prebuilt set (the build CLI's pre-warm surface)."""
+    plane's step-EWMA is wired to); (b) the bucket step donates the
+    stacked state; (c) a failed step rebuilds the donated state from the
+    tracked control planes and serving resumes."""
     feeds = []
     # every phase below relies on a+b coalescing into ONE k=2 batch; a
     # wide window makes that deterministic on a throttled box (a 2 ms
@@ -424,7 +428,29 @@ def test_amortized_admission_feed_and_aot_roundtrip(
         ha, hb = a.submit(f), b.submit(f)
         oa, ob = a.fetch(ha), b.fetch(hb)  # fresh states serve again
         assert oa.shape == (64, 64, 3) and ob.shape == (64, 64, 3)
+    finally:
+        s.close()
 
+
+# slow tier (ISSUE 20 budget shave): exporting every bucket geometry +
+# the cold-scheduler adoption re-pays every tiny-model compile through
+# jax.export; tier-1 keeps the admission-feed/donation/recovery sibling
+# above (one lazy compile) and test_shard_aware_bucket_keys_and_prewarm_
+# coverage's key-plane pins
+@pytest.mark.slow
+def test_aot_export_adopt_roundtrip(bundle, cfg, tmp_path, rng):
+    """Every bucket geometry exports through the engine cache
+    (sbucket/sessions keys), a fresh scheduler adopts WITHOUT building,
+    and aot_status/EngineCache.has report the prebuilt set (the build
+    CLI's pre-warm surface)."""
+    s = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        model_id="tiny-test", max_sessions=2, window_ms=500.0,
+        prewarm=False, aot_build_on_miss=False, cache_dir=str(tmp_path),
+    )
+    try:
+        status = s.aot_status("tiny-test", cache_dir=str(tmp_path))
+        assert status == {(1, "full"): False, (2, "full"): False}
         # export every bucket, then adopt from a cold scheduler
         assert s.use_aot_cache(
             "tiny-test", cache_dir=str(tmp_path), build_on_miss=True
@@ -442,10 +468,6 @@ def test_amortized_admission_feed_and_aot_roundtrip(
     )
     try:
         assert s2._aot_adopted  # ctor adoption found every bucket
-        eng = StreamEngine(
-            bundle.stream_models, bundle.params, cfg, bundle.encode_prompt
-        )
-        eng.prepare("aot check", seed=5)
         sess = s2.claim("aot", prompt="aot check", seed=5)
         out = sess(rng.integers(0, 256, (64, 64, 3), np.uint8))
         assert out.shape == (64, 64, 3) and out.dtype == np.uint8
@@ -677,4 +699,173 @@ def test_deepcache_uncaptured_rider_forces_capture(bundle):
         # driver's DC leg; tier-1 budget)
         assert s._tick % s._cache_interval != 0
     finally:
+        s.close()
+
+
+def _mk_adapter_registry(bundle, r=2):
+    """Synthetic two-style registry over the tiny UNet: styleA touches one
+    attn linear, styleB two (the bank target set is the union, so styleA
+    rows carry explicit zeros at the second target); rank 2 pads to the
+    smallest blessed bucket, 4."""
+    from ai_rtc_agent_tpu.adapters import AdapterRegistry
+    from ai_rtc_agent_tpu.models import loader as LD
+
+    mq = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q"
+    mv = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_v"
+    rng = np.random.default_rng(7)
+
+    def groups(mods):
+        return {
+            m: {
+                "down": (rng.normal(size=(r, 8)) * 0.2).astype(np.float32),
+                "up": (rng.normal(size=(8, r)) * 0.2).astype(np.float32),
+                "alpha": float(r),
+            }
+            for m in mods
+        }
+
+    reg = AdapterRegistry(
+        bundle.params["unet"], LD.unet_key_map(bundle.unet_cfg)
+    )
+    reg.add("styleA", groups([mq]))
+    reg.add("styleB", groups([mq, mv]))
+    return reg
+
+
+def test_adapter_bucket_keys_bank_shape_and_metrics(bundle, cfg):
+    """Unit pins for the adapter key plane (ISSUE 20): a bound factor bank
+    joins the AOT key space as its padded rank (``lrank-R`` via
+    aot/cache.adapter_key_extra — empty-when-disabled like the dp extra),
+    the devtel bucket label carries ``:rR``, the stacked bank is
+    [S, ...]-shaped over the union target set, snapshot/fingerprint expose
+    the bank, and style validation refuses BEFORE touching a slot.
+    Compile-free (prewarm off, no frame dispatched)."""
+    from ai_rtc_agent_tpu.aot.cache import adapter_key_extra
+
+    assert adapter_key_extra(0) == {}
+    assert adapter_key_extra(4) == {"lrank": 4}
+
+    reg = _mk_adapter_registry(bundle)
+    assert reg.bank_rank == 4 and reg.rank_of("styleA") == 4
+    s = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=4, window_ms=10_000.0, prewarm=False, adapters=reg,
+    )
+    try:
+        # rank joins the key space: (k, variant, rank, dp)
+        assert s._bucket_label(2, "full") == "sbucket-2:full:r4"
+        keys = s.bucket_keys("tiny-test")
+        assert keys and all("lrank-4" in k for k in keys.values())
+        # the stacked bank rides the session pytree: [S, R, in]/[S, out, R]
+        bank = s.states["adapters"]
+        assert set(bank) == set(reg.targets)
+        for f in bank.values():
+            assert f["down"].shape == (4, 4, 8)
+            assert f["up"].shape == (4, 8, 4)
+        # validation refuses BEFORE slot allocation / bank writes
+        with pytest.raises(KeyError):
+            s.claim("x", adapter="nope")
+        assert s.snapshot()["batchsched_sessions"] == 0
+        a = s.claim("a", adapter="styleA")
+        assert a.adapter == "styleA"
+        with pytest.raises(KeyError):
+            a.update_adapter("nope")
+        assert a.adapter == "styleA"  # refused swap never lands
+        a.update_adapter("styleB")
+        assert a.adapter == "styleB"
+        # global update: live slots swap AND future claims inherit
+        s.update_adapter("styleA")
+        assert a.adapter == "styleA"
+        b = s.claim("b")
+        assert b.adapter == "styleA"
+        snap = s.snapshot()
+        assert snap["adapter_rank"] == 4
+        assert snap["adapter_sessions"] == 2
+        assert snap["adapter_swaps_total"] >= 2
+        fp = s.snapshot_fingerprint()
+        assert fp["adapter_rank"] == 4 and fp["adapter_targets"]
+        assert a.snapshot()["adapter"] == "styleA"
+    finally:
+        s.close()
+    # an adapterless scheduler keeps every pre-existing surface unchanged
+    s2 = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg, bundle.encode_prompt,
+        max_sessions=2, window_ms=10_000.0, prewarm=False,
+    )
+    try:
+        assert s2._bucket_label(2, "full") == "sbucket-2:full"
+        assert "adapters" not in s2.states
+        assert "adapter_rank" not in s2.snapshot_fingerprint()
+        with pytest.raises(ValueError, match="ADAPTER_DIR"):
+            s2.claim("x", adapter="styleA")
+        with pytest.raises(ValueError, match="ADAPTER_DIR"):
+            s2.update_adapter("styleA")
+    finally:
+        s2.close()
+
+
+# slow tier: prewarm=True pays every (k, variant, rank) compile up front —
+# tier-1 keeps test_adapter_bucket_keys_bank_shape_and_metrics above,
+# which pins the same key/bank mechanism compile-free
+@pytest.mark.slow
+def test_adapter_hot_swap_never_retraces(bundle):
+    """ISSUE 20 acceptance pin: join/leave/hot-swap/clear/restart on a
+    prewarmed adapter-carrying scheduler with ZERO devtel retrace
+    breaches — the closed rank-bucket contract makes every swap a
+    same-shaped ``.at[slot].set`` bank write, never a new graph."""
+    from ai_rtc_agent_tpu.obs import devtel
+    from ai_rtc_agent_tpu.obs.devtel import DevTelPlane
+
+    cfg32 = registry.default_stream_config(
+        "tiny-test", t_index_list=(0,), num_inference_steps=1,
+        timestep_spacing="trailing", scheduler="turbo", cfg_type="none",
+        height=32, width=32,
+    )
+    reg = _mk_adapter_registry(bundle)
+    plane = devtel.activate(DevTelPlane())
+    s = BatchScheduler(
+        bundle.stream_models, bundle.params, cfg32, bundle.encode_prompt,
+        max_sessions=2, window_ms=10_000.0, prewarm=True, dp=1,
+        adapters=reg,
+    )
+    rng = np.random.default_rng(5)
+
+    def tick(sessions):
+        fs = [
+            rng.integers(0, 256, (32, 32, 3), np.uint8) for _ in sessions
+        ]
+        hs = [x.submit(f) for x, f in zip(sessions, fs)]
+        return [x.fetch(h) for x, h in zip(sessions, hs)]
+
+    try:
+        # prewarm attributed under the rank-carrying scope, all expected
+        ctxs = {c["context"] for c in plane.compiles}
+        assert "sbucket-2:full:r4" in ctxs, ctxs
+        assert plane.retrace_breaches == 0
+        a = s.claim("a", prompt="pa", seed=1, adapter="styleA")
+        b = s.claim("b", prompt="pb", seed=2)
+        tick([a, b])  # warm the host-side eager ops too
+        a.update_adapter("styleB")  # ...including the bank-write path
+        b.release()
+        tick([a])
+        plane.serving()
+        # churn on warm executables ONLY: swap, clear, rejoin with a
+        # style, swap the rejoiner, restart a styled session, global clear
+        a.update_adapter(None)
+        tick([a])
+        b2 = s.claim("b2", prompt="pb2", seed=9, adapter="styleB")
+        tick([a, b2])
+        b2.update_adapter("styleA")
+        tick([a, b2])
+        a.update_adapter("styleA")
+        a.restart()
+        tick([a, b2])
+        s.update_adapter(None)
+        tick([a, b2])
+        assert plane.retrace_breaches == 0, [
+            c for c in plane.compiles if c["phase"] == "serving"
+        ]
+        assert s.snapshot()["adapter_swaps_total"] >= 5
+    finally:
+        devtel.deactivate(plane)
         s.close()

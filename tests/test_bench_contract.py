@@ -614,6 +614,51 @@ def test_batch_scheduler_bench_contract(tmp_path):
 
 
 @pytest.mark.slow
+def test_adapter_bench_contract(tmp_path):
+    """Per-session style adapter bench smoke (ISSUE 20): emits exactly
+    one contract line with the NxN metric + bank-rank/swap labels and
+    BANKS it, and the factor-bank path must not be grossly slower than
+    the fused dedicated engines it replaces.  Runs at 2x2 (half the
+    compiles — two fused engines + one 2-slot prewarm); `slow` tier like
+    its batchsched sibling; the committed 4x4 PERF_LOG line carries the
+    acceptance trajectory."""
+    log = tmp_path / "PERF_LOG.jsonl"
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env.update(
+        {
+            "PERF_LOG_PATH": str(log),
+            "ADAPTER_BENCH_FRAMES": "6",
+            "ADAPTER_BENCH_PAIRS": "4",
+            "ADAPTER_BENCH_SESSIONS": "2",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/adapter_bench.py"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline"):
+        assert k in d, d
+    assert "error" not in d, d
+    assert d["metric"] == "adapter_amortization_2x2"
+    assert d["sessions"] == 2 and d["adapters"] == 2
+    assert d["bank_rank"] == 4
+    # pessimization fence with contended-box headroom: the factors path
+    # collapsing (per-frame graft retraces, bank copies) reads ~0.3
+    assert d["value"] >= 0.7, d
+    # a hot-swap is one same-shaped bank write — never an engine build
+    assert d["adapter_swap_ms"] < 500.0, d
+    assert d["fingerprint"]["jax_backend"] == "cpu"
+    banked = [json.loads(x) for x in log.read_text().splitlines()]
+    assert banked and banked[-1]["metric"] == "adapter_amortization_2x2"
+
+
+@pytest.mark.slow
 def test_mesh_sched_bench_contract(tmp_path):
     """Mesh-sharded scheduler amortization smoke (ISSUE 12): emits
     exactly one contract line with the dp/session labels + fingerprint

@@ -116,8 +116,8 @@ def test_lora_fuse_linear_changes_output(rng):
     }
     groups = LR.parse_lora_state_dict(sd)
     assert len(groups) == 1
-    fused, applied = LR.fuse_lora_into_unet(params, groups, km, scale=1.0)
-    assert applied == 1
+    fused, applied, unmatched = LR.fuse_lora_into_unet(params, groups, km, scale=1.0)
+    assert applied == 1 and unmatched == []
 
     old = np.asarray(
         params["down_blocks"][0]["attentions"][0]["blocks"][0]["attn1"]["to_q"]["kernel"]
@@ -134,6 +134,49 @@ def test_lora_fuse_linear_changes_output(rng):
     o1 = np.asarray(U.apply_unet(params, x, jnp.array([100]), ctx, cfg))
     o2 = np.asarray(U.apply_unet(fused, x, jnp.array([100]), ctx, cfg))
     assert not np.allclose(o1, o2)
+
+
+def test_lora_fuse_miskeyed_state_dict_is_loud(rng, caplog):
+    """ISSUE 20 satellite: unmatched LoRA paths must be RETURNED and warned,
+    and a fully-miskeyed adapter (applied == 0) must be a hard error at the
+    registry call site — not a silent no-op style."""
+    import logging
+
+    cfg = U.UNetConfig.tiny()
+    params = U.init_unet(jax.random.PRNGKey(7), cfg)
+    km = LD.unet_key_map(cfg)
+
+    r, din = 2, 8
+    down = rng.standard_normal((r, din)).astype(np.float32)
+    up = rng.standard_normal((din, r)).astype(np.float32)
+    # deliberately miskeyed: a module path that exists in no SD UNet
+    sd = {
+        "lora_unet_mid_block_bogus_module_to_q.lora_down.weight": down,
+        "lora_unet_mid_block_bogus_module_to_q.lora_up.weight": up,
+    }
+    groups = LR.parse_lora_state_dict(sd)
+    assert len(groups) == 1
+    with caplog.at_level(logging.WARNING, logger="ai_rtc_agent_tpu.models.lora"):
+        fused, applied, unmatched = LR.fuse_lora_into_unet(params, groups, km)
+    assert applied == 0
+    assert unmatched == list(groups)
+    assert any("DROPPED" in rec.message for rec in caplog.records)
+    # untouched tree: the shallow-copy result still shares every leaf
+    assert fused["mid_block"] is params["mid_block"]
+
+    # registry call site refuses an all-miss fuse
+    from ai_rtc_agent_tpu.models import registry as REG
+
+    lora_path = None
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        lora_path = os.path.join(td, "bogus_style.safetensors")
+        LD.write_safetensors(lora_path, sd)
+        import pytest
+
+        with pytest.raises(ValueError, match="matched 0 of"):
+            REG.load_model_bundle("tiny-test", lora_dict={lora_path: 1.0})
 
 
 def test_real_weights_with_missing_vocab_is_hard_error(tmp_path, monkeypatch):

@@ -162,6 +162,111 @@ def test_migrate_mid_stream_bit_identical_and_abort_safe(bundle, cfg32):
             s.close()
 
 
+def _mk_adapter_reg(bundle):
+    """Synthetic two-style registry (rank 2 -> bucket 4) — deterministic,
+    so two independently-built schedulers carry identical banks (the
+    restarted-agent / destination-agent boot path)."""
+    from ai_rtc_agent_tpu.adapters import AdapterRegistry
+    from ai_rtc_agent_tpu.models import loader as LD
+
+    mq = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q"
+    mv = "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_v"
+    rng = np.random.default_rng(7)
+
+    def groups(mods):
+        return {
+            m: {
+                "down": (rng.normal(size=(2, 8)) * 0.2).astype(np.float32),
+                "up": (rng.normal(size=(8, 2)) * 0.2).astype(np.float32),
+                "alpha": 2.0,
+            }
+            for m in mods
+        }
+
+    reg = AdapterRegistry(
+        bundle.params["unet"], LD.unet_key_map(bundle.unet_cfg)
+    )
+    reg.add("styleA", groups([mq]))
+    reg.add("styleB", groups([mq, mv]))
+    return reg
+
+
+def test_migrate_adapter_style_rides_snapshot_and_crash_resume(bundle, cfg32):
+    """ISSUE 20 satellite: migration carries style.  The schema-2 payload
+    names the adapter and the state row carries its factor bank; restore
+    lands the rows BIT-EXACT and the destination session keeps serving
+    the styled stream identically.  A schema-1 (pre-adapter) snapshot is
+    REFUSED by the version gate; an adapterless scheduler refuses the
+    bank-carrying fingerprint (and vice versa) BEFORE touching state.
+    Crash-resume (the AGENT_DEAD flow: the dead agent's banked snapshot
+    restored on a fresh boot) restores the adapter too."""
+    A = _mk_sched(bundle, cfg32, adapters=_mk_adapter_reg(bundle))
+    B = _mk_sched(bundle, cfg32, adapters=_mk_adapter_reg(bundle))
+    D = _mk_sched(bundle, cfg32)  # adapterless
+    A2 = None
+    rng = np.random.default_rng(13)
+    frames = [
+        rng.integers(0, 256, (32, 32, 3), np.uint8) for _ in range(10)
+    ]
+    try:
+        sa = A.claim("sa", prompt="styled stream", seed=5, adapter="styleA")
+        for f in frames[:4]:
+            _tick(sa, f)
+        snap = A.snapshot_session("sa")
+        assert snap["schema"] == SESSION_SNAPSHOT_SCHEMA == 2
+        assert snap["adapter"] == "styleA"
+        assert snap["fingerprint"]["adapter_rank"] == 4
+        assert snap["fingerprint"]["adapter_targets"]
+
+        # schema 1 (pre-adapter) -> the version gate refuses it outright
+        old = dict(snap)
+        old["schema"] = 1
+        with pytest.raises(SnapshotMismatch, match="schema"):
+            B.restore_session(old, "x")
+        # bank-carrying rows can't land on an adapterless bank shape...
+        with pytest.raises(SnapshotMismatch, match="fingerprint"):
+            D.restore_session(snap, "x")
+        # ...and a bankless row can't land on a bank-carrying scheduler
+        D.claim("sd", prompt="plain", seed=6)
+        snap_plain = D.snapshot_session("sd")
+        assert snap_plain["adapter"] is None
+        with pytest.raises(SnapshotMismatch, match="fingerprint"):
+            B.restore_session(snap_plain, "x")
+        assert B.live_sessions == 0 and D.live_sessions == 1
+
+        # the move: style name + factor rows land bit-exact
+        sb = B.restore_session(snap, "sb")
+        assert sb.adapter == "styleA"
+        for path in A.states["adapters"]:
+            for part in ("down", "up"):
+                np.testing.assert_array_equal(
+                    np.asarray(B.states["adapters"][path][part][sb.slot]),
+                    np.asarray(A.states["adapters"][path][part][sa.slot]),
+                )
+        # continuity: the export never touched the source, so both sides
+        # keep serving the styled stream identically
+        for f in frames[4:6]:
+            assert np.array_equal(_tick(sb, f), _tick(sa, f))
+
+        # crash-resume: B's periodic bank survives B; a fresh boot (same
+        # ADAPTER_DIR catalog) restores the styled session mid-stream
+        bank = B.snapshot_session("sb")
+        B.close()
+        A2 = _mk_sched(bundle, cfg32, adapters=_mk_adapter_reg(bundle))
+        s2 = A2.restore_session(bank, "sb")
+        assert s2.adapter == "styleA"
+        for f in frames[6:8]:
+            assert np.array_equal(_tick(s2, f), _tick(sa, f))
+        # restart() on the resumed session keeps the style bound
+        s2.restart()
+        assert s2.adapter == "styleA"
+        assert A2.snapshot()["adapter_sessions"] == 1
+    finally:
+        for s in (A, B, D, A2):
+            if s is not None:
+                s.close()
+
+
 def test_snapshot_unknown_session_and_fingerprint_shape(bundle, cfg32):
     sched = _mk_sched(bundle, cfg32)
     try:

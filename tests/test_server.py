@@ -110,6 +110,46 @@ def test_config_guidance_capability_checked_before_mutation():
     assert (g.prompt, g.guidance, g.delta) == ("p", 2.0, 0.5)
 
 
+def test_config_adapter_presence_keyed_and_capability_checked():
+    """ISSUE 20: the "adapter" /config key is PRESENCE-keyed (JSON null
+    CLEARS to the base style; an absent key touches nothing), refused
+    against a pipeline without the factor-bank surface BEFORE any other
+    key applies, and applied FIRST so an unknown style name rejects the
+    whole body un-applied."""
+    import pytest
+
+    from ai_rtc_agent_tpu.server.agent import apply_runtime_config
+
+    pipe = FakePipeline()  # has no update_adapter
+    with pytest.raises(ValueError, match="adapter hot-swap not supported"):
+        apply_runtime_config(pipe, {"prompt": "late", "adapter": "ghibli"})
+    assert pipe.prompt is None  # nothing half-applied
+
+    class Adapted(FakePipeline):
+        def __init__(self):
+            super().__init__()
+            self.swaps = []
+
+        def update_adapter(self, name):
+            if name == "nope":
+                raise KeyError("unknown adapter 'nope'")
+            self.swaps.append(name)
+
+    a = Adapted()
+    apply_runtime_config(a, {"adapter": "ghibli", "prompt": "p"})
+    assert a.swaps == ["ghibli"] and a.prompt == "p"
+    apply_runtime_config(a, {"adapter": None})  # null = clear, not absent
+    assert a.swaps == ["ghibli", None]
+    apply_runtime_config(a, {"prompt": "q"})  # absent key: style untouched
+    assert a.swaps == ["ghibli", None] and a.prompt == "q"
+    with pytest.raises(ValueError, match="string name or null"):
+        apply_runtime_config(a, {"adapter": 3})
+    # adapter applies FIRST: a registry refusal leaves the prompt alone
+    with pytest.raises(KeyError):
+        apply_runtime_config(a, {"adapter": "nope", "prompt": "never"})
+    assert a.prompt == "q" and a.swaps == ["ghibli", None]
+
+
 def test_whep_without_source_is_401_and_delete_200():
     async def go():
         app, client = await _client(FakePipeline())
